@@ -1,0 +1,39 @@
+"""cocoa_tpu — a TPU-native (JAX/XLA) framework for communication-efficient
+distributed primal-dual optimization.
+
+Re-implementation, from scratch and TPU-first, of the capabilities of the
+reference Spark/Scala framework (calvinmccarter/cocoa): CoCoA / CoCoA+ /
+mini-batch SDCA / local SGD / mini-batch SGD / distributed subgradient descent
+for L2-regularized hinge-loss SVMs, with duality-gap convergence certificates.
+
+Architecture (see SURVEY.md for the reference layer map this mirrors):
+
+- ``cocoa_tpu.data``     — LIBSVM ingestion + device-sharded dataset layouts
+                           (dense and padded-CSR), replacing the reference's
+                           Spark RDD loader (OptUtils.scala:11-53).
+- ``cocoa_tpu.parallel`` — device mesh + collective helpers; the Spark
+                           closure-broadcast / ``RDD.reduce`` communication
+                           backend (CoCoA.scala:45-47) becomes a single
+                           ``lax.psum`` over the ICI mesh.
+- ``cocoa_tpu.ops``      — jit-compiled local solvers (the per-worker inner
+                           loops: SDCA, SGD, subgradient pass), the TPU
+                           equivalents of CoCoA.scala:130-192 etc.
+- ``cocoa_tpu.solvers``  — outer-loop drivers (CoCoA.scala:39-63 skeleton):
+                           one jitted, shard_mapped round-step per algorithm,
+                           driven by a pure-Python (or lax.scan) outer loop.
+- ``cocoa_tpu.evals``    — primal/dual objectives, duality gap, classification
+                           error (OptUtils.scala:57-98 math) as sharded
+                           reductions.
+- ``cocoa_tpu.utils``    — reference-faithful RNG (java.util.Random LCG),
+                           trajectory logging, misc.
+- ``cocoa_tpu.checkpoint`` — round-stamped save/restore of (w, alpha, t, key);
+                           strictly more capable than the reference's RDD
+                           lineage checkpointing (CoCoA.scala:59-62).
+- ``cocoa_tpu.cli``      — accepts the full reference flag set
+                           (hingeDriver.scala:22-38) and runs the same
+                           algorithm menu.
+"""
+
+__version__ = "0.1.0"
+
+from cocoa_tpu.config import Params, DebugParams  # noqa: F401

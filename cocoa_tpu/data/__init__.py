@@ -1,0 +1,2 @@
+from cocoa_tpu.data.libsvm import load_libsvm, LibsvmData  # noqa: F401
+from cocoa_tpu.data.sharding import ShardedDataset, shard_dataset  # noqa: F401

@@ -1,0 +1,139 @@
+"""LIBSVM text ingestion.
+
+TPU-native replacement for the reference's Spark loader
+(OptUtils.scala:11-53).  Semantics kept 1:1:
+
+- label token containing ``+`` or parsing to int 1 → +1, anything else → −1
+  (OptUtils.scala:35-37; yes, that means "2" silently becomes −1 — documented
+  reference quirk #5 in SURVEY.md).
+- feature pairs are 1-based ``idx:val`` → 0-based indices
+  (OptUtils.scala:40-43).
+- ``num_features`` is taken from the caller (the ``--numFeatures`` flag), not
+  inferred, matching ``SparseVector(..., numFeats)``.
+
+Instead of an RDD of per-example sparse vectors, the output is a single
+columnar CSR triple (row pointers / column indices / values) — the layout
+device sharding wants.  A C++ fast path (``native/libsvm_parser.cpp``, loaded
+via ctypes) handles large files; the pure-Python path is the fallback and the
+semantic oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LibsvmData:
+    """Columnar CSR holding the whole dataset on host.
+
+    ``labels`` ∈ {−1.0, +1.0}; ``indptr`` has n+1 entries; ``indices`` are
+    0-based feature ids; ``num_features`` = d.
+    """
+
+    labels: np.ndarray     # (n,) float64
+    indptr: np.ndarray     # (n+1,) int64
+    indices: np.ndarray    # (nnz,) int32
+    values: np.ndarray     # (nnz,) float64
+    num_features: int
+
+    @property
+    def n(self) -> int:
+        return self.labels.shape[0]
+
+    def row(self, i: int) -> tuple[np.ndarray, np.ndarray]:
+        lo, hi = self.indptr[i], self.indptr[i + 1]
+        return self.indices[lo:hi], self.values[lo:hi]
+
+    def to_dense(self, dtype=np.float64) -> np.ndarray:
+        """(n, d) dense matrix."""
+        out = np.zeros((self.n, self.num_features), dtype=dtype)
+        for i in range(self.n):
+            lo, hi = self.indptr[i], self.indptr[i + 1]
+            out[i, self.indices[lo:hi]] = self.values[lo:hi]
+        return out
+
+    @property
+    def max_nnz(self) -> int:
+        if self.n == 0:
+            return 0
+        return int(np.max(np.diff(self.indptr)))
+
+
+def _parse_label(token: str) -> float:
+    """Reference label rule (OptUtils.scala:35-37)."""
+    if "+" in token:
+        return 1.0
+    try:
+        if float(token) == 1.0:
+            return 1.0
+    except ValueError:
+        pass
+    return -1.0
+
+
+def load_libsvm_python(path: str, num_features: int) -> LibsvmData:
+    """Pure-Python reference parser (semantic oracle for the native one)."""
+    labels: list[float] = []
+    indptr: list[int] = [0]
+    indices: list[np.ndarray] = []
+    values: list[np.ndarray] = []
+    nnz = 0
+    with open(path, "r") as f:
+        for line in f:
+            parts = line.strip().split(" ")
+            if not parts or parts == [""]:
+                continue
+            labels.append(_parse_label(parts[0]))
+            row_idx = np.empty(len(parts) - 1, dtype=np.int32)
+            row_val = np.empty(len(parts) - 1, dtype=np.float64)
+            m = 0
+            for tok in parts[1:]:
+                if not tok:
+                    continue
+                i, v = tok.split(":")
+                row_idx[m] = int(i) - 1  # 1-based → 0-based (OptUtils.scala:42)
+                row_val[m] = float(v)
+                m += 1
+            indices.append(row_idx[:m])
+            values.append(row_val[:m])
+            nnz += m
+            indptr.append(nnz)
+    return LibsvmData(
+        labels=np.asarray(labels, dtype=np.float64),
+        indptr=np.asarray(indptr, dtype=np.int64),
+        indices=(
+            np.concatenate(indices) if indices else np.empty(0, dtype=np.int32)
+        ),
+        values=(
+            np.concatenate(values) if values else np.empty(0, dtype=np.float64)
+        ),
+        num_features=num_features,
+    )
+
+
+def _validate(data: LibsvmData, path: str) -> LibsvmData:
+    if data.indices.size:
+        hi = int(data.indices.max())
+        if hi >= data.num_features:
+            raise ValueError(
+                f"{path}: feature index {hi + 1} (1-based) exceeds "
+                f"num_features={data.num_features}; pass a larger "
+                f"--numFeatures (the reference also requires d up front, "
+                f"OptUtils.scala:43)"
+            )
+        if int(data.indices.min()) < 0:
+            raise ValueError(f"{path}: negative feature index after 1→0 shift")
+    return data
+
+
+def load_libsvm(path: str, num_features: int, prefer_native: bool = True) -> LibsvmData:
+    """Parse a LIBSVM file; uses the C++ fast path when available."""
+    if prefer_native:
+        from cocoa_tpu.data import native_loader
+
+        if native_loader.available():
+            return _validate(native_loader.parse_file(path, num_features), path)
+    return _validate(load_libsvm_python(path, num_features), path)

@@ -1,0 +1,91 @@
+"""ctypes bridge to the C++ LIBSVM parser (native/libsvm_parser.cpp).
+
+The reference's only native component is JNI-wrapped BLAS (build.sbt:27);
+here the native obligation lands on the runtime around the XLA compute path —
+starting with ingestion, whose line parsing is the one CPU-bound O(file-size)
+step.  The shared library is built by ``make -C native`` (see native/Makefile);
+when it is absent, ``available()`` is False and callers fall back to the
+pure-Python parser, which is the semantic oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from cocoa_tpu.data.libsvm import LibsvmData
+
+_SO_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "libsvm_parser.so",
+)
+
+_lib = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH):
+        return None
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.cocoa_parse_libsvm.restype = ctypes.c_void_p
+    lib.cocoa_parse_libsvm.argtypes = [ctypes.c_char_p]
+    lib.cocoa_parsed_n.restype = ctypes.c_int64
+    lib.cocoa_parsed_n.argtypes = [ctypes.c_void_p]
+    lib.cocoa_parsed_nnz.restype = ctypes.c_int64
+    lib.cocoa_parsed_nnz.argtypes = [ctypes.c_void_p]
+    lib.cocoa_parsed_fill.restype = None
+    lib.cocoa_parsed_fill.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),  # labels (n)
+        ctypes.POINTER(ctypes.c_int64),   # indptr (n+1)
+        ctypes.POINTER(ctypes.c_int32),   # indices (nnz)
+        ctypes.POINTER(ctypes.c_double),  # values (nnz)
+    ]
+    lib.cocoa_parsed_free.restype = None
+    lib.cocoa_parsed_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_file(path: str, num_features: int) -> Optional[LibsvmData]:
+    """Parse via the C++ library; None when the library is not built."""
+    lib = _load()
+    if lib is None:
+        return None
+    handle = lib.cocoa_parse_libsvm(path.encode())
+    if not handle:
+        raise IOError(f"native parser failed to open {path}")
+    try:
+        n = lib.cocoa_parsed_n(handle)
+        nnz = lib.cocoa_parsed_nnz(handle)
+        labels = np.empty(n, dtype=np.float64)
+        indptr = np.empty(n + 1, dtype=np.int64)
+        indices = np.empty(max(nnz, 1), dtype=np.int32)
+        values = np.empty(max(nnz, 1), dtype=np.float64)
+        lib.cocoa_parsed_fill(
+            handle,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+    finally:
+        lib.cocoa_parsed_free(handle)
+    return LibsvmData(
+        labels=labels,
+        indptr=indptr,
+        indices=indices[:nnz],
+        values=values[:nnz],
+        num_features=num_features,
+    )

@@ -1,0 +1,7 @@
+from cocoa_tpu.parallel.mesh import (  # noqa: F401
+    DP_AXIS,
+    FP_AXIS,
+    make_mesh,
+    replicated,
+    sharded_rows,
+)

@@ -1,0 +1,71 @@
+"""Device mesh and collective-communication backend.
+
+The reference's entire communication layer is implicit Spark dataflow: the
+driver broadcasts ``w`` by closure capture to K executors and sum-reduces the
+per-shard ``Δw`` with ``RDD.reduce(_ + _)`` (CoCoA.scala:45-47) — one O(d)
+all-reduce per outer round.  Here the same contract is carried by XLA
+collectives over the ICI mesh:
+
+- ``w`` lives **replicated** on every device: the broadcast costs nothing.
+- ``Δw`` is combined with one ``lax.psum`` over the data-parallel axis.
+- shard-local state (``α``, the data shard) is pinned per-device in HBM and
+  never moves — the analogue of ``preservesPartitioning=true`` + per-partition
+  ``α`` RDDs (CoCoA.scala:33-34,45).
+
+Mesh axes:
+
+- ``dp`` — data parallelism over example shards (the reference's only
+  parallelism strategy; K = number of Spark partitions).
+- ``fp`` — optional feature-dimension sharding of ``w``/``X`` for very large d
+  (a TPU extension with no reference analogue; see SURVEY.md §2.2).
+
+On a real pod the mesh should be built so ``dp`` rides ICI; a multi-slice
+deployment puts the slowest axis on DCN.  Tests simulate K devices on CPU via
+``--xla_force_host_platform_device_count`` (see tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+FP_AXIS = "fp"
+
+
+def make_mesh(
+    k: Optional[int] = None,
+    fp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp,) or (dp, fp) mesh over ``k * fp`` devices.
+
+    ``k`` defaults to using every available device on the dp axis.  Raises if
+    the device count cannot satisfy the request — shards must map 1:1 onto
+    mesh positions (unlike Spark, where K partitions multiplex onto fewer
+    executors; on TPU the mesh *is* the worker set).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if k is None:
+        k = len(devices) // fp
+    need = k * fp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh ({k} dp x {fp} fp) needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    if fp == 1:
+        return jax.make_mesh((k,), (DP_AXIS,), devices=devices[:need])
+    return jax.make_mesh((k, fp), (DP_AXIS, FP_AXIS), devices=devices[:need])
+
+
+def sharded_rows(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
+    """Sharding for per-shard stacked arrays of shape (K, ...): axis 0 on dp."""
+    return NamedSharding(mesh, P(DP_AXIS, *([None] * extra_dims)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for fully replicated arrays (the global primal vector w)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,1 @@
+from cocoa_tpu.utils.prng import JavaRandom, sample_indices  # noqa: F401

@@ -1,0 +1,142 @@
+"""Random sampling, with a reference-faithful mode.
+
+The reference draws local example indices with ``new scala.util.Random(seed)``
+(CoCoA.scala:144,151), where the per-round seed is ``debug.seed + t``
+(CoCoA.scala:45) and — crucially — **every shard uses the same seed in the same
+round**, so index draws are correlated across workers.  ``scala.util.Random``
+delegates to ``java.util.Random``, whose 48-bit LCG is fixed by spec, so we can
+reproduce the exact index sequences here without a JVM.
+
+Two modes (selected by ``RunConfig.rng``):
+
+- ``reference``: host-side ``JavaRandom`` precomputes the (T, H) index table,
+  identical across shards — bit-faithful to the Scala behavior.  Index draws
+  are data-independent (uniform), so precomputing them does not change the
+  algorithm; it just moves RNG off the device hot path.
+- ``jax``: ``jax.random`` keyed by (seed, round) and folded per shard —
+  decorrelated across workers, the statistically preferable mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MULT = 0x5DEECE66D
+_ADD = 0xB
+_MASK = (1 << 48) - 1
+
+
+class JavaRandom:
+    """Bit-exact java.util.Random (the engine behind scala.util.Random).
+
+    Implements the linear congruential generator specified in the Java SE
+    docs: seed' = (seed * 0x5DEECE66D + 0xB) mod 2^48.
+    """
+
+    def __init__(self, seed: int):
+        self._seed = (seed ^ _MULT) & _MASK
+
+    def _next(self, bits: int) -> int:
+        self._seed = (self._seed * _MULT + _ADD) & _MASK
+        # top `bits` bits, as a signed 32-bit int when bits == 32
+        val = self._seed >> (48 - bits)
+        if bits == 32 and val >= (1 << 31):
+            val -= 1 << 32
+        return val
+
+    def next_int(self, bound: int | None = None) -> int:
+        if bound is None:
+            return self._next(32)
+        if bound <= 0:
+            raise ValueError("bound must be positive")
+        if (bound & -bound) == bound:  # power of two
+            return (bound * self._next(31)) >> 31
+        while True:
+            bits = self._next(31)
+            val = bits % bound
+            if bits - val + (bound - 1) < (1 << 31):  # no int32 overflow
+                return val
+
+    def next_double(self) -> float:
+        return ((self._next(26) << 27) + self._next(27)) * (2.0 ** -53)
+
+
+# ---- vectorized LCG (numpy uint64, 48-bit multiply done in two 24-bit
+# halves so nothing overflows 64 bits) ----
+
+_U_MULT = np.uint64(_MULT)
+_U_ADD = np.uint64(_ADD)
+_U_MASK = np.uint64(_MASK)
+_LO24 = np.uint64((1 << 24) - 1)
+_S24 = np.uint64(24)
+_S17 = np.uint64(17)  # 48 - 31: top 31 bits for next(31)
+
+
+def _scramble(seeds: np.ndarray) -> np.ndarray:
+    return (seeds.astype(np.uint64) ^ _U_MULT) & _U_MASK
+
+
+def _advance(states: np.ndarray) -> np.ndarray:
+    hi = states >> _S24
+    lo = states & _LO24
+    prod = (((hi * _U_MULT) & _LO24) << _S24) + lo * _U_MULT
+    return (prod + _U_ADD) & _U_MASK
+
+
+def _next_int_vec(states: np.ndarray, bounds: np.ndarray):
+    """One java.util.Random.nextInt(bound) per lane; returns (values, states).
+
+    Handles the power-of-two fast path and the modulo-rejection loop per lane
+    (lanes that reject advance their own state and redraw; accepted lanes
+    don't), exactly as the scalar spec does.
+    """
+    bounds = bounds.astype(np.int64)
+    is_pow2 = (bounds & -bounds) == bounds
+    states = _advance(states)
+    bits = (states >> _S17).astype(np.int64)  # next(31)
+    val_pow2 = (bounds * bits) >> np.int64(31)
+    val_mod = bits % bounds
+    reject = (~is_pow2) & (bits - val_mod + (bounds - 1) >= (1 << 31))
+    while np.any(reject):
+        states = np.where(reject, _advance(states), states)
+        new_bits = (states >> _S17).astype(np.int64)
+        bits = np.where(reject, new_bits, bits)
+        val_mod = np.where(reject, bits % bounds, val_mod)
+        reject = (~is_pow2) & (bits - val_mod + (bounds - 1) >= (1 << 31))
+    return np.where(is_pow2, val_pow2, val_mod).astype(np.int32), states
+
+
+def sample_indices(seed: int, rounds: range, h: int, n_local: int) -> np.ndarray:
+    """Index table for the reference RNG mode.
+
+    For round t the reference seeds ``Random(seed + t)`` and draws H indices
+    uniform in [0, n_local) (CoCoA.scala:148-151).  Returns int32 array of
+    shape (len(rounds), H).  All shards share this table (the reference's
+    correlated-across-workers behavior); callers wanting per-shard tables pass
+    a shard-adjusted seed.  Vectorized over rounds (rounds reseed
+    independently, so their LCG streams are independent lanes).
+    """
+    return sample_indices_per_shard(seed, rounds, h, [n_local])[0]
+
+
+def sample_indices_per_shard(
+    seed: int, rounds: range, h: int, n_locals: "list[int] | np.ndarray"
+) -> np.ndarray:
+    """Reference-mode index table for K shards of (possibly) unequal size.
+
+    Shard k replays ``Random(seed + t)`` against its own ``n_local`` — exactly
+    what each Spark task does with its partition (CoCoA.scala:144,151).  Shape
+    (K, len(rounds), H).  Equal-size shards see identical draws (the
+    reference's correlated-across-workers behavior).
+    """
+    n_locals = np.asarray(n_locals, dtype=np.int64)
+    if np.any(n_locals <= 0):
+        raise ValueError(f"all shards must be non-empty, got sizes {n_locals}")
+    t0 = np.asarray([seed + t for t in rounds], dtype=np.int64)
+    k = n_locals.shape[0]
+    states = np.broadcast_to(_scramble(t0)[None, :], (k, len(t0))).copy()
+    bounds = np.broadcast_to(n_locals[:, None], states.shape)
+    out = np.empty((k, len(t0), h), dtype=np.int32)
+    for j in range(h):
+        out[:, :, j], states = _next_int_vec(states, bounds)
+    return out

@@ -1,0 +1,125 @@
+// Fast LIBSVM text parser for cocoa_tpu.
+//
+// Native-runtime counterpart of the Spark loader (reference:
+// OptUtils.scala:11-53).  Semantics match the Python oracle in
+// cocoa_tpu/data/libsvm.py exactly:
+//   - label token containing '+' or equal to 1 -> +1, else -1
+//     (OptUtils.scala:35-37)
+//   - 1-based idx:val pairs -> 0-based indices (OptUtils.scala:42)
+//
+// Exposed through a tiny C ABI consumed via ctypes
+// (cocoa_tpu/data/native_loader.py): parse -> query sizes -> fill
+// caller-allocated numpy buffers -> free.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Parsed {
+  std::vector<double> labels;
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> indices;
+  std::vector<double> values;
+};
+
+// Label rule per OptUtils.scala:35-37 ('+' anywhere in the token, or the
+// token parsing to 1, means +1; everything else silently -1).
+double parse_label(const char* tok, const char* end) {
+  for (const char* p = tok; p < end; ++p) {
+    if (*p == '+') return 1.0;
+  }
+  char* stop = nullptr;
+  double v = strtod(tok, &stop);
+  return (stop != tok && v == 1.0) ? 1.0 : -1.0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* cocoa_parse_libsvm(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+
+  // read whole file (datasets at this scale fit host RAM comfortably;
+  // epsilon ~12GB text would want mmap, a TODO noted in native/README)
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = static_cast<char*>(malloc(size + 1));
+  if (!buf || fread(buf, 1, size, f) != static_cast<size_t>(size)) {
+    fclose(f);
+    free(buf);
+    return nullptr;
+  }
+  fclose(f);
+  buf[size] = '\0';
+
+  auto* out = new Parsed();
+  out->indptr.push_back(0);
+
+  char* p = buf;
+  char* fend = buf + size;
+  while (p < fend) {
+    // find end of line
+    char* eol = static_cast<char*>(memchr(p, '\n', fend - p));
+    if (!eol) eol = fend;
+    *eol = '\0';
+
+    // skip leading spaces; blank lines are skipped entirely
+    while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+    if (p < eol) {
+      // label token ends at first space
+      char* sp = p;
+      while (sp < eol && *sp != ' ' && *sp != '\t') ++sp;
+      out->labels.push_back(parse_label(p, sp));
+
+      // idx:val pairs
+      p = sp;
+      while (p < eol) {
+        while (p < eol && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+        if (p >= eol) break;
+        char* stop = nullptr;
+        long idx = strtol(p, &stop, 10);
+        if (stop == p || *stop != ':') break;  // malformed tail: stop row
+        p = stop + 1;
+        double val = strtod(p, &stop);
+        if (stop == p) break;
+        p = stop;
+        out->indices.push_back(static_cast<int32_t>(idx - 1));  // 1->0 based
+        out->values.push_back(val);
+      }
+      out->indptr.push_back(static_cast<int64_t>(out->indices.size()));
+    }
+    p = eol + 1;
+  }
+
+  free(buf);
+  return out;
+}
+
+int64_t cocoa_parsed_n(void* handle) {
+  return static_cast<Parsed*>(handle)->labels.size();
+}
+
+int64_t cocoa_parsed_nnz(void* handle) {
+  return static_cast<Parsed*>(handle)->indices.size();
+}
+
+void cocoa_parsed_fill(void* handle, double* labels, int64_t* indptr,
+                       int32_t* indices, double* values) {
+  auto* parsed = static_cast<Parsed*>(handle);
+  memcpy(labels, parsed->labels.data(), parsed->labels.size() * sizeof(double));
+  memcpy(indptr, parsed->indptr.data(), parsed->indptr.size() * sizeof(int64_t));
+  memcpy(indices, parsed->indices.data(),
+         parsed->indices.size() * sizeof(int32_t));
+  memcpy(values, parsed->values.data(), parsed->values.size() * sizeof(double));
+}
+
+void cocoa_parsed_free(void* handle) { delete static_cast<Parsed*>(handle); }
+
+}  // extern "C"

@@ -1,0 +1,74 @@
+"""Parser golden tests against the bundled reference data
+(/root/reference/data, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from cocoa_tpu.data.libsvm import _parse_label, load_libsvm_python
+
+
+def test_small_train_shape_and_labels(small_train):
+    # 2000 rows, balanced 1000/+1000− (SURVEY.md §4); d = 9947
+    assert small_train.n == 2000
+    assert small_train.num_features == 9947
+    assert set(np.unique(small_train.labels)) == {-1.0, 1.0}
+    assert int(np.sum(small_train.labels == 1.0)) == 1000
+
+
+def test_small_test_shape(small_test):
+    assert small_test.n == 600
+    assert set(np.unique(small_test.labels)) <= {-1.0, 1.0}
+
+
+def test_first_row_golden(small_train):
+    # First line of small_train.dat: label 1, first pair 6:0.0198403253586671
+    idx, val = small_train.row(0)
+    assert small_train.labels[0] == 1.0
+    assert idx[0] == 5  # 1-based → 0-based (OptUtils.scala:42)
+    assert val[0] == pytest.approx(0.0198403253586671, abs=0.0)
+    # indices strictly within [0, d)
+    assert small_train.indices.min() >= 0
+    assert small_train.indices.max() < 9947
+
+
+def test_label_rule_reference_faithful():
+    # OptUtils.scala:35-37: '+' or 1 → +1, everything else → −1
+    assert _parse_label("+1") == 1.0
+    assert _parse_label("1") == 1.0
+    assert _parse_label("-1") == -1.0
+    assert _parse_label("0") == -1.0
+    assert _parse_label("2") == -1.0  # reference quirk #5: silently −1
+
+
+def test_to_dense_roundtrip(tiny_data):
+    dense = tiny_data.to_dense()
+    assert dense.shape == (tiny_data.n, tiny_data.num_features)
+    i = 3
+    idx, val = tiny_data.row(i)
+    np.testing.assert_allclose(dense[i, idx], val)
+    mask = np.ones(tiny_data.num_features, bool)
+    mask[idx] = False
+    assert np.all(dense[i, mask] == 0)
+
+
+def test_native_parser_matches_python_oracle():
+    from cocoa_tpu.data import native_loader
+
+    if not native_loader.available():
+        import pytest
+
+        pytest.skip("native parser not built (make -C native)")
+    nat = native_loader.parse_file("/root/reference/data/small_train.dat", 9947)
+    py = load_libsvm_python("/root/reference/data/small_train.dat", 9947)
+    np.testing.assert_array_equal(nat.labels, py.labels)
+    np.testing.assert_array_equal(nat.indptr, py.indptr)
+    np.testing.assert_array_equal(nat.indices, py.indices)
+    np.testing.assert_array_equal(nat.values, py.values)
+
+
+def test_python_parser_is_fallback_identical(small_train):
+    py = load_libsvm_python("/root/reference/data/small_train.dat", 9947)
+    np.testing.assert_array_equal(py.labels, small_train.labels)
+    np.testing.assert_array_equal(py.indptr, small_train.indptr)
+    np.testing.assert_array_equal(py.indices, small_train.indices)
+    np.testing.assert_array_equal(py.values, small_train.values)

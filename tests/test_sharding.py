@@ -1,0 +1,81 @@
+"""Sharded dataset layout tests: balanced contiguous splits, padding
+invariants, dense/sparse agreement, mesh placement."""
+
+import jax
+import numpy as np
+
+from cocoa_tpu.data.sharding import shard_dataset, split_sizes
+from cocoa_tpu.parallel import make_mesh
+
+
+def test_split_sizes_balanced():
+    s = split_sizes(2000, 4)
+    assert s.tolist() == [500, 500, 500, 500]
+    s = split_sizes(10, 3)
+    assert s.tolist() == [4, 3, 3]
+    assert split_sizes(2, 8).tolist() == [1, 1] + [0] * 6
+
+
+def test_dense_shards_contiguous(tiny_data):
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=np.float64)
+    assert ds.layout == "dense"
+    assert ds.X.shape == (4, 24, tiny_data.num_features)
+    dense = tiny_data.to_dense()
+    # shard 1 holds rows 24..48 in order
+    np.testing.assert_allclose(np.asarray(ds.X[1]), dense[24:48])
+    np.testing.assert_allclose(np.asarray(ds.labels[1]), tiny_data.labels[24:48])
+    np.testing.assert_allclose(np.asarray(ds.mask), 1.0)
+
+
+def test_sparse_dense_same_semantics(tiny_data):
+    dd = shard_dataset(tiny_data, k=3, layout="dense", dtype=np.float64)
+    sd = shard_dataset(tiny_data, k=3, layout="sparse", dtype=np.float64)
+    # reconstruct dense rows from padded-CSR and compare
+    for s in range(3):
+        for i in range(int(sd.counts[s])):
+            row = np.zeros(tiny_data.num_features)
+            idx = np.asarray(sd.sp_indices[s, i])
+            val = np.asarray(sd.sp_values[s, i])
+            np.add.at(row, idx, val)
+            np.testing.assert_allclose(row, np.asarray(dd.X[s, i]), atol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(sd.sq_norms), np.asarray(dd.sq_norms), atol=1e-12
+    )
+
+
+def test_padding_and_sq_norms(tiny_data):
+    # 96 rows over 5 shards → sizes [20,19,19,19,19], padded to 20
+    ds = shard_dataset(tiny_data, k=5, layout="dense", dtype=np.float64)
+    assert ds.counts.tolist() == [20, 19, 19, 19, 19]
+    assert ds.n_shard == 20
+    m = np.asarray(ds.mask)
+    assert np.all(m[1:, 19] == 0.0)
+    assert np.all(np.asarray(ds.X)[1:, 19] == 0.0)
+    dense = tiny_data.to_dense()
+    np.testing.assert_allclose(
+        np.asarray(ds.sq_norms[0, :20]),
+        np.sum(dense[:20] ** 2, axis=1),
+        rtol=1e-12,
+    )
+
+
+def test_auto_layout_picks_sparse_for_sparse_data(small_train):
+    ds = shard_dataset(small_train, k=4, layout="auto")
+    assert ds.layout == "sparse"  # density ~0.2% on small_train
+
+
+def test_mesh_placement(tiny_data):
+    mesh = make_mesh(4)
+    assert mesh.shape["dp"] == 4
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=np.float64, mesh=mesh)
+    assert len(ds.X.sharding.device_set) == 4
+    # each device holds exactly its shard
+    shard_shapes = {s.data.shape for s in ds.X.addressable_shards}
+    assert shard_shapes == {(1, 24, tiny_data.num_features)}
+
+
+def test_make_mesh_too_many_devices():
+    import pytest
+
+    with pytest.raises(ValueError):
+        make_mesh(100)
