@@ -1,0 +1,3 @@
+from cocoa_tpu.cli import main
+
+raise SystemExit(main())

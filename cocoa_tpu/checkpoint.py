@@ -1,0 +1,62 @@
+"""Resumable training checkpoints.
+
+The reference's checkpointing is Spark lineage truncation only — it cannot
+restart a killed job (SURVEY.md §5).  This is the strictly-more-capable TPU
+equivalent: a round-stamped device→host save of the full optimizer state
+(w, per-shard alpha, round, rng seed), restorable into a fresh process.
+
+Plain ``.npz`` + a JSON sidecar is deliberate: the state is two arrays and
+three scalars; orbax would be justified the day state becomes a nested
+pytree across hosts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def save(
+    directory: str,
+    algorithm: str,
+    round_t: int,
+    w: jax.Array,
+    alpha: Optional[jax.Array] = None,
+    seed: int = 0,
+) -> str:
+    """Write checkpoint for ``round_t``; returns the file path."""
+    os.makedirs(directory, exist_ok=True)
+    algorithm = algorithm.replace(" ", "_")
+    path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
+    arrays = {"w": np.asarray(w)}
+    if alpha is not None:
+        arrays["alpha"] = np.asarray(alpha)
+    np.savez(path, **arrays)
+    meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def latest(directory: str, algorithm: str) -> Optional[str]:
+    """Most recent checkpoint path for ``algorithm``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    algorithm = algorithm.replace(" ", "_")
+    files = sorted(
+        f for f in os.listdir(directory)
+        if f.startswith(f"{algorithm}-r") and f.endswith(".npz")
+    )
+    return os.path.join(directory, files[-1]) if files else None
+
+
+def load(path: str):
+    """Returns (meta dict, w, alpha-or-None) as host numpy arrays."""
+    with open(path + ".json") as f:
+        meta = json.load(f)
+    data = np.load(path)
+    return meta, data["w"], (data["alpha"] if "alpha" in data.files else None)

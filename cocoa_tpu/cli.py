@@ -1,0 +1,192 @@
+"""Command-line driver — drop-in for the reference CLI
+(hingeDriver.scala:11-115).
+
+Accepts the same ``--key=value`` flag set (including ``--master``, accepted
+and ignored), loads train/test LIBSVM data, computes H = max(1,
+localIterFrac·n/K), then runs the same algorithm menu: CoCoA+ and CoCoA
+always; mini-batch CD, mini-batch SGD, local SGD and DistGD when
+``--justCoCoA=false`` (hingeDriver.scala:84-110).
+
+TPU-native additions (no reference analogue): ``--dtype``, ``--layout``,
+``--rng``, ``--mesh`` (dp size; defaults to min(numSplits, device count);
+``--mesh=1`` forces the single-chip vmap path), ``--trajOut`` (JSONL
+trajectory dump), ``--gapTarget`` (early stop on duality gap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from cocoa_tpu.config import REFERENCE_FLAGS, RunConfig
+from cocoa_tpu.data import load_libsvm, shard_dataset
+from cocoa_tpu.evals import objectives
+from cocoa_tpu.parallel import make_mesh
+from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
+
+_TPU_FLAGS = ("dtype", "layout", "rng")       # map to same-named RunConfig fields
+_EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume")  # run-level, not in RunConfig
+
+_BOOL_FIELDS = {"just_cocoa"}
+_INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
+               "debug_iter", "seed"}
+_FLOAT_FIELDS = {"lam", "local_iter_frac", "beta", "gamma"}
+
+
+def parse_args(argv: list[str]):
+    """--key=value (or bare --flag == true, hingeDriver.scala:13-19)."""
+    options: dict[str, str] = {}
+    for arg in argv:
+        stripped = arg.lstrip("-")
+        if "=" in stripped:
+            key, val = stripped.split("=", 1)
+        else:
+            key, val = stripped, "true"
+        options[key] = val
+
+    cfg = RunConfig()
+    extras = {k: None for k in _EXTRA_FLAGS}
+    for key, val in options.items():
+        if key in _EXTRA_FLAGS:
+            extras[key] = val
+            continue
+        if key in REFERENCE_FLAGS:
+            field = REFERENCE_FLAGS[key]
+            if field is None:  # --master: accepted, ignored
+                continue
+        elif key in _TPU_FLAGS:
+            field = key
+        else:
+            raise SystemExit(f"Invalid argument: --{key}")
+        if field in _BOOL_FIELDS:
+            if val.lower() not in ("true", "false"):
+                # Scala's String.toBoolean rejects anything else too
+                raise SystemExit(f"Invalid argument: --{key}={val} (expected true/false)")
+            setattr(cfg, field, val.lower() == "true")
+        elif field in _INT_FIELDS:
+            setattr(cfg, field, int(val))
+        elif field in _FLOAT_FIELDS:
+            setattr(cfg, field, float(val))
+        else:
+            setattr(cfg, field, val)
+    return cfg, extras
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cfg, extras = parse_args(argv)
+
+    if not cfg.train_file:
+        print("error: --trainFile is required", file=sys.stderr)
+        return 2
+    if cfg.num_features <= 0:
+        print("error: --numFeatures must be positive", file=sys.stderr)
+        return 2
+
+    # echo flags, as the reference does (hingeDriver.scala:41-48) — with its
+    # gamma-prints-beta bug (quirk #2) fixed
+    for f in dataclasses.fields(cfg):
+        print(f"{f.name}: {getattr(cfg, f.name)}")
+
+    dtype = jnp.dtype(cfg.dtype)
+    if dtype == jnp.float64:
+        jax.config.update("jax_enable_x64", True)
+
+    data = load_libsvm(cfg.train_file, cfg.num_features)
+    n = data.n
+    k = cfg.num_splits
+
+    # mesh selection: K shards need a K-device dp mesh; anything else runs
+    # the single-chip vmap path (all K logical shards on one device).  An
+    # explicit --mesh that can't be honored is an error; inferred sizes
+    # fall back silently.
+    mesh = None
+    explicit = extras["mesh"] is not None
+    mesh_size = int(extras["mesh"]) if explicit else min(k, len(jax.devices()))
+    if explicit and (mesh_size > len(jax.devices()) or (mesh_size > 1 and mesh_size != k)):
+        print(f"error: --mesh={mesh_size} needs exactly numSplits={k} devices "
+              f"(have {len(jax.devices())}); use --mesh=1 for the single-chip path",
+              file=sys.stderr)
+        return 2
+    if mesh_size == k and k > 1:
+        mesh = make_mesh(k)
+
+    ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
+    test_ds = None
+    if cfg.test_file:
+        test_data = load_libsvm(cfg.test_file, cfg.num_features)
+        test_ds = shard_dataset(test_data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
+
+    params = cfg.to_params(n, k)
+    debug = cfg.to_debug()
+    gap_target = float(extras["gapTarget"]) if extras["gapTarget"] else None
+    resume = extras["resume"] is not None and str(extras["resume"]).lower() != "false"
+    if resume and not cfg.chkpt_dir:
+        print("error: --resume requires --chkptDir", file=sys.stderr)
+        return 2
+
+    def restore(algorithm):
+        """(w_init, alpha_init, start_round) from the latest checkpoint."""
+        if not resume:
+            return dict()
+        from cocoa_tpu import checkpoint as ckpt_lib
+
+        path = ckpt_lib.latest(cfg.chkpt_dir, algorithm)
+        if path is None:
+            return dict()
+        meta, w0, a0 = ckpt_lib.load(path)
+        print(f"resuming {algorithm} from round {meta['round']} ({path})")
+        out = dict(w_init=w0, start_round=meta["round"] + 1)
+        if a0 is not None:
+            out["alpha_init"] = a0
+        return out
+
+    def finish(traj, w, alpha=None):
+        primal = objectives.primal_objective(ds, w, params.lam)
+        gap = (
+            primal - objectives.dual_objective(ds, w, alpha, params.lam)
+            if alpha is not None
+            else None
+        )
+        err = (
+            objectives.classification_error(test_ds, w)
+            if test_ds is not None
+            else None
+        )
+        traj.summary(primal, gap=gap, test_error=err)
+        if extras["trajOut"]:
+            path = f"{extras['trajOut']}.{traj.algorithm.replace(' ', '_')}.jsonl"
+            traj.dump_jsonl(path)
+
+    common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng)
+
+    w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
+                               gap_target=gap_target, **restore("CoCoA+"), **common)
+    finish(traj, w, alpha)
+
+    w, alpha, traj = run_cocoa(ds, params, debug, plus=False,
+                               gap_target=gap_target, **restore("CoCoA"), **common)
+    finish(traj, w, alpha)
+
+    if not cfg.just_cocoa:  # hingeDriver.scala:93-110
+        w, alpha, traj = run_minibatch_cd(ds, params, debug,
+                                          **restore("Mini-batch CD"), **common)
+        finish(traj, w, alpha)
+
+        w, traj = run_sgd(ds, params, debug, local=False, **common)
+        finish(traj, w)
+
+        w, traj = run_sgd(ds, params, debug, local=True, **common)
+        finish(traj, w)
+
+        w, traj = run_dist_gd(ds, params, debug, mesh=mesh, test_ds=test_ds)
+        finish(traj, w)
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

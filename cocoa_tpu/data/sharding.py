@@ -92,6 +92,41 @@ class ShardedDataset:
             out["sp_values"] = self.sp_values
         return out
 
+    # --- pytree protocol: array fields are leaves, metadata is static, so a
+    # ShardedDataset can be passed straight through jit/shard_map ---
+    def tree_flatten(self):
+        children = (
+            self.labels, self.mask, self.sq_norms,
+            self.X, self.sp_indices, self.sp_values,
+        )
+        aux = (self.layout, self.n, self.num_features, tuple(self.counts))
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        labels, mask, sq_norms, X, sp_indices, sp_values = children
+        layout, n, num_features, counts = aux
+        return cls(
+            layout=layout,
+            n=n,
+            num_features=num_features,
+            counts=np.asarray(counts, dtype=np.int64),
+            labels=labels,
+            mask=mask,
+            sq_norms=sq_norms,
+            X=X,
+            sp_indices=sp_indices,
+            sp_values=sp_values,
+        )
+
+
+try:
+    jax.tree_util.register_pytree_node(
+        ShardedDataset, ShardedDataset.tree_flatten, ShardedDataset.tree_unflatten
+    )
+except ValueError:
+    pass  # already registered (module re-imported/reloaded)
+
 
 def shard_dataset(
     data: LibsvmData,

@@ -1,0 +1,6 @@
+from cocoa_tpu.evals.objectives import (  # noqa: F401
+    classification_error,
+    dual_objective,
+    duality_gap,
+    primal_objective,
+)

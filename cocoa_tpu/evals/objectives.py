@@ -1,0 +1,90 @@
+"""Objectives and the duality-gap convergence certificate.
+
+Math from OptUtils.scala:57-98:
+
+- hinge loss           max(1 − y·(x·w), 0)                      (:57-61)
+- primal objective     avg hinge + (λ/2)‖w‖²                    (:73-75)
+- dual objective       −(λ/2)‖w‖² + Σα/n                        (:80-84)
+- duality gap          primal − dual                            (:89-91)
+- classification error mean over examples of [y·(x·w) ≤ 0]      (:95-98)
+
+These cost a full data pass (the reference gates them to every ``debugIter``
+rounds — CoCoA.scala:51); same policy here.  Each reduction runs through the
+same fan-out machinery as the solvers (parallel/fanout.py): per-shard partial
+sums, one scalar ``lax.psum`` — the TPU equivalent of
+``data.map(...).reduce(_ + _)`` (OptUtils.scala:67).  Padded rows are
+excluded via the mask.  The dp mesh is inferred from array placement, so the
+same code serves the multi-device and single-chip paths.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.ops.rows import shard_margins
+from cocoa_tpu.parallel.fanout import fanout, mesh_of
+
+
+@functools.lru_cache(maxsize=None)
+def _hinge_sum_fn(mesh):
+    def per_shard(w, shard):
+        hinge = jnp.maximum(1.0 - shard["labels"] * shard_margins(w, shard), 0.0)
+        return (jnp.sum(hinge * shard["mask"]),)
+
+    @jax.jit
+    def f(w, shard_arrays):
+        (total,) = fanout(per_shard, mesh, w, shard_arrays)
+        return total
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _alpha_sum_fn(mesh):
+    def per_shard(w, alpha_k, shard):
+        return (jnp.sum(alpha_k * shard["mask"]),)
+
+    @jax.jit
+    def f(w, alpha, shard_arrays):
+        (total,) = fanout(per_shard, mesh, w, alpha, shard_arrays)
+        return total
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _error_sum_fn(mesh):
+    def per_shard(w, shard):
+        correct = (shard_margins(w, shard) * shard["labels"]) > 0.0
+        return (jnp.sum(jnp.where(correct, 0.0, 1.0) * shard["mask"]),)
+
+    @jax.jit
+    def f(w, shard_arrays):
+        (total,) = fanout(per_shard, mesh, w, shard_arrays)
+        return total
+
+    return f
+
+
+def primal_objective(ds: ShardedDataset, w, lam) -> float:
+    hinge_sum = _hinge_sum_fn(mesh_of(ds.labels))(w, ds.shard_arrays())
+    return float(hinge_sum) / ds.n + 0.5 * lam * float(w @ w)
+
+
+def dual_objective(ds: ShardedDataset, w, alpha, lam) -> float:
+    """alpha: (K, n_shard) sharded dual variables."""
+    sum_alpha = _alpha_sum_fn(mesh_of(ds.labels))(w, alpha, ds.shard_arrays())
+    return -0.5 * lam * float(w @ w) + float(sum_alpha) / ds.n
+
+
+def duality_gap(ds: ShardedDataset, w, alpha, lam) -> float:
+    return primal_objective(ds, w, lam) - dual_objective(ds, w, alpha, lam)
+
+
+def classification_error(ds: ShardedDataset, w) -> float:
+    errors = _error_sum_fn(mesh_of(ds.labels))(w, ds.shard_arrays())
+    return float(errors) / ds.n

@@ -1,0 +1,3 @@
+from cocoa_tpu.ops.local_sdca import local_sdca  # noqa: F401
+from cocoa_tpu.ops.local_sgd import local_sgd  # noqa: F401
+from cocoa_tpu.ops.subgradient import subgradient_pass  # noqa: F401

@@ -1,0 +1,64 @@
+"""Local SGD / mini-batch SGD inner loop (reference: SGD.scala:87-139).
+
+- ``local=True`` (Local SGD): H Pegasos-style steps on a private copy of w —
+  per step, w *= (1 − ηλ) with η = 1/(λ(t_global + i)) (SGD.scala:106,117-121),
+  then w += η·y·x when the hinge is active (:124-129); the worker's update is
+  Δw = w − w_init (:132-134).
+- ``local=False`` (mini-batch SGD): w stays frozen; the worker just sums raw
+  hinge subgradients x·y over the H draws (:124-127); all η scaling happens
+  driver-side (SGD.scala:44-50,57-59).
+
+Like local_sdca, the loop is sequential only in the ``local=True`` case, but
+both run as one fused ``lax.fori_loop`` for uniformity; the mini-batch case
+could be vmapped, which matters only when H is large and the hot algorithm is
+CoCoA anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cocoa_tpu.ops.rows import get_row, row_axpy, row_dot
+
+
+def local_sgd(
+    w_init: jax.Array,   # (d,)
+    shard: dict,         # labels, X | sp_indices+sp_values
+    idxs: jax.Array,     # (H,) int32
+    lam: float,
+    t_global,            # (t-1)*H*K, traced scalar (SGD.scala:53)
+    local: bool,
+):
+    """Returns this worker's delta_w."""
+    labels = shard["labels"]
+    dtype = w_init.dtype
+    lam_c = jnp.asarray(lam, dtype)
+    one = jnp.asarray(1.0, dtype)
+    zero = jnp.asarray(0.0, dtype)
+    t0 = jnp.asarray(t_global, dtype)
+
+    def step(i, carry):
+        w, dw = carry
+        # reference counts i from 1 (SGD.scala:104-106)
+        eta = one / (lam_c * (t0 + i + 1))
+        idx = idxs[i]
+        row = get_row(shard, idx)
+        y = labels[idx]
+        active = (one - y * row_dot(row, w)) > zero
+        if local:
+            # the reference also accumulates dw here but overwrites it with
+            # w - w_init each step (SGD.scala:132-134); only the final value
+            # matters, so the dead accumulation is skipped statically
+            w = w * (one - eta * lam_c)
+            w = row_axpy(row, jnp.where(active, y * eta, zero), w)
+        else:
+            dw = row_axpy(row, jnp.where(active, y, zero), dw)
+        return w, dw
+
+    dw0 = jnp.zeros_like(w_init)
+    w_final, dw = lax.fori_loop(0, idxs.shape[0], step, (w_init, dw0))
+    if local:
+        return w_final - w_init  # SGD.scala:132-134
+    return dw

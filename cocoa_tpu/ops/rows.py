@@ -1,0 +1,66 @@
+"""Row access over the two shard layouts (dense / padded-CSR).
+
+The sequential local solvers touch one example per step: a row gather, one or
+two dots against d-vectors, and a scaled row-axpy back into d-vectors
+(CoCoA.scala:157-185 shape).  These helpers give that per-row contract a
+layout-independent form:
+
+- dense: the row is a (d,) slice; dot is an O(d) dense dot; axpy is dense add.
+- sparse: the row is (max_nnz,) index/value arrays; dot is gather+reduce;
+  axpy is scatter-add.  Padded slots carry index 0 / value 0, so they
+  contribute exactly 0 to every dot and axpy — no masking needed.
+
+Layout choice is static (Python-level), so each jit specialization contains
+only its own code path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Row(NamedTuple):
+    """One example's features, in whichever layout the shard uses."""
+
+    dense: Optional[jax.Array] = None    # (d,)
+    idx: Optional[jax.Array] = None      # (max_nnz,) int32
+    val: Optional[jax.Array] = None      # (max_nnz,)
+
+
+def get_row(shard: dict, i) -> Row:
+    if "X" in shard:
+        return Row(dense=jax.lax.dynamic_index_in_dim(shard["X"], i, 0, keepdims=False))
+    return Row(
+        idx=jax.lax.dynamic_index_in_dim(shard["sp_indices"], i, 0, keepdims=False),
+        val=jax.lax.dynamic_index_in_dim(shard["sp_values"], i, 0, keepdims=False),
+    )
+
+
+def row_dot(row: Row, vec: jax.Array) -> jax.Array:
+    """x · vec."""
+    if row.dense is not None:
+        return row.dense @ vec
+    return vec[row.idx] @ row.val
+
+
+def row_axpy(row: Row, coef, vec: jax.Array) -> jax.Array:
+    """vec + coef * x."""
+    if row.dense is not None:
+        return vec + coef * row.dense
+    return vec.at[row.idx].add(coef * row.val)
+
+
+def shard_margins(w: jax.Array, shard: dict) -> jax.Array:
+    """x_i·w for every row of one shard at once, shape (n_shard,).
+
+    The batched counterpart of ``row_dot`` — on the dense layout a single
+    MXU matvec; on padded-CSR a gather + reduction (padded slots contribute
+    0).  Shared by the vectorized inner solver (ops/subgradient.py) and
+    evaluation (evals/objectives.py) so layout dispatch lives in one place.
+    """
+    if "X" in shard:
+        return shard["X"] @ w
+    return (w[shard["sp_indices"]] * shard["sp_values"]).sum(-1)

@@ -1,0 +1,42 @@
+"""Full subgradient pass over a shard — DistGD's inner step
+(reference: DistGD.scala:67-102).
+
+Unlike SDCA/SGD this has **no sequential dependency**: every example's
+subgradient is evaluated against the same frozen w.  That makes it the one
+inner solver that vectorizes perfectly — on TPU it is a single masked
+matvec pair (margins = X·w, Δw = Xᵀ·coef), which XLA tiles onto the MXU.
+The reference's off-by-one (`0 to nLocal` inclusive, DistGD.scala:82, reads
+one past the shard) is fixed here — deviation documented in SURVEY.md §2.4.
+
+Per-worker regularizer term −λ·w_init (DistGD.scala:98) is included, so the
+K-worker sum subtracts K·λ·w, matching the reference's aggregate exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cocoa_tpu.ops.rows import shard_margins
+
+
+def subgradient_pass(w_init: jax.Array, shard: dict, lam: float) -> jax.Array:
+    """Returns this worker's delta_w (DistGD.scala:82-98 semantics)."""
+    labels = shard["labels"]
+    dtype = w_init.dtype
+    one = jnp.asarray(1.0, dtype)
+    zero = jnp.asarray(0.0, dtype)
+
+    margins = shard_margins(w_init, shard)                 # (n_shard,)
+
+    # padded rows have label 0 ⇒ coef 0 ⇒ contribute nothing
+    coef = jnp.where(one - labels * margins > zero, labels, zero)
+
+    if "X" in shard:
+        dw = coef @ shard["X"]                             # Xᵀ·coef on the MXU
+    else:
+        flat_idx = shard["sp_indices"].reshape(-1)
+        flat_val = (shard["sp_values"] * coef[:, None]).reshape(-1)
+        dw = jnp.zeros_like(w_init).at[flat_idx].add(flat_val)
+
+    return dw - lam * w_init

@@ -1,0 +1,120 @@
+"""Shared outer-loop machinery for all solvers.
+
+Every algorithm's round has the same communication shape (the reference's
+``mapPartitions`` → ``reduce`` skeleton, CoCoA.scala:45-47):
+
+    fan out (w replicated, shard-local state pinned)
+    → per-shard local solver
+    → one O(d) sum-reduce of Δw
+    → replicated driver-side w update
+
+``fanout`` carries that shape on two execution paths with identical math:
+
+- **mesh path** (K devices): ``shard_map`` over the dp axis; the Δw reduce is
+  one ``lax.psum`` over ICI — the whole point of CoCoA's communication
+  efficiency maps to exactly one collective per round.
+- **local path** (mesh=None, e.g. a single TPU chip holding all K logical
+  shards): ``vmap`` over the leading shard axis + an in-device sum.  Same
+  numbers, no collective — used for single-chip benchmarking and as the
+  K-logical-shards-on-1-device analogue of the reference's ``local[4]`` mode.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from cocoa_tpu import checkpoint as ckpt_lib
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.parallel.fanout import fanout  # noqa: F401  (re-export)
+from cocoa_tpu.utils.logging import Trajectory
+from cocoa_tpu.utils.prng import sample_indices_per_shard
+
+
+def drive(
+    name: str,
+    params: Params,
+    debug: DebugParams,
+    state: tuple,
+    round_fn: Callable[[int, tuple], tuple],
+    eval_fn: Callable[[tuple], tuple],
+    quiet: bool = False,
+    gap_target: Optional[float] = None,
+    start_round: int = 1,
+):
+    """The outer driver loop shared by every solver (CoCoA.scala:39-63
+    skeleton): run rounds, gate evaluation to every ``debugIter`` rounds,
+    checkpoint every ``chkptIter`` rounds, optionally stop early on a
+    duality-gap target.
+
+    ``state`` is ``(w,)`` or ``(w, alpha)``; ``round_fn(t, state) -> state``;
+    ``eval_fn(state) -> (primal, gap_or_None, test_error_or_None)``.
+    Returns (state, Trajectory).
+    """
+    traj = Trajectory(name, quiet=quiet)
+    for t in range(start_round, params.num_rounds + 1):
+        state = round_fn(t, state)
+
+        if debug.debug_iter > 0 and t % debug.debug_iter == 0:
+            primal, gap, test_err = eval_fn(state)
+            traj.log_round(t, primal=primal, gap=gap, test_error=test_err)
+            if gap_target is not None and gap is not None and gap <= gap_target:
+                break
+
+        if debug.chkpt_dir and debug.chkpt_iter > 0 and t % debug.chkpt_iter == 0:
+            ckpt_lib.save(
+                debug.chkpt_dir, name, t, state[0],
+                state[1] if len(state) > 1 else None, seed=debug.seed,
+            )
+    return state, traj
+
+
+def check_shards(ds: ShardedDataset) -> None:
+    """Reject empty shards up front: the reference crashes inside the task
+    (``nextInt(0)``) when numSplits > rows; we fail with a clear message."""
+    if np.any(ds.counts <= 0):
+        raise ValueError(
+            f"every shard needs at least one example; shard sizes are "
+            f"{ds.counts.tolist()} (n={ds.n} over K={ds.k} shards) — "
+            f"lower numSplits"
+        )
+
+
+class IndexSampler:
+    """Per-round local-coordinate sampling, in one of two modes.
+
+    - ``reference``: host-side java.util.Random replay — identical draws to
+      the Scala code per (seed+t, n_local), correlated across equal-size
+      shards exactly as the reference is (CoCoA.scala:45,144).
+    - ``jax``: device-friendly ``jax.random`` folded per (seed, round, shard)
+      — decorrelated across shards (statistical improvement, not
+      reference-faithful).
+    """
+
+    def __init__(self, mode: str, seed: int, h: int, counts: np.ndarray):
+        if mode not in ("reference", "jax"):
+            raise ValueError(f"rng mode must be 'reference' or 'jax', got {mode!r}")
+        self.mode = mode
+        self.seed = seed
+        self.h = h
+        self.counts = np.asarray(counts)
+        self._key = None
+        if mode == "jax":
+            self._key = jax.random.key(seed)
+
+    def round_indices(self, t: int) -> jax.Array:
+        """(K, H) int32 index table for round t (1-based, as the reference)."""
+        if self.mode == "reference":
+            tab = sample_indices_per_shard(
+                self.seed, range(t, t + 1), self.h, self.counts
+            )[:, 0, :]
+            return jax.numpy.asarray(tab)
+        k = self.counts.shape[0]
+        key = jax.random.fold_in(self._key, t)
+        bounds = jax.numpy.asarray(self.counts, dtype=jax.numpy.int32)
+        return jax.random.randint(
+            key, (k, self.h), minval=0, maxval=bounds[:, None], dtype=jax.numpy.int32
+        )

@@ -1,0 +1,120 @@
+"""CoCoA / CoCoA+ outer driver (reference: CoCoA.scala:22-66).
+
+One outer round = one jitted step: fan out the replicated w, run H local
+SDCA coordinate steps per shard, psum the Δw, apply the scaling law —
+γ for CoCoA+ (additive) or β/K for CoCoA (averaging) (CoCoA.scala:37).
+The Python loop over rounds mirrors the reference's driver loop
+(CoCoA.scala:39); per-``debugIter`` evaluation is gated off the hot path
+exactly as the reference gates it (CoCoA.scala:51).
+
+State lives device-side across rounds: w replicated, alpha (K, n_shard)
+pinned per-shard — donated through the jitted step so XLA updates it in
+place in HBM (the analogue of ``preservesPartitioning=true`` RDD reuse).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.evals import objectives
+from cocoa_tpu.ops import local_sdca
+from cocoa_tpu.solvers import base
+
+
+def make_round_step(mesh, params: Params, k: int, plus: bool):
+    """Build the jitted (w, alpha, idxs, shard_arrays) -> (w', alpha') step."""
+    scaling = params.gamma if plus else params.beta / k
+    sigma = k * params.gamma  # sigma' in the CoCoA+ paper (CoCoA.scala:45)
+    mode = "plus" if plus else "cocoa"
+
+    def per_shard(w, alpha_k, idxs_k, shard_k):
+        da, dw = local_sdca(
+            w, alpha_k, shard_k, idxs_k, params.lam, params.n,
+            mode=mode, sigma=sigma,
+        )
+        alpha_new = alpha_k + scaling * da  # CoCoA.scala:101
+        return dw, alpha_new
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def round_step(w, alpha, idxs, shard_arrays):
+        dw_sum, alpha_new = base.fanout(
+            per_shard, mesh, w, alpha, idxs, shard_arrays
+        )
+        return w + scaling * dw_sum, alpha_new  # CoCoA.scala:47-48
+
+    return round_step
+
+
+def run_cocoa(
+    ds: ShardedDataset,
+    params: Params,
+    debug: DebugParams,
+    plus: bool,
+    mesh=None,
+    test_ds: Optional[ShardedDataset] = None,
+    rng: str = "reference",
+    w_init: Optional[jax.Array] = None,
+    alpha_init: Optional[jax.Array] = None,
+    start_round: int = 1,
+    quiet: bool = False,
+    gap_target: Optional[float] = None,
+):
+    """Train; returns (w, alpha, Trajectory).
+
+    Extensions over the reference: ``gap_target`` stops early once the
+    duality gap — checked at the ``debugIter`` cadence — falls below the
+    target (the baseline metric counts comm-rounds and wall-clock to reach
+    it); ``w_init``/``alpha_init``/``start_round`` resume from a checkpoint
+    (see cocoa_tpu.checkpoint) — round-indexed RNG makes the resumed
+    trajectory identical to an uninterrupted run.
+    """
+    base.check_shards(ds)
+    k = ds.k
+    alg = "CoCoA+" if plus else "CoCoA"
+    if not quiet:
+        print(f"\nRunning {alg} on {params.n} data examples, "
+              f"distributed over {k} workers")
+
+    dtype = ds.labels.dtype
+    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.asarray(w_init, dtype)
+    alpha = (
+        jnp.zeros((k, ds.n_shard), dtype=dtype)
+        if alpha_init is None
+        else jnp.asarray(alpha_init, dtype)
+    )
+    if mesh is not None:
+        from cocoa_tpu.parallel.mesh import replicated, sharded_rows
+
+        w = jax.device_put(w, replicated(mesh))
+        alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
+
+    sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
+    step = make_round_step(mesh, params, k, plus)
+    shard_arrays = ds.shard_arrays()
+
+    def round_fn(t, state):
+        w, alpha = state
+        return step(w, alpha, sampler.round_indices(t), shard_arrays)
+
+    def eval_fn(state):
+        w, alpha = state
+        primal = objectives.primal_objective(ds, w, params.lam)
+        gap = primal - objectives.dual_objective(ds, w, alpha, params.lam)
+        test_err = (
+            objectives.classification_error(test_ds, w)
+            if test_ds is not None
+            else None
+        )
+        return primal, gap, test_err
+
+    (w, alpha), traj = base.drive(
+        alg, params, debug, (w, alpha), round_fn, eval_fn,
+        quiet=quiet, gap_target=gap_target, start_round=start_round,
+    )
+    return w, alpha, traj

@@ -1,0 +1,87 @@
+"""Distributed (sub)gradient descent (reference: DistGD.scala).
+
+Per round: every worker takes one deterministic full pass over its shard
+(the one inner solver with no sequential dependency — a pure MXU matvec
+pair, see ops/subgradient.py), adds its −λ·w regularizer term, then the
+driver applies the gradient-direction-normalized step
+w += Δw·(η/‖Δw‖) with η = 1/(β·t) (DistGD.scala:35,40-41).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.evals import objectives
+from cocoa_tpu.ops import subgradient_pass
+from cocoa_tpu.solvers import base
+
+
+def make_round_step(mesh, params: Params, k: int):
+    lam = params.lam
+    beta = params.beta
+
+    def per_shard(w, shard_k):
+        return (subgradient_pass(w, shard_k, lam),)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def round_step(w, t, shard_arrays):
+        eta = 1.0 / (beta * t)  # DistGD.scala:35
+        (dw_sum,) = base.fanout(per_shard, mesh, w, shard_arrays)
+        norm = jnp.linalg.norm(dw_sum)  # DistGD.scala:40
+        return w + dw_sum * (eta / norm)  # DistGD.scala:41
+
+    return round_step
+
+
+def run_dist_gd(
+    ds: ShardedDataset,
+    params: Params,
+    debug: DebugParams,
+    mesh=None,
+    test_ds: Optional[ShardedDataset] = None,
+    w_init: Optional[jax.Array] = None,
+    start_round: int = 1,
+    quiet: bool = False,
+):
+    """Train; returns (w, Trajectory)."""
+    base.check_shards(ds)
+    k = ds.k
+    if not quiet:
+        print(f"\nRunning DistGD on {params.n} data examples, "
+              f"distributed over {k} workers")
+
+    dtype = ds.labels.dtype
+    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.asarray(w_init, dtype)
+    if mesh is not None:
+        from cocoa_tpu.parallel.mesh import replicated
+
+        w = jax.device_put(w, replicated(mesh))
+
+    step = make_round_step(mesh, params, k)
+    shard_arrays = ds.shard_arrays()
+
+    def round_fn(t, state):
+        (w,) = state
+        return (step(w, jnp.asarray(float(t), dtype=dtype), shard_arrays),)
+
+    def eval_fn(state):
+        (w,) = state
+        primal = objectives.primal_objective(ds, w, params.lam)
+        test_err = (
+            objectives.classification_error(test_ds, w)
+            if test_ds is not None
+            else None
+        )
+        return primal, None, test_err
+
+    (w,), traj = base.drive(
+        "Dist SGD", params, debug, (w,), round_fn, eval_fn,
+        quiet=quiet, start_round=start_round,
+    )
+    return w, traj

@@ -1,0 +1,107 @@
+"""Distributed SGD: local-SGD and mini-batch variants (reference: SGD.scala).
+
+- local=True (Local SGD): workers run H Pegasos steps on a private w; the
+  driver averages Δw = w_local − w_init with β/K (SGD.scala:34-37,55-56).
+- local=False (mini-batch SGD): the driver pre-scales w by (1 − ηλ) with
+  η = 1/(λt) (SGD.scala:44-50), workers sum raw hinge subgradients, and the
+  driver applies w += Δw·η·β/(K·H) (SGD.scala:38,57-59).
+
+No dual state → primal-objective-only trajectory (no duality-gap
+certificate), as in the reference (SGD.scala:62-66).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.evals import objectives
+from cocoa_tpu.ops import local_sgd
+from cocoa_tpu.solvers import base
+
+
+def make_round_step(mesh, params: Params, k: int, local: bool):
+    h = params.local_iters
+    lam = params.lam
+    scaling = params.beta / k if local else params.beta / (k * h)  # SGD.scala:34-39
+
+    def per_shard(w, idxs_k, t_global, shard_k):
+        return (local_sgd(w, shard_k, idxs_k, lam, t_global, local),)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def round_step(w, idxs, t, shard_arrays):
+        eta = 1.0 / (lam * t)  # SGD.scala:44
+        if not local:
+            w = w * (1.0 - eta * lam)  # driver-side pre-scale (SGD.scala:46-50)
+        t_global = (t - 1.0) * h * k  # SGD.scala:53
+        (dw_sum,) = base.fanout(
+            per_shard, mesh, w, idxs, _rep(t_global, k), shard_arrays
+        )
+        if local:
+            return w + dw_sum * scaling  # SGD.scala:55-56
+        return w + dw_sum * (eta * scaling)  # SGD.scala:57-59
+
+    return round_step
+
+
+def _rep(scalar, k):
+    """Broadcast a traced scalar to a (K,) sharded arg for fanout."""
+    return jnp.broadcast_to(scalar, (k,))
+
+
+def run_sgd(
+    ds: ShardedDataset,
+    params: Params,
+    debug: DebugParams,
+    local: bool,
+    mesh=None,
+    test_ds: Optional[ShardedDataset] = None,
+    rng: str = "reference",
+    w_init: Optional[jax.Array] = None,
+    start_round: int = 1,
+    quiet: bool = False,
+):
+    """Train; returns (w, Trajectory)."""
+    base.check_shards(ds)
+    k = ds.k
+    if not quiet:
+        print(f"\nRunning SGD (with local updates = {local}) on {params.n} "
+              f"data examples, distributed over {k} workers")
+
+    dtype = ds.labels.dtype
+    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.asarray(w_init, dtype)
+    if mesh is not None:
+        from cocoa_tpu.parallel.mesh import replicated
+
+        w = jax.device_put(w, replicated(mesh))
+
+    sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
+    step = make_round_step(mesh, params, k, local)
+    shard_arrays = ds.shard_arrays()
+    name = "Local SGD" if local else "Mini-batch SGD"
+
+    def round_fn(t, state):
+        (w,) = state
+        idxs = sampler.round_indices(t)
+        return (step(w, idxs, jnp.asarray(float(t), dtype=dtype), shard_arrays),)
+
+    def eval_fn(state):
+        (w,) = state
+        primal = objectives.primal_objective(ds, w, params.lam)
+        test_err = (
+            objectives.classification_error(test_ds, w)
+            if test_ds is not None
+            else None
+        )
+        return primal, None, test_err
+
+    (w,), traj = base.drive(
+        name, params, debug, (w,), round_fn, eval_fn,
+        quiet=quiet, start_round=start_round,
+    )
+    return w, traj

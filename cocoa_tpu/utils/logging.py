@@ -1,0 +1,76 @@
+"""Run trajectory logging and reference-style console output.
+
+The reference's observability is println-only: per-``debugIter`` lines
+(CoCoA.scala:51-56) and end-of-run summaries (OptUtils.scala:102-126).  We
+keep that exact console format (so trajectories are eyeball-comparable) and
+add what the baseline work actually needs (SURVEY.md §5-6): a structured
+per-round record (round, wall-clock, comm-rounds, primal, gap, test error)
+that can be dumped as JSONL — the benchmark artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    round: int
+    wall_time: float          # seconds since run start
+    primal: Optional[float] = None
+    gap: Optional[float] = None
+    test_error: Optional[float] = None
+
+
+class Trajectory:
+    """Collects per-round records; one comm-round == one outer round (the
+    baseline's #comm-rounds metric counts these)."""
+
+    def __init__(self, algorithm: str, quiet: bool = False):
+        self.algorithm = algorithm
+        self.records: list[RoundRecord] = []
+        self.quiet = quiet
+        self._t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def log_round(self, t, primal=None, gap=None, test_error=None):
+        self.records.append(
+            RoundRecord(
+                round=t,
+                wall_time=self.elapsed(),
+                primal=primal,
+                gap=gap,
+                test_error=test_error,
+            )
+        )
+        if not self.quiet:
+            # reference console format (CoCoA.scala:52-55)
+            print(f"Iteration: {t}")
+            if primal is not None:
+                print(f"primal objective: {primal}")
+            if gap is not None:
+                print(f"primal-dual gap: {gap}")
+            if test_error is not None:
+                print(f"test error: {test_error}")
+
+    def summary(self, primal, gap=None, test_error=None):
+        """End-of-run block (OptUtils.scala:102-126 format)."""
+        if self.quiet:
+            return
+        out = f"{self.algorithm} has finished running. Summary Stats: "
+        out += f"\n Total Objective Value: {primal}"
+        if gap is not None:
+            out += f"\n Duality Gap: {gap}"
+        if test_error is not None:
+            out += f"\n Test Error: {test_error}"
+        print(out + "\n")
+
+    def dump_jsonl(self, path: str):
+        with open(path, "w") as f:
+            for r in self.records:
+                f.write(json.dumps({"algorithm": self.algorithm, **dataclasses.asdict(r)}) + "\n")

@@ -1,0 +1,186 @@
+"""Literal NumPy oracle of the reference update rules.
+
+Transcribes the *math* of the Scala reference (with file:line citations) as
+plainly as possible — deliberately unvectorized and slow, so the production
+JAX kernels have an independent ground truth to match bit-closely in x64.
+
+All functions operate on dense numpy rows; padding/masking concerns of the
+device layouts do not exist here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def local_sdca(
+    X: np.ndarray,          # (n_local, d) dense rows of this shard
+    y: np.ndarray,          # (n_local,) labels in {-1, +1}
+    w_init: np.ndarray,     # (d,) shared primal vector
+    alpha: np.ndarray,      # (n_local,) local dual variables (copied, not mutated)
+    idxs: np.ndarray,       # (H,) sampled coordinates for this round
+    lam: float,
+    n: int,                 # GLOBAL example count (primal-dual correspondence)
+    plus: bool,
+    sigma: float,           # sigma' = K * gamma (CoCoA.scala:45)
+):
+    """Reference localSDCA (CoCoA.scala:130-192). Returns (delta_alpha, delta_w)."""
+    w = w_init.copy()
+    alpha = alpha.copy()
+    alpha_old = alpha.copy()
+    delta_w = np.zeros_like(w_init)
+    lam_n = lam * n
+
+    for idx in idxs:
+        x = X[idx]
+        yy = y[idx]
+        # hinge-loss gradient (CoCoA.scala:157-163)
+        if plus:
+            grad = (yy * (x @ w + sigma * (x @ delta_w)) - 1.0) * lam_n
+        else:
+            grad = (yy * (x @ w) - 1.0) * lam_n
+        # projection onto the box-constraint active set (CoCoA.scala:166-170)
+        proj_grad = grad
+        if alpha[idx] <= 0.0:
+            proj_grad = min(grad, 0.0)
+        elif alpha[idx] >= 1.0:
+            proj_grad = max(grad, 0.0)
+        if abs(proj_grad) != 0.0:
+            xnorm2 = float(x @ x)
+            qii = xnorm2 * sigma if plus else xnorm2  # CoCoA.scala:173-174
+            new_alpha = 1.0
+            if qii != 0.0:
+                new_alpha = min(max(alpha[idx] - grad / qii, 0.0), 1.0)
+            update = x * (yy * (new_alpha - alpha[idx]) / lam_n)  # :181
+            if not plus:
+                w = w + update               # local view advances (:182-184)
+            delta_w = delta_w + update       # :185
+            alpha[idx] = new_alpha           # :186
+    return alpha - alpha_old, delta_w
+
+
+def minibatch_cd_partition(
+    X, y, w_init, alpha, idxs, lam, n, scaling
+):
+    """Reference MinibatchCD.partitionUpdate (MinibatchCD.scala:76-132).
+
+    Like localSDCA but the gradient always reads the frozen w (:104) and the
+    local w never advances; alpha *does* advance within the batch (:123).
+    Returns (delta_w, alpha_scaled) where alpha_scaled = alpha_old +
+    scaling * delta_alpha (:127-128).
+    """
+    alpha = alpha.copy()
+    alpha_old = alpha.copy()
+    delta_w = np.zeros_like(w_init)
+    lam_n = lam * n
+    for idx in idxs:
+        x = X[idx]
+        yy = y[idx]
+        grad = (yy * (x @ w_init) - 1.0) * lam_n
+        proj_grad = grad
+        if alpha[idx] <= 0.0:
+            proj_grad = min(grad, 0.0)
+        elif alpha[idx] >= 1.0:
+            proj_grad = max(grad, 0.0)
+        if abs(proj_grad) != 0.0:
+            qii = float(x @ x)
+            new_alpha = 1.0
+            if qii != 0.0:
+                new_alpha = min(max(alpha[idx] - grad / qii, 0.0), 1.0)
+            delta_w = delta_w + x * (yy * (new_alpha - alpha[idx]) / lam_n)
+            alpha[idx] = new_alpha
+    return delta_w, alpha_old + scaling * (alpha - alpha_old)
+
+
+def sgd_partition(X, y, w_init, idxs, lam, t_global, local):
+    """Reference SGD.partitionUpdate (SGD.scala:87-139).
+
+    local=True: Pegasos-style steps on a private w copy, eta = 1/(lam*(t+i)),
+    returns w - w_init (:117-134).  local=False: sum of raw hinge
+    subgradients x*y over the draws (:124-127).
+    """
+    w = w_init.copy()
+    delta_w = np.zeros_like(w_init)
+    for i, idx in enumerate(idxs, start=1):
+        step = 1.0 / (lam * (t_global + i))
+        x = X[idx]
+        yy = y[idx]
+        evaluation = 1.0 - yy * (x @ w)
+        if local:
+            w = w * (1.0 - step * lam)
+        if evaluation > 0:
+            delta_w = delta_w + x * yy
+            if local:
+                w = w + x * (yy * step)
+        if local:
+            delta_w = w - w_init
+    return delta_w
+
+
+def dist_gd_partition(X, y, w_init, lam, include_oob_bug: bool = False):
+    """Reference DistGD.partitionUpdate (DistGD.scala:67-102).
+
+    Deterministic pass over the shard accumulating active-hinge subgradients,
+    then the per-worker regularizer term -lam*w_init (:98).  The reference's
+    inclusive loop bound (`0 to nLocal`, :82) reads one element past the end —
+    we fix that (SURVEY.md reference bug #1); ``include_oob_bug`` exists only
+    to document the deviation, not to reproduce a JVM crash.
+    """
+    if include_oob_bug:
+        raise NotImplementedError("the out-of-bounds read is a reference bug")
+    delta_w = np.zeros_like(w_init)
+    for i in range(X.shape[0]):
+        x = X[i]
+        yy = y[i]
+        if 1.0 - yy * (x @ w_init) > 0:
+            delta_w = delta_w + x * yy
+    return delta_w - lam * w_init
+
+
+# ---- objectives (OptUtils.scala:57-98) ----
+
+def hinge_loss(X, y, w):
+    return np.maximum(1.0 - y * (X @ w), 0.0)
+
+
+def primal_objective(X, y, w, lam):
+    return hinge_loss(X, y, w).mean() + 0.5 * lam * float(w @ w)
+
+
+def dual_objective(w, alpha_total_sum, n, lam):
+    return -0.5 * lam * float(w @ w) + alpha_total_sum / n
+
+
+def duality_gap(X, y, w, alpha_total_sum, lam):
+    return primal_objective(X, y, w, lam) - dual_objective(
+        w, alpha_total_sum, X.shape[0], lam
+    )
+
+
+def classification_error(X, y, w):
+    return float(np.mean((X @ w) * y <= 0))
+
+
+# ---- outer loops (driver-side math only) ----
+
+def cocoa_outer(
+    shards,              # list of (X_k, y_k) per shard
+    w0, lam, n, num_rounds, h, beta, gamma, seed, plus,
+    sample_fn,           # (seed, t, n_local) -> (H,) idx array
+):
+    """Reference runCoCoA (CoCoA.scala:22-66): per-round local SDCA on every
+    shard, sum-reduce delta_w, w += scaling * sum, alpha_k += scaling * da_k."""
+    k = len(shards)
+    scaling = gamma if plus else beta / k
+    sigma = k * gamma
+    w = w0.copy()
+    alphas = [np.zeros(Xk.shape[0]) for Xk, _ in shards]
+    for t in range(1, num_rounds + 1):
+        dw_sum = np.zeros_like(w)
+        for s, (Xk, yk) in enumerate(shards):
+            idxs = sample_fn(seed, t, Xk.shape[0])
+            da, dw = local_sdca(Xk, yk, w, alphas[s], idxs, lam, n, plus, sigma)
+            alphas[s] = alphas[s] + scaling * da
+            dw_sum += dw
+        w = w + scaling * dw_sum
+    return w, alphas

@@ -1,0 +1,247 @@
+"""Outer-loop solver tests: oracle trajectory parity, shard_map-vs-vmap path
+equality, primal-dual correspondence, convergence properties."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracle
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import shard_dataset, split_sizes
+from cocoa_tpu.parallel import make_mesh
+from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
+from cocoa_tpu.utils.prng import sample_indices
+
+
+def _params(tiny_data, **kw):
+    defaults = dict(n=tiny_data.n, num_rounds=5, local_iters=20, lam=0.01,
+                    beta=1.0, gamma=1.0)
+    defaults.update(kw)
+    return Params(**defaults)
+
+
+def _debug(**kw):
+    defaults = dict(debug_iter=-1, seed=0, chkpt_iter=10**9, chkpt_dir="")
+    defaults.update(kw)
+    return DebugParams(**defaults)
+
+
+def _oracle_shards(tiny_data, k):
+    X = tiny_data.to_dense()
+    y = tiny_data.labels
+    sizes = split_sizes(tiny_data.n, k)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    return [(X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(k)]
+
+
+def _sample_fn(seed, t, n_local):
+    return sample_indices(seed, range(t, t + 1), 20, n_local)[0]
+
+
+@pytest.mark.parametrize("plus", [True, False])
+@pytest.mark.parametrize("layout", ["dense", "sparse"])
+def test_cocoa_outer_matches_oracle(tiny_data, plus, layout):
+    """Full T-round CoCoA trajectory == literal oracle, K=4, matched RNG."""
+    k = 4
+    ds = shard_dataset(tiny_data, k=k, layout=layout, dtype=jnp.float64)
+    p = _params(tiny_data)
+    w, alpha, _ = run_cocoa(ds, p, _debug(), plus=plus, quiet=True)
+    w_o, alphas_o = oracle.cocoa_outer(
+        _oracle_shards(tiny_data, k), np.zeros(tiny_data.num_features),
+        p.lam, p.n, p.num_rounds, p.local_iters, p.beta, p.gamma, 0, plus,
+        _sample_fn,
+    )
+    np.testing.assert_allclose(np.asarray(w), w_o, atol=1e-12)
+    for s in range(k):
+        np.testing.assert_allclose(
+            np.asarray(alpha[s, : len(alphas_o[s])]), alphas_o[s], atol=1e-12
+        )
+
+
+@pytest.mark.parametrize("plus", [True, False])
+def test_mesh_path_equals_local_path(tiny_data, plus):
+    """shard_map over 4 real devices == vmap on one device, bit-close."""
+    k = 4
+    p = _params(tiny_data)
+    mesh = make_mesh(k)
+    ds_m = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64, mesh=mesh)
+    ds_l = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64)
+    w_m, a_m, _ = run_cocoa(ds_m, p, _debug(), plus=plus, mesh=mesh, quiet=True)
+    w_l, a_l, _ = run_cocoa(ds_l, p, _debug(), plus=plus, quiet=True)
+    np.testing.assert_allclose(np.asarray(w_m), np.asarray(w_l), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a_m), np.asarray(a_l), atol=1e-12)
+
+
+@pytest.mark.parametrize("plus", [True, False])
+def test_primal_dual_correspondence(tiny_data, plus):
+    """Invariant: w == (1/λn)·Σ yᵢαᵢxᵢ after every run (implied by
+    CoCoA.scala:181 — both sides scale by the same factor)."""
+    k = 4
+    ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=3)
+    w, alpha, _ = run_cocoa(ds, p, _debug(), plus=plus, quiet=True)
+    X = tiny_data.to_dense()
+    y = tiny_data.labels
+    sizes = split_sizes(tiny_data.n, k)
+    alpha_flat = np.concatenate(
+        [np.asarray(alpha[s, : sizes[s]]) for s in range(k)]
+    )
+    w_expect = (y * alpha_flat) @ X / (p.lam * p.n)
+    np.testing.assert_allclose(np.asarray(w), w_expect, atol=1e-10)
+
+
+def test_duality_gap_decreases_and_nonneg(tiny_data):
+    from cocoa_tpu.evals import objectives
+
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=40, local_iters=30, lam=0.01)
+    w, alpha, traj = run_cocoa(
+        ds, p, _debug(debug_iter=10), plus=True, quiet=True
+    )
+    gaps = [r.gap for r in traj.records]
+    assert len(gaps) == 4
+    assert all(g >= -1e-12 for g in gaps)
+    assert gaps[-1] < gaps[0]
+    # alpha in the box
+    a = np.asarray(alpha)
+    assert a.min() >= -1e-15 and a.max() <= 1 + 1e-15
+
+
+def test_minibatch_cd_matches_oracle(tiny_data):
+    k = 4
+    ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=4)
+    w, alpha, _ = run_minibatch_cd(ds, p, _debug(), quiet=True)
+
+    # oracle outer loop for MbCD (MinibatchCD.scala:34-58)
+    scaling = p.beta / (k * p.local_iters)
+    w_o = np.zeros(tiny_data.num_features)
+    shards = _oracle_shards(tiny_data, k)
+    alphas_o = [np.zeros(Xk.shape[0]) for Xk, _ in shards]
+    for t in range(1, p.num_rounds + 1):
+        dw_sum = np.zeros_like(w_o)
+        for s, (Xk, yk) in enumerate(shards):
+            idxs = _sample_fn(0, t, Xk.shape[0])
+            dw, a_new = oracle.minibatch_cd_partition(
+                Xk, yk, w_o, alphas_o[s], idxs, p.lam, p.n, scaling
+            )
+            alphas_o[s] = a_new
+            dw_sum += dw
+        w_o = w_o + dw_sum * scaling
+    np.testing.assert_allclose(np.asarray(w), w_o, atol=1e-12)
+
+
+@pytest.mark.parametrize("local", [True, False])
+def test_sgd_matches_oracle(tiny_data, local):
+    k = 4
+    ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=4)
+    w, _ = run_sgd(ds, p, _debug(), local=local, quiet=True)
+
+    # oracle outer loop (SGD.scala:41-67)
+    scaling = p.beta / k if local else p.beta / (k * p.local_iters)
+    w_o = np.zeros(tiny_data.num_features)
+    shards = _oracle_shards(tiny_data, k)
+    for t in range(1, p.num_rounds + 1):
+        eta = 1.0 / (p.lam * t)
+        if not local:
+            w_o = w_o * (1.0 - eta * p.lam)
+        t_global = (t - 1) * p.local_iters * k
+        dw_sum = np.zeros_like(w_o)
+        for Xk, yk in shards:
+            idxs = _sample_fn(0, t, Xk.shape[0])
+            dw_sum += oracle.sgd_partition(Xk, yk, w_o, idxs, p.lam, t_global, local)
+        w_o = w_o + dw_sum * (scaling if local else eta * scaling)
+    np.testing.assert_allclose(np.asarray(w), w_o, atol=1e-12)
+
+
+def test_dist_gd_matches_oracle(tiny_data):
+    k = 4
+    ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=4, beta=1.0)
+    w, _ = run_dist_gd(ds, p, _debug(), quiet=True)
+
+    w_o = np.zeros(tiny_data.num_features)
+    shards = _oracle_shards(tiny_data, k)
+    for t in range(1, p.num_rounds + 1):
+        eta = 1.0 / (p.beta * t)
+        dw_sum = np.zeros_like(w_o)
+        for Xk, yk in shards:
+            dw_sum += oracle.dist_gd_partition(Xk, yk, w_o, p.lam)
+        w_o = w_o + dw_sum * (eta / np.linalg.norm(dw_sum))
+    np.testing.assert_allclose(np.asarray(w), w_o, atol=1e-12)
+
+
+def test_evals_match_oracle(tiny_data):
+    from cocoa_tpu.evals import objectives
+
+    ds = shard_dataset(tiny_data, k=3, layout="sparse", dtype=jnp.float64)
+    X, y = tiny_data.to_dense(), tiny_data.labels
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=tiny_data.num_features))
+    lam = 0.01
+    assert objectives.primal_objective(ds, w, lam) == pytest.approx(
+        oracle.primal_objective(X, y, np.asarray(w), lam), rel=1e-12
+    )
+    assert objectives.classification_error(ds, w) == pytest.approx(
+        oracle.classification_error(X, y, np.asarray(w)), rel=1e-12
+    )
+    alpha = jnp.asarray(rng.random((3, ds.n_shard)))
+    masked_sum = float(np.sum(np.asarray(alpha) * np.asarray(ds.mask)))
+    assert objectives.dual_objective(ds, w, alpha, lam) == pytest.approx(
+        oracle.dual_objective(np.asarray(w), masked_sum, tiny_data.n, lam),
+        rel=1e-12,
+    )
+
+
+def test_gap_target_early_stop(tiny_data):
+    ds = shard_dataset(tiny_data, k=2, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=200, local_iters=50)
+    w, alpha, traj = run_cocoa(
+        ds, p, _debug(debug_iter=5), plus=True, quiet=True, gap_target=1e-3
+    )
+    assert traj.records[-1].gap <= 1e-3
+    assert traj.records[-1].round < 200
+
+
+def test_checkpoint_roundtrip(tiny_data, tmp_path):
+    from cocoa_tpu import checkpoint as ck
+
+    ds = shard_dataset(tiny_data, k=2, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=4)
+    d = _debug(chkpt_iter=2, chkpt_dir=str(tmp_path))
+    w, alpha, _ = run_cocoa(ds, p, d, plus=True, quiet=True)
+    path = ck.latest(str(tmp_path), "CoCoA+")
+    assert path is not None and path.endswith("r000004.npz")
+    meta, w_l, a_l = ck.load(path)
+    assert meta["round"] == 4
+    np.testing.assert_allclose(w_l, np.asarray(w), atol=0)
+    np.testing.assert_allclose(a_l, np.asarray(alpha), atol=0)
+
+
+def test_resume_equals_uninterrupted(tiny_data, tmp_path):
+    """Checkpoint at round 5, resume to 10 → bit-identical to a straight
+    10-round run (round-indexed RNG makes rounds independent of history)."""
+    from cocoa_tpu import checkpoint as ck
+
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=10)
+    w_full, a_full, _ = run_cocoa(ds, p, _debug(), plus=True, quiet=True)
+
+    d = _debug(chkpt_iter=5, chkpt_dir=str(tmp_path))
+    p5 = _params(tiny_data, num_rounds=5)
+    run_cocoa(ds, p5, d, plus=True, quiet=True)
+    meta, w0, a0 = ck.load(ck.latest(str(tmp_path), "CoCoA+"))
+    assert meta["round"] == 5
+    w_res, a_res, _ = run_cocoa(
+        ds, p, _debug(), plus=True, quiet=True,
+        w_init=w0, alpha_init=a0, start_round=6,
+    )
+    np.testing.assert_allclose(np.asarray(w_res), np.asarray(w_full), atol=0)
+    np.testing.assert_allclose(np.asarray(a_res), np.asarray(a_full), atol=0)
+
+
+def test_empty_shard_rejected(tiny_data):
+    ds = shard_dataset(tiny_data, k=97, layout="dense", dtype=jnp.float64)
+    with pytest.raises(ValueError, match="lower numSplits"):
+        run_cocoa(ds, _params(tiny_data), _debug(), plus=True, quiet=True)
