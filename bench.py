@@ -1,0 +1,127 @@
+"""Benchmark: wall-clock to a 1e-4 duality gap, CoCoA+ on the reference demo
+config (data/small_train.dat, K=4, H=50, λ=1e-3 — run-demo-local.sh:2-9).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": speedup}
+
+``vs_baseline`` is the speedup over the reference implementation proxy: the
+same algorithm, same RNG, same convergence criterion executed by the literal
+NumPy oracle of the Scala update rules (tests/oracle.py).  The actual Spark
+reference cannot run in this environment (sbt needs the network); the oracle
+executes the identical per-step math single-threaded, which flatters the
+reference if anything (no JVM/Spark scheduling overhead).  The oracle time is
+measured once and pinned here (same machine class, see BASELINE.md); set
+COCOA_BENCH_BASELINE=measure to re-measure it live.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# Pinned oracle wall-clock for this config (measured on this machine; see
+# module docstring).  Re-measure with COCOA_BENCH_BASELINE=measure.
+ORACLE_BASELINE_S = None  # filled after first measurement; None = measure live
+
+GAP_TARGET = 1e-4
+MAX_ROUNDS = 600  # the demo config crosses 1e-4 around round ~440
+DEBUG_ITER = 10
+LAM = 1e-3
+K = 4
+H = 50
+TRAIN = "/root/reference/data/small_train.dat"
+D = 9947
+
+
+def run_tpu() -> tuple[float, int]:
+    """Returns (seconds, comm_rounds) to reach GAP_TARGET."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data import load_libsvm, shard_dataset
+    from cocoa_tpu.solvers import run_cocoa
+
+    data = load_libsvm(TRAIN, D)
+    ds = shard_dataset(data, k=K, layout="sparse", dtype=jnp.float32)
+    params = Params(n=data.n, num_rounds=MAX_ROUNDS, local_iters=H, lam=LAM)
+    debug = DebugParams(debug_iter=DEBUG_ITER, seed=0)
+
+    # warm-up: compile the chunked scan step + eval out of the timed region
+    warm = Params(n=data.n, num_rounds=DEBUG_ITER, local_iters=H, lam=LAM)
+    run_cocoa(ds, warm, DebugParams(debug_iter=DEBUG_ITER, seed=0), plus=True,
+              quiet=True, scan_chunk=DEBUG_ITER)
+
+    t0 = time.perf_counter()
+    w, alpha, traj = run_cocoa(
+        ds, params, debug, plus=True, quiet=True, gap_target=GAP_TARGET,
+        scan_chunk=DEBUG_ITER,
+    )
+    elapsed = time.perf_counter() - t0
+    last = traj.records[-1]
+    if last.gap is None or last.gap > GAP_TARGET:
+        raise RuntimeError(
+            f"did not reach gap {GAP_TARGET} within {MAX_ROUNDS} rounds "
+            f"(last gap {last.gap})"
+        )
+    return elapsed, last.round
+
+
+def run_oracle_baseline() -> float:
+    """The reference-math proxy, timed to the same convergence criterion."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
+    import oracle
+    from cocoa_tpu.data import load_libsvm
+    from cocoa_tpu.data.sharding import split_sizes
+    from cocoa_tpu.utils.prng import sample_indices
+
+    data = load_libsvm(TRAIN, D)
+    X, y = data.to_dense(), data.labels
+    sizes = split_sizes(data.n, K)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    shards = [(X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(K)]
+
+    t0 = time.perf_counter()
+    w = np.zeros(D)
+    alphas = [np.zeros(Xk.shape[0]) for Xk, _ in shards]
+    sigma = float(K)  # gamma = 1
+    for t in range(1, MAX_ROUNDS + 1):
+        dw_sum = np.zeros_like(w)
+        for s, (Xk, yk) in enumerate(shards):
+            idxs = sample_indices(0, range(t, t + 1), H, Xk.shape[0])[0]
+            da, dw = oracle.local_sdca(
+                Xk, yk, w, alphas[s], idxs, LAM, data.n, True, sigma
+            )
+            alphas[s] = alphas[s] + da  # gamma = 1
+            dw_sum += dw
+        w = w + dw_sum  # gamma = 1
+        if t % DEBUG_ITER == 0:
+            total_alpha = float(sum(a.sum() for a in alphas))
+            gap = oracle.duality_gap(X, y, w, total_alpha, LAM)
+            if gap <= GAP_TARGET:
+                break
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    mode = os.environ.get("COCOA_BENCH_BASELINE", "")
+    elapsed, rounds = run_tpu()
+    if ORACLE_BASELINE_S is not None and mode != "measure":
+        baseline = ORACLE_BASELINE_S
+    else:
+        baseline = run_oracle_baseline()
+    print(json.dumps({
+        "metric": "wallclock_to_1e-4_duality_gap (CoCoA+ demo config, "
+                  f"{rounds} comm-rounds)",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(baseline / elapsed, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

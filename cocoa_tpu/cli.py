@@ -28,7 +28,7 @@ from cocoa_tpu.parallel import make_mesh
 from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
 
 _TPU_FLAGS = ("dtype", "layout", "rng")       # map to same-named RunConfig fields
-_EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume")  # run-level, not in RunConfig
+_EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume", "scanChunk")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -123,6 +123,7 @@ def main(argv=None) -> int:
     params = cfg.to_params(n, k)
     debug = cfg.to_debug()
     gap_target = float(extras["gapTarget"]) if extras["gapTarget"] else None
+    cfg.scan_chunk = int(extras["scanChunk"]) if extras["scanChunk"] else cfg.scan_chunk
     resume = extras["resume"] is not None and str(extras["resume"]).lower() != "false"
     if resume and not cfg.chkpt_dir:
         print("error: --resume requires --chkptDir", file=sys.stderr)
@@ -164,11 +165,13 @@ def main(argv=None) -> int:
     common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng)
 
     w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
-                               gap_target=gap_target, **restore("CoCoA+"), **common)
+                               gap_target=gap_target, scan_chunk=cfg.scan_chunk,
+                               **restore("CoCoA+"), **common)
     finish(traj, w, alpha)
 
     w, alpha, traj = run_cocoa(ds, params, debug, plus=False,
-                               gap_target=gap_target, **restore("CoCoA"), **common)
+                               gap_target=gap_target, scan_chunk=cfg.scan_chunk,
+                               **restore("CoCoA"), **common)
     finish(traj, w, alpha)
 
     if not cfg.just_cocoa:  # hingeDriver.scala:93-110

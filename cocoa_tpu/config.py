@@ -67,7 +67,8 @@ class RunConfig:
                                  #   all shards per round (CoCoA.scala:45,144);
                                  # "jax": jax PRNG folded per (round, shard) —
                                  #   decorrelated across shards (improvement)
-    scan_rounds: bool = False    # run the T-round loop as one device-side lax.scan
+    scan_chunk: int = 0          # >0: run rounds device-side in lax.scan blocks
+                                 # of this size (one dispatch per block)
     mesh_shape: Optional[tuple] = None  # (dp,) or (dp, fp); None = (num_splits,)
     loss: str = "hinge"
 

@@ -73,6 +73,73 @@ def fanout(
     return (red.sum(axis=0), *aux)
 
 
+def invariant_from_varying(x):
+    """Recover a replicated (invariant) value from a device-varying one that
+    is numerically identical on every device — exactly, via a masked psum
+    that selects device 0's copy (no division, so bit-exact for any K)."""
+    idx = lax.axis_index(DP_AXIS)
+    import jax.numpy as jnp
+
+    return lax.psum(jnp.where(idx == 0, x, jnp.zeros_like(x)), DP_AXIS)
+
+
+def chunk_fanout(
+    mesh: Optional[Mesh],
+    per_round: Callable,
+    apply_fn: Callable,
+    w: jax.Array,
+    carry_sharded,      # pytree, leaves (K, ...): shard-local carry (e.g. alpha)
+    xs_sharded,         # pytree, leaves (C, K, ...): per-round per-shard inputs
+    static_sharded,     # pytree, leaves (K, ...): shard data (not scanned)
+):
+    """Run C rounds device-side as one ``lax.scan`` (one dispatch per chunk).
+
+    ``per_round(w, carry_k, x_k, static_k) -> (dw, carry_k')`` is one outer
+    round seen from a single shard, returning its *unreduced* Δw;
+    ``apply_fn(w, dw_sum) -> w'`` is the replicated driver-side update.
+    Returns (w_final, carry_final) with the same placement semantics as
+    ``fanout`` (w replicated, carry keeping its leading K dim).
+    """
+    if mesh is not None:
+        def wrapped(w, carry, xs, static):
+            w = _to_varying(w)
+            carry = jax.tree.map(lambda a: a[0], carry)
+            xs = jax.tree.map(lambda a: a[:, 0], xs)        # (C, 1, ...) → (C, ...)
+            static = jax.tree.map(lambda a: a[0], static)
+
+            def body(c, x):
+                w, carry_k = c
+                dw, carry2 = per_round(w, carry_k, x, static)
+                w2 = apply_fn(w, lax.psum(dw, DP_AXIS))
+                return (w2, carry2), None
+
+            (w, carry), _ = lax.scan(body, (w, carry), xs)
+            w_inv = invariant_from_varying(w)
+            return w_inv, jax.tree.map(lambda a: a[None], carry)
+
+        in_specs = (
+            P(),
+            jax.tree.map(lambda _: P(DP_AXIS), carry_sharded),
+            jax.tree.map(lambda _: P(None, DP_AXIS), xs_sharded),
+            jax.tree.map(lambda _: P(DP_AXIS), static_sharded),
+        )
+        out_specs = (P(), jax.tree.map(lambda _: P(DP_AXIS), carry_sharded))
+        return jax.shard_map(
+            wrapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )(w, carry_sharded, xs_sharded, static_sharded)
+
+    # local path: scan over rounds; per round, vmap over shards + in-device sum
+    def body(c, x):
+        w, carry = c
+        dw, carry2 = jax.vmap(per_round, in_axes=(None, 0, 0, 0))(
+            w, carry, x, static_sharded
+        )
+        return (apply_fn(w, dw.sum(axis=0)), carry2), None
+
+    (w, carry), _ = lax.scan(body, (w, carry_sharded), xs_sharded)
+    return w, carry
+
+
 def mesh_of(*arrays) -> Optional[Mesh]:
     """Infer the dp mesh from array placement (None ⇒ local/vmap path).
 

@@ -72,6 +72,58 @@ def drive(
     return state, traj
 
 
+def drive_chunked(
+    name: str,
+    params: Params,
+    debug: DebugParams,
+    state: tuple,
+    chunk_fn: Callable[[int, int, tuple], tuple],
+    eval_fn: Callable[[tuple], tuple],
+    quiet: bool = False,
+    gap_target: Optional[float] = None,
+    start_round: int = 1,
+    chunk: int = 50,
+):
+    """Chunked variant of :func:`drive`: rounds run device-side in blocks of
+    up to ``chunk`` via ``lax.scan`` (one dispatch per block instead of one
+    per round), with blocks aligned to the ``debugIter`` evaluation cadence
+    so the observable trajectory is identical to the per-round driver.
+
+    ``chunk_fn(t0, c, state) -> state`` advances rounds t0..t0+c-1.
+    """
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    traj = Trajectory(name, quiet=quiet)
+    t = start_round
+    total = params.num_rounds
+    ckpt_on = bool(debug.chkpt_dir) and debug.chkpt_iter > 0
+    while t <= total:
+        # advance to the next eval/checkpoint boundary (or ``chunk`` rounds,
+        # whichever is nearest) so observable behavior matches the per-round
+        # driver and same-size blocks share one compiled executable
+        end = min(total, t + chunk - 1)
+        if debug.debug_iter > 0:
+            end = min(end, ((t - 1) // debug.debug_iter + 1) * debug.debug_iter)
+        if ckpt_on:
+            end = min(end, ((t - 1) // debug.chkpt_iter + 1) * debug.chkpt_iter)
+        c = end - t + 1
+        state = chunk_fn(t, c, state)
+        t = end + 1
+
+        if debug.debug_iter > 0 and end % debug.debug_iter == 0:
+            primal, gap, test_err = eval_fn(state)
+            traj.log_round(end, primal=primal, gap=gap, test_error=test_err)
+            if gap_target is not None and gap is not None and gap <= gap_target:
+                break
+
+        if ckpt_on and end % debug.chkpt_iter == 0:
+            ckpt_lib.save(
+                debug.chkpt_dir, name, end, state[0],
+                state[1] if len(state) > 1 else None, seed=debug.seed,
+            )
+    return state, traj
+
+
 def check_shards(ds: ShardedDataset) -> None:
     """Reject empty shards up front: the reference crashes inside the task
     (``nextInt(0)``) when numSplits > rows; we fail with a clear message."""
@@ -107,14 +159,25 @@ class IndexSampler:
 
     def round_indices(self, t: int) -> jax.Array:
         """(K, H) int32 index table for round t (1-based, as the reference)."""
+        return self.chunk_indices(t, 1)[0]
+
+    def chunk_indices(self, t0: int, c: int) -> jax.Array:
+        """(C, K, H) int32 tables for rounds t0..t0+c-1 (device-side scan
+        consumes one (K, H) slice per round)."""
+        import jax.numpy as jnp
+
         if self.mode == "reference":
             tab = sample_indices_per_shard(
-                self.seed, range(t, t + 1), self.h, self.counts
-            )[:, 0, :]
-            return jax.numpy.asarray(tab)
+                self.seed, range(t0, t0 + c), self.h, self.counts
+            )  # (K, C, H)
+            return jnp.asarray(np.swapaxes(tab, 0, 1))
         k = self.counts.shape[0]
-        key = jax.random.fold_in(self._key, t)
-        bounds = jax.numpy.asarray(self.counts, dtype=jax.numpy.int32)
-        return jax.random.randint(
-            key, (k, self.h), minval=0, maxval=bounds[:, None], dtype=jax.numpy.int32
-        )
+        bounds = jnp.asarray(self.counts, dtype=jnp.int32)
+        keys = [jax.random.fold_in(self._key, t) for t in range(t0, t0 + c)]
+        return jnp.stack([
+            jax.random.randint(
+                key, (k, self.h), minval=0, maxval=bounds[:, None],
+                dtype=jnp.int32,
+            )
+            for key in keys
+        ])

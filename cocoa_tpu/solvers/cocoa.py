@@ -27,10 +27,14 @@ from cocoa_tpu.ops import local_sdca
 from cocoa_tpu.solvers import base
 
 
-def make_round_step(mesh, params: Params, k: int, plus: bool):
-    """Build the jitted (w, alpha, idxs, shard_arrays) -> (w', alpha') step."""
+def _cocoa_round_parts(params: Params, k: int, plus: bool):
+    """The per-shard local update and driver-side apply shared by the
+    per-round and chunked builders (so the two paths cannot diverge).
+
+    scaling law: γ (CoCoA+, additive) | β/K (CoCoA, averaging) —
+    CoCoA.scala:37; σ′ = K·γ (CoCoA.scala:45)."""
     scaling = params.gamma if plus else params.beta / k
-    sigma = k * params.gamma  # sigma' in the CoCoA+ paper (CoCoA.scala:45)
+    sigma = k * params.gamma
     mode = "plus" if plus else "cocoa"
 
     def per_shard(w, alpha_k, idxs_k, shard_k):
@@ -38,17 +42,43 @@ def make_round_step(mesh, params: Params, k: int, plus: bool):
             w, alpha_k, shard_k, idxs_k, params.lam, params.n,
             mode=mode, sigma=sigma,
         )
-        alpha_new = alpha_k + scaling * da  # CoCoA.scala:101
-        return dw, alpha_new
+        return dw, alpha_k + scaling * da  # CoCoA.scala:101
+
+    def apply_fn(w, dw_sum):
+        return w + scaling * dw_sum  # CoCoA.scala:47-48
+
+    return per_shard, apply_fn
+
+
+def make_round_step(mesh, params: Params, k: int, plus: bool):
+    """Build the jitted (w, alpha, idxs, shard_arrays) -> (w', alpha') step."""
+    per_shard, apply_fn = _cocoa_round_parts(params, k, plus)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def round_step(w, alpha, idxs, shard_arrays):
         dw_sum, alpha_new = base.fanout(
             per_shard, mesh, w, alpha, idxs, shard_arrays
         )
-        return w + scaling * dw_sum, alpha_new  # CoCoA.scala:47-48
+        return apply_fn(w, dw_sum), alpha_new
 
     return round_step
+
+
+def make_chunk_step(mesh, params: Params, k: int, plus: bool):
+    """Build the jitted chunked step: C rounds as one device-side lax.scan
+    (see parallel/fanout.py chunk_fanout) — same math as make_round_step,
+    one host dispatch per chunk instead of per round."""
+    from cocoa_tpu.parallel.fanout import chunk_fanout
+
+    per_shard, apply_fn = _cocoa_round_parts(params, k, plus)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def chunk_step(w, alpha, idxs_ckh, shard_arrays):
+        return chunk_fanout(
+            mesh, per_shard, apply_fn, w, alpha, idxs_ckh, shard_arrays
+        )
+
+    return chunk_step
 
 
 def run_cocoa(
@@ -64,6 +94,7 @@ def run_cocoa(
     start_round: int = 1,
     quiet: bool = False,
     gap_target: Optional[float] = None,
+    scan_chunk: int = 0,
 ):
     """Train; returns (w, alpha, Trajectory).
 
@@ -72,7 +103,9 @@ def run_cocoa(
     target (the baseline metric counts comm-rounds and wall-clock to reach
     it); ``w_init``/``alpha_init``/``start_round`` resume from a checkpoint
     (see cocoa_tpu.checkpoint) — round-indexed RNG makes the resumed
-    trajectory identical to an uninterrupted run.
+    trajectory identical to an uninterrupted run; ``scan_chunk > 0`` runs
+    rounds device-side in blocks of that size via ``lax.scan`` (fewer host
+    dispatches, same math and observable trajectory).
     """
     base.check_shards(ds)
     k = ds.k
@@ -95,12 +128,7 @@ def run_cocoa(
         alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
 
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
-    step = make_round_step(mesh, params, k, plus)
     shard_arrays = ds.shard_arrays()
-
-    def round_fn(t, state):
-        w, alpha = state
-        return step(w, alpha, sampler.round_indices(t), shard_arrays)
 
     def eval_fn(state):
         w, alpha = state
@@ -112,6 +140,26 @@ def run_cocoa(
             else None
         )
         return primal, gap, test_err
+
+    if scan_chunk > 0:
+        chunk_step = make_chunk_step(mesh, params, k, plus)
+
+        def chunk_fn(t0, c, state):
+            w, alpha = state
+            return chunk_step(w, alpha, sampler.chunk_indices(t0, c), shard_arrays)
+
+        (w, alpha), traj = base.drive_chunked(
+            alg, params, debug, (w, alpha), chunk_fn, eval_fn,
+            quiet=quiet, gap_target=gap_target, start_round=start_round,
+            chunk=scan_chunk,
+        )
+        return w, alpha, traj
+
+    step = make_round_step(mesh, params, k, plus)
+
+    def round_fn(t, state):
+        w, alpha = state
+        return step(w, alpha, sampler.round_indices(t), shard_arrays)
 
     (w, alpha), traj = base.drive(
         alg, params, debug, (w, alpha), round_fn, eval_fn,

@@ -219,6 +219,23 @@ def test_checkpoint_roundtrip(tiny_data, tmp_path):
     np.testing.assert_allclose(a_l, np.asarray(alpha), atol=0)
 
 
+@pytest.mark.parametrize("use_mesh", [False, True])
+@pytest.mark.parametrize("plus", [True, False])
+def test_scan_chunk_equals_per_round(tiny_data, use_mesh, plus):
+    """Device-side lax.scan over round chunks == the per-round python loop,
+    bit-exact, on both execution paths."""
+    k = 4
+    mesh = make_mesh(k) if use_mesh else None
+    ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64, mesh=mesh)
+    p = _params(tiny_data, num_rounds=7)
+    w_loop, a_loop, _ = run_cocoa(ds, p, _debug(), plus=plus, mesh=mesh, quiet=True)
+    w_scan, a_scan, _ = run_cocoa(
+        ds, p, _debug(), plus=plus, mesh=mesh, quiet=True, scan_chunk=3
+    )
+    np.testing.assert_allclose(np.asarray(w_scan), np.asarray(w_loop), atol=0)
+    np.testing.assert_allclose(np.asarray(a_scan), np.asarray(a_loop), atol=0)
+
+
 def test_resume_equals_uninterrupted(tiny_data, tmp_path):
     """Checkpoint at round 5, resume to 10 → bit-identical to a straight
     10-round run (round-indexed RNG makes rounds independent of history)."""
