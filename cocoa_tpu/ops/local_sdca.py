@@ -103,3 +103,88 @@ def local_sdca(
     )
     del w_final
     return alpha_final - alpha, dw
+
+
+def mode_factors(mode: str, sigma: float):
+    """(sig_eff, qii_factor) for the margin decomposition used by the fast
+    kernels: x·w_step = margins0[idx] + sig_eff·(x·Δw), where margins0 = X·w₀
+    is precomputed once per round (one MXU matvec).
+
+    - cocoa:  w_step = w₀ + Δw exactly (the local w advance accumulates the
+      same updates as Δw, CoCoA.scala:182-185) ⇒ sig_eff = 1, qii = ‖x‖².
+    - plus:   w frozen, subproblem reads σ′·Δw (CoCoA.scala:158-160)
+      ⇒ sig_eff = σ′, qii = ‖x‖²·σ′.
+    - frozen: w frozen, no Δw term (MinibatchCD.scala:104)
+      ⇒ sig_eff = 0, qii = ‖x‖².
+    """
+    if mode == "cocoa":
+        return 1.0, 1.0
+    if mode == "plus":
+        return sigma, sigma
+    if mode == "frozen":
+        return 0.0, 1.0
+    raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+
+
+def local_sdca_fast(
+    margins0: jax.Array,   # (n_shard,) precomputed x_i·w₀
+    alpha: jax.Array,      # (n_shard,)
+    shard: dict,
+    idxs: jax.Array,       # (H,) int32
+    lam: float,
+    n: int,
+    dw_init: jax.Array,    # (d,) zeros, built from w by the caller so its
+                           # varying-axes type matches under shard_map
+    mode: str = "cocoa",
+    sigma: float = 1.0,
+):
+    """Fast-math variant of :func:`local_sdca`: the per-step w dot is
+    replaced by the precomputed round margin plus an incremental Δw dot
+    (see :func:`mode_factors`).  Exactly equal in real arithmetic; floating
+    point rounds differently than the reference order, so trajectories agree
+    to ~1e-6 rather than bit-exactly.  Returns (delta_alpha, delta_w).
+
+    The frozen mode skips the Δw dot entirely — its only sequential state is
+    alpha itself.
+    """
+    sig_eff, qii_factor = mode_factors(mode, sigma)
+    labels = shard["labels"]
+    sq_norms = shard["sq_norms"]
+    dtype = margins0.dtype
+    lam_n = jnp.asarray(lam * n, dtype)
+    sig_c = jnp.asarray(sig_eff, dtype)
+    qf = jnp.asarray(qii_factor, dtype)
+    zero = jnp.asarray(0.0, dtype)
+    one = jnp.asarray(1.0, dtype)
+
+    def step(i, carry):
+        dw, a_vec = carry
+        idx = idxs[i]
+        row = get_row(shard, idx)
+        y = labels[idx]
+        a = a_vec[idx]
+
+        margin = margins0[idx]
+        if mode != "frozen":
+            margin = margin + sig_c * row_dot(row, dw)
+        grad = (y * margin - one) * lam_n
+
+        proj_grad = jnp.where(
+            a <= zero,
+            jnp.minimum(grad, zero),
+            jnp.where(a >= one, jnp.maximum(grad, zero), grad),
+        )
+        qii = sq_norms[idx] * qf
+        safe_qii = jnp.where(qii != zero, qii, one)
+        new_a = jnp.where(
+            qii != zero, jnp.clip(a - grad / safe_qii, zero, one), one
+        )
+        new_a = jnp.where(proj_grad != zero, new_a, a)
+
+        coef = y * (new_a - a) / lam_n
+        dw = row_axpy(row, coef, dw)
+        a_vec = a_vec.at[idx].set(new_a)
+        return dw, a_vec
+
+    dw, alpha_final = lax.fori_loop(0, idxs.shape[0], step, (dw_init, alpha))
+    return alpha_final - alpha, dw

@@ -1,0 +1,159 @@
+"""Pallas TPU kernel for the sequential SDCA inner loop (dense layout).
+
+The H coordinate steps of local SDCA are inherently sequential
+(CoCoA.scala:148-188); under plain XLA each step pays HBM round-trips for
+the row gather and the Δw update.  This kernel keeps the hot state — the Δw
+accumulator and the shard's α/labels/‖x‖²/margins vectors — resident in VMEM
+across all H steps and lets Pallas's grid pipeline prefetch each sampled row
+HBM→VMEM (double-buffered) while the previous step computes.
+
+Uses the margins decomposition (ops/local_sdca.py ``mode_factors``): the
+per-step margin is ``margins0[idx] + sig_eff·(x·Δw)`` with margins0 = X·w₀
+precomputed outside the kernel as one MXU matvec per round.  Per grid step
+the kernel does one (1, d) VPU dot, scalar box-projection logic, one (1, d)
+axpy, and a masked α write.
+
+Grid is (K, H): shard-major, steps inner.  Output blocks (Δw row, α row)
+map to the shard index only, so Pallas keeps them in VMEM across the H
+inner steps and flushes to HBM once per shard — the classic revisited-block
+reduction pattern.
+
+Sampled indices arrive via ``PrefetchScalarGridSpec`` so the row BlockSpec's
+index_map can address X[k, idxs[k, i]] ahead of the compute.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cocoa_tpu.ops.local_sdca import mode_factors
+
+
+def _kernel(
+    idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
+    x_ref,           # (1, 1, d) VMEM: the sampled row (auto-DMA'd per step)
+    margins0_ref,    # (1, n) VMEM
+    labels_ref,      # (1, n) VMEM
+    sqn_ref,         # (1, n) VMEM
+    alpha_in_ref,    # (1, n) VMEM
+    dw_ref,          # out (1, d) VMEM, revisited across the H inner steps
+    alpha_ref,       # out (1, n) VMEM, revisited
+    *,
+    lam_n: float,
+    sig_eff: float,
+    qii_factor: float,
+    frozen: bool,
+):
+    i = pl.program_id(1)
+    idx = idxs_ref[pl.program_id(0), i]
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        alpha_ref[...] = alpha_in_ref[...]
+
+    n = alpha_ref.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    sel = lane == idx
+
+    def pick(ref):
+        return jnp.sum(jnp.where(sel, ref[...], 0.0))
+
+    y = pick(labels_ref)
+    a = pick(alpha_ref)
+    sq = pick(sqn_ref)
+    m0 = pick(margins0_ref)
+
+    x = x_ref[0]                      # (1, d)
+    if frozen:
+        margin = m0
+    else:
+        xdw = jnp.sum(x * dw_ref[...])
+        margin = m0 + sig_eff * xdw
+    grad = (y * margin - 1.0) * lam_n
+
+    # box projection (CoCoA.scala:166-178)
+    proj_grad = jnp.where(
+        a <= 0.0,
+        jnp.minimum(grad, 0.0),
+        jnp.where(a >= 1.0, jnp.maximum(grad, 0.0), grad),
+    )
+    qii = sq * qii_factor
+    safe_qii = jnp.where(qii != 0.0, qii, 1.0)
+    new_a = jnp.where(qii != 0.0, jnp.clip(a - grad / safe_qii, 0.0, 1.0), 1.0)
+    new_a = jnp.where(proj_grad != 0.0, new_a, a)
+
+    coef = y * (new_a - a) / lam_n
+    dw_ref[...] = dw_ref[...] + coef * x
+    alpha_ref[...] = jnp.where(sel, new_a, alpha_ref[...])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lam", "n", "mode", "sigma", "interpret"),
+)
+def pallas_sdca_round(
+    w_margins0: jax.Array,   # (K, n_shard) precomputed X·w₀ per shard
+    alpha: jax.Array,        # (K, n_shard)
+    X: jax.Array,            # (K, n_shard, d) dense rows
+    labels: jax.Array,       # (K, n_shard)
+    sq_norms: jax.Array,     # (K, n_shard)
+    idxs: jax.Array,         # (K, H) int32
+    lam: float,
+    n: int,
+    mode: str = "plus",
+    sigma: float = 1.0,
+    interpret: bool = False,
+):
+    """One SDCA round for K shards on this chip.  Returns (dw, alpha_inner):
+    dw (K, d) unreduced per-shard updates; alpha_inner (K, n_shard) the
+    locally-advanced alpha (callers apply the outer scaling law).
+
+    Inside ``shard_map`` this must run under ``check_vma=False`` (the
+    chunked driver does; pallas_call's internal slices confuse the VMA
+    checker)."""
+    k, n_shard, d = X.shape
+    h = idxs.shape[1]
+    sig_eff, qii_factor = mode_factors(mode, sigma)
+    dtype = X.dtype
+
+    kernel = functools.partial(
+        _kernel,
+        lam_n=float(lam * n),
+        sig_eff=float(sig_eff),
+        qii_factor=float(qii_factor),
+        frozen=(mode == "frozen"),
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(k, h),
+        in_specs=[
+            # the sampled row: block (1,1,d) at [k, idxs[k,i], :]
+            pl.BlockSpec((1, 1, d), lambda k_, i_, idxs_: (k_, idxs_[k_, i_], 0)),
+            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
+            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
+            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
+            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, d), lambda k_, i_, idxs_: (k_, 0)),
+            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
+        ],
+    )
+
+    dw, alpha_inner = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), dtype),
+            jax.ShapeDtypeStruct((k, n_shard), dtype),
+        ],
+        interpret=interpret,
+    )(idxs, X, w_margins0, labels, sq_norms, alpha)
+    return dw, alpha_inner

@@ -91,6 +91,8 @@ def chunk_fanout(
     carry_sharded,      # pytree, leaves (K, ...): shard-local carry (e.g. alpha)
     xs_sharded,         # pytree, leaves (C, K, ...): per-round per-shard inputs
     static_sharded,     # pytree, leaves (K, ...): shard data (not scanned)
+    per_round_batched: Optional[Callable] = None,
+    check_vma: bool = True,
 ):
     """Run C rounds device-side as one ``lax.scan`` (one dispatch per chunk).
 
@@ -99,6 +101,11 @@ def chunk_fanout(
     ``apply_fn(w, dw_sum) -> w'`` is the replicated driver-side update.
     Returns (w_final, carry_final) with the same placement semantics as
     ``fanout`` (w replicated, carry keeping its leading K dim).
+
+    ``per_round_batched(w, carry, x, static) -> (dw_sum, carry')``, when
+    given, replaces the vmap on the single-chip path with one call over all
+    K shards at once — required for inner solvers that manage the shard axis
+    themselves (the Pallas kernel's (K, H) grid cannot sit under vmap).
     """
     if mesh is not None:
         def wrapped(w, carry, xs, static):
@@ -125,16 +132,21 @@ def chunk_fanout(
         )
         out_specs = (P(), jax.tree.map(lambda _: P(DP_AXIS), carry_sharded))
         return jax.shard_map(
-            wrapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+            wrapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
         )(w, carry_sharded, xs_sharded, static_sharded)
 
     # local path: scan over rounds; per round, vmap over shards + in-device sum
     def body(c, x):
         w, carry = c
-        dw, carry2 = jax.vmap(per_round, in_axes=(None, 0, 0, 0))(
-            w, carry, x, static_sharded
-        )
-        return (apply_fn(w, dw.sum(axis=0)), carry2), None
+        if per_round_batched is not None:
+            dw_sum, carry2 = per_round_batched(w, carry, x, static_sharded)
+        else:
+            dw, carry2 = jax.vmap(per_round, in_axes=(None, 0, 0, 0))(
+                w, carry, x, static_sharded
+            )
+            dw_sum = dw.sum(axis=0)
+        return (apply_fn(w, dw_sum), carry2), None
 
     (w, carry), _ = lax.scan(body, (w, carry_sharded), xs_sharded)
     return w, carry

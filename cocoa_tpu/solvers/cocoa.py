@@ -27,32 +27,95 @@ from cocoa_tpu.ops import local_sdca
 from cocoa_tpu.solvers import base
 
 
-def _cocoa_round_parts(params: Params, k: int, plus: bool):
+def _cocoa_round_parts(
+    params: Params,
+    k: int,
+    plus: bool,
+    math: str = "exact",
+    pallas: bool = False,
+    pallas_interpret: bool = False,
+):
     """The per-shard local update and driver-side apply shared by the
     per-round and chunked builders (so the two paths cannot diverge).
 
     scaling law: γ (CoCoA+, additive) | β/K (CoCoA, averaging) —
-    CoCoA.scala:37; σ′ = K·γ (CoCoA.scala:45)."""
+    CoCoA.scala:37; σ′ = K·γ (CoCoA.scala:45).
+
+    ``math="fast"`` uses the margins decomposition (ops/local_sdca.py
+    ``mode_factors``): one MXU matvec per round + an incremental Δw dot per
+    step — equal in real arithmetic, rounds differently than the reference
+    order.  ``pallas=True`` (dense layout only) further runs the inner loop
+    as the Pallas TPU kernel.  Returns (per_shard, per_round_batched | None,
+    apply_fn)."""
+    if math not in ("exact", "fast"):
+        raise ValueError(f"math must be 'exact' or 'fast', got {math!r}")
     scaling = params.gamma if plus else params.beta / k
     sigma = k * params.gamma
     mode = "plus" if plus else "cocoa"
 
-    def per_shard(w, alpha_k, idxs_k, shard_k):
-        da, dw = local_sdca(
-            w, alpha_k, shard_k, idxs_k, params.lam, params.n,
-            mode=mode, sigma=sigma,
-        )
-        return dw, alpha_k + scaling * da  # CoCoA.scala:101
-
     def apply_fn(w, dw_sum):
         return w + scaling * dw_sum  # CoCoA.scala:47-48
 
-    return per_shard, apply_fn
+    if math == "exact":
+        if pallas:
+            raise ValueError("the Pallas kernel implies math='fast'")
+
+        def per_shard(w, alpha_k, idxs_k, shard_k):
+            da, dw = local_sdca(
+                w, alpha_k, shard_k, idxs_k, params.lam, params.n,
+                mode=mode, sigma=sigma,
+            )
+            return dw, alpha_k + scaling * da  # CoCoA.scala:101
+
+        return per_shard, None, apply_fn
+
+    from cocoa_tpu.ops.local_sdca import local_sdca_fast
+    from cocoa_tpu.ops.rows import shard_margins
+
+    def per_shard(w, alpha_k, idxs_k, shard_k):
+        m0 = shard_margins(w, shard_k)
+        if pallas:
+            # only reached inside the chunked mesh driver, which runs its
+            # shard_map with check_vma=False (pallas_call's internal slices
+            # confuse the VMA checker)
+            from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
+
+            dw, a_inner = pallas_sdca_round(
+                m0[None], alpha_k[None], shard_k["X"][None],
+                shard_k["labels"][None], shard_k["sq_norms"][None],
+                idxs_k[None], params.lam, params.n,
+                mode=mode, sigma=sigma, interpret=pallas_interpret,
+            )
+            da = a_inner[0] - alpha_k
+            return dw[0], alpha_k + scaling * da
+        da, dw = local_sdca_fast(
+            m0, alpha_k, shard_k, idxs_k, params.lam, params.n,
+            jnp.zeros_like(w), mode=mode, sigma=sigma,
+        )
+        return dw, alpha_k + scaling * da
+
+    per_round_batched = None
+    if pallas:
+        # the Pallas kernel owns the shard axis via its (K, H) grid — used on
+        # the single-chip path instead of vmap(per_shard)
+        def per_round_batched(w, alpha, idxs_kh, shards):
+            from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
+
+            m0 = shard_margins(w, shards)   # (K, n_shard): batched matvec
+            dw, a_inner = pallas_sdca_round(
+                m0, alpha, shards["X"], shards["labels"], shards["sq_norms"],
+                idxs_kh, params.lam, params.n,
+                mode=mode, sigma=sigma, interpret=pallas_interpret,
+            )
+            alpha_new = alpha + scaling * (a_inner - alpha)
+            return dw.sum(axis=0), alpha_new
+
+    return per_shard, per_round_batched, apply_fn
 
 
-def make_round_step(mesh, params: Params, k: int, plus: bool):
+def make_round_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
     """Build the jitted (w, alpha, idxs, shard_arrays) -> (w', alpha') step."""
-    per_shard, apply_fn = _cocoa_round_parts(params, k, plus)
+    per_shard, _, apply_fn = _cocoa_round_parts(params, k, plus, **parts_kw)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def round_step(w, alpha, idxs, shard_arrays):
@@ -64,18 +127,24 @@ def make_round_step(mesh, params: Params, k: int, plus: bool):
     return round_step
 
 
-def make_chunk_step(mesh, params: Params, k: int, plus: bool):
+def make_chunk_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
     """Build the jitted chunked step: C rounds as one device-side lax.scan
     (see parallel/fanout.py chunk_fanout) — same math as make_round_step,
     one host dispatch per chunk instead of per round."""
     from cocoa_tpu.parallel.fanout import chunk_fanout
 
-    per_shard, apply_fn = _cocoa_round_parts(params, k, plus)
+    per_shard, per_round_batched, apply_fn = _cocoa_round_parts(
+        params, k, plus, **parts_kw
+    )
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def chunk_step(w, alpha, idxs_ckh, shard_arrays):
         return chunk_fanout(
-            mesh, per_shard, apply_fn, w, alpha, idxs_ckh, shard_arrays
+            mesh, per_shard, apply_fn, w, alpha, idxs_ckh, shard_arrays,
+            per_round_batched=per_round_batched,
+            # pallas_call's internal slices confuse shard_map's VMA type
+            # checker; the manual pvary/psum handling makes it safe to skip
+            check_vma=not parts_kw.get("pallas", False),
         )
 
     return chunk_step
@@ -95,6 +164,8 @@ def run_cocoa(
     quiet: bool = False,
     gap_target: Optional[float] = None,
     scan_chunk: int = 0,
+    math: str = "exact",
+    pallas=None,
 ):
     """Train; returns (w, alpha, Trajectory).
 
@@ -106,6 +177,13 @@ def run_cocoa(
     trajectory identical to an uninterrupted run; ``scan_chunk > 0`` runs
     rounds device-side in blocks of that size via ``lax.scan`` (fewer host
     dispatches, same math and observable trajectory).
+
+    ``math="fast"`` enables the margins-decomposition inner loop (equal in
+    real arithmetic; floating-point rounds differ from the reference order —
+    trajectories agree to ~1e-6, convergence behavior is unchanged).
+    ``pallas`` (None = auto: fast math + dense layout + TPU backend) runs
+    the inner loop as the Pallas TPU kernel; requires ``math="fast"`` and
+    the dense layout.
     """
     base.check_shards(ds)
     k = ds.k
@@ -127,6 +205,31 @@ def run_cocoa(
         w = jax.device_put(w, replicated(mesh))
         alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
 
+    platform = jax.devices()[0].platform
+    if pallas is None:  # auto: the TPU fast path when it applies
+        pallas = (
+            math == "fast" and ds.layout == "dense"
+            and platform in ("tpu", "axon")
+        )
+    if pallas and ds.layout != "dense":
+        raise ValueError("the Pallas SDCA kernel requires layout='dense'")
+    if pallas and math != "fast":
+        raise ValueError("pallas=True requires math='fast'")
+    if pallas and platform not in ("tpu", "axon", "cpu"):
+        raise ValueError(
+            f"the Pallas SDCA kernel needs a TPU backend (or CPU interpret "
+            f"mode); current platform is {platform!r}"
+        )
+    parts_kw = dict(
+        math=math, pallas=pallas,
+        pallas_interpret=(pallas and platform == "cpu"),
+    )
+    # the Pallas kernel owns the shard axis itself, which neither the
+    # per-round driver's vmap path nor its plain fanout shard_map can
+    # express — always route it through the chunked driver
+    if pallas and scan_chunk <= 0:
+        scan_chunk = 1
+
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
     shard_arrays = ds.shard_arrays()
 
@@ -142,7 +245,7 @@ def run_cocoa(
         return primal, gap, test_err
 
     if scan_chunk > 0:
-        chunk_step = make_chunk_step(mesh, params, k, plus)
+        chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
 
         def chunk_fn(t0, c, state):
             w, alpha = state
@@ -155,7 +258,7 @@ def run_cocoa(
         )
         return w, alpha, traj
 
-    step = make_round_step(mesh, params, k, plus)
+    step = make_round_step(mesh, params, k, plus, **parts_kw)
 
     def round_fn(t, state):
         w, alpha = state
