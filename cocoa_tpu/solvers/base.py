@@ -124,6 +124,121 @@ def drive_chunked(
     return state, traj
 
 
+_DEVICE_RUNS: dict = {}
+
+
+def _build_device_run(chunk_kernel, eval_kernel, n_chunks, gap_target, n_state,
+                      mesh=None):
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    tgt = -jnp.inf if gap_target is None else float(gap_target)
+
+    @functools.partial(jax.jit, donate_argnums=tuple(range(n_state)))
+    def run(*args):
+        state = args[:n_state]
+        idxs_all, shard_arrays, test_arrays = args[n_state:]
+
+        def cond(s):
+            i, done, state, traj = s
+            return (i < n_chunks) & jnp.logical_not(done)
+
+        def body(s):
+            i, done, state, traj = s
+            state = chunk_kernel(state, idxs_all[i], shard_arrays)
+            metrics = eval_kernel(state, shard_arrays, test_arrays)
+            traj = lax.dynamic_update_index_in_dim(traj, metrics, i, 0)
+            done = metrics[1] <= tgt
+            return i + jnp.int32(1), done, state, traj
+
+        traj0 = jnp.full((n_chunks, 3), jnp.nan, dtype=state[0].dtype)
+        if mesh is not None:
+            # metrics coming out of the shard_mapped eval carry the (Explicit)
+            # mesh in their sharding type; the update target must match
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            traj0 = lax.with_sharding_constraint(
+                traj0, NamedSharding(mesh, P(None, None))
+            )
+        i, done, state, traj = lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.asarray(False), state, traj0)
+        )
+        return i, state, traj
+
+    return run
+
+
+def drive_on_device(
+    name: str,
+    debug: DebugParams,
+    state: tuple,
+    chunk_kernel: Callable,   # (state, idxs_ckh, shard_arrays) -> state, traceable
+    eval_kernel: Callable,    # (state, shard_arrays, test_arrays) -> (3,) metrics
+    idxs_all,                 # (n_chunks, C, K, H) int32, C = eval cadence
+    shard_arrays,
+    test_arrays=None,
+    quiet: bool = False,
+    gap_target: Optional[float] = None,
+    start_round: int = 1,
+    cache_key=None,
+    mesh=None,
+):
+    """Fully device-resident outer driver: the ENTIRE run — every round,
+    every ``debugIter`` evaluation, and the gap-target early-stop test — is
+    one ``lax.while_loop`` inside one jit.  One dispatch, one host fetch.
+
+    Rationale: the per-round device compute of these solvers is microseconds,
+    so the wall-clock of the host-stepped drivers is pure host/device
+    round-trip latency (~100ms per scalar fetch through a tunneled device —
+    measured; see bench.py).  The reference has the same structure (driver
+    JVM ⇄ executors every round, CoCoA.scala:39-63) and pays it; riding the
+    whole loop device-side is the TPU-native answer, not a benchmark trick —
+    the observable trajectory (eval cadence, stopping round, printed lines)
+    is identical to :func:`drive_chunked`.
+
+    ``idxs_all`` carries the eval cadence as its chunk axis (chunks of
+    exactly C = debugIter rounds; the caller finishes any num_rounds % C
+    remainder through the host-stepped path).  Trajectory metrics land in a
+    preallocated device buffer, fetched once.
+
+    Checkpointing is host-side by nature — callers with chkpt_iter > 0 use
+    :func:`drive_chunked` instead.
+
+    ``cache_key``: any hashable token fully determining the closures
+    (algorithm + params + flags + mesh + chunk geometry + gap target).  When
+    given, the built jit executable is reused across calls — without it every
+    call re-jits (closures have fresh identity) and pays ~1s of recompile.
+    """
+    n_chunks, c = int(idxs_all.shape[0]), int(idxs_all.shape[1])
+    tgt = gap_target
+    n_state = len(state)
+
+    run = _DEVICE_RUNS.get(cache_key) if cache_key is not None else None
+    if run is None:
+        run = _build_device_run(
+            chunk_kernel, eval_kernel, n_chunks, tgt, n_state, mesh=mesh
+        )
+        if cache_key is not None:
+            _DEVICE_RUNS[cache_key] = run
+
+    i, state, traj_buf = run(*state, idxs_all, shard_arrays, test_arrays)
+    # the single host sync of the whole run
+    n_done = int(i)
+    traj_host = np.asarray(traj_buf[:n_done])
+
+    traj = Trajectory(name, quiet=quiet)
+    for j in range(n_done):
+        end = start_round - 1 + (j + 1) * c
+        primal, gap, test_err = (float(v) for v in traj_host[j])
+        traj.log_round(
+            end, primal=primal, gap=gap,
+            test_error=None if np.isnan(test_err) else test_err,
+        )
+    return state, traj
+
+
 def check_shards(ds: ShardedDataset) -> None:
     """Reject empty shards up front: the reference crashes inside the task
     (``nextInt(0)``) when numSplits > rows; we fail with a clear message."""

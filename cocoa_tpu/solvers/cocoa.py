@@ -127,18 +127,18 @@ def make_round_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
     return round_step
 
 
-def make_chunk_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
-    """Build the jitted chunked step: C rounds as one device-side lax.scan
-    (see parallel/fanout.py chunk_fanout) — same math as make_round_step,
-    one host dispatch per chunk instead of per round."""
+def _make_chunk_kernel(mesh, params: Params, k: int, plus: bool, **parts_kw):
+    """The un-jitted traceable chunk body shared by :func:`make_chunk_step`
+    and the device-resident driver (so the two cannot diverge):
+    (w, alpha, idxs_ckh, shard_arrays) -> (w', alpha'), C rounds as one
+    ``lax.scan`` (parallel/fanout.py chunk_fanout)."""
     from cocoa_tpu.parallel.fanout import chunk_fanout
 
     per_shard, per_round_batched, apply_fn = _cocoa_round_parts(
         params, k, plus, **parts_kw
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def chunk_step(w, alpha, idxs_ckh, shard_arrays):
+    def chunk_kernel(w, alpha, idxs_ckh, shard_arrays):
         return chunk_fanout(
             mesh, per_shard, apply_fn, w, alpha, idxs_ckh, shard_arrays,
             per_round_batched=per_round_batched,
@@ -147,7 +147,28 @@ def make_chunk_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
             check_vma=not parts_kw.get("pallas", False),
         )
 
-    return chunk_step
+    return chunk_kernel
+
+
+_CHUNK_STEPS: dict = {}
+
+
+def make_chunk_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
+    """Build the jitted chunked step: C rounds as one device-side lax.scan
+    (see parallel/fanout.py chunk_fanout) — same math as make_round_step,
+    one host dispatch per chunk instead of per round.  Executables are cached
+    per configuration so repeated run_* calls don't pay a re-jit."""
+    key = (
+        mesh, k, plus, params.lam, params.n, params.local_iters,
+        params.beta, params.gamma, params.loss,
+        tuple(sorted(parts_kw.items())),
+    )
+    step = _CHUNK_STEPS.get(key)
+    if step is None:
+        kernel = _make_chunk_kernel(mesh, params, k, plus, **parts_kw)
+        step = jax.jit(kernel, donate_argnums=(0, 1))
+        _CHUNK_STEPS[key] = step
+    return step
 
 
 def run_cocoa(
@@ -166,6 +187,7 @@ def run_cocoa(
     scan_chunk: int = 0,
     math: str = "exact",
     pallas=None,
+    device_loop: bool = False,
 ):
     """Train; returns (w, alpha, Trajectory).
 
@@ -184,6 +206,13 @@ def run_cocoa(
     ``pallas`` (None = auto: fast math + dense layout + TPU backend) runs
     the inner loop as the Pallas TPU kernel; requires ``math="fast"`` and
     the dense layout.
+
+    ``device_loop=True`` runs the ENTIRE training loop — all rounds, the
+    ``debugIter``-cadence evaluations, and the gap-target early-stop — as
+    one ``lax.while_loop`` on device: one dispatch, one host fetch (see
+    base.drive_on_device).  Observable trajectory identical to the
+    host-stepped drivers; requires debug_iter > 0, not compatible with
+    checkpointing (chkpt_iter).
     """
     base.check_shards(ds)
     k = ds.k
@@ -193,11 +222,11 @@ def run_cocoa(
               f"distributed over {k} workers")
 
     dtype = ds.labels.dtype
-    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.asarray(w_init, dtype)
+    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.array(w_init, dtype=dtype, copy=True)
     alpha = (
         jnp.zeros((k, ds.n_shard), dtype=dtype)
         if alpha_init is None
-        else jnp.asarray(alpha_init, dtype)
+        else jnp.array(alpha_init, dtype=dtype, copy=True)
     )
     if mesh is not None:
         from cocoa_tpu.parallel.mesh import replicated, sharded_rows
@@ -206,11 +235,12 @@ def run_cocoa(
         alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
 
     platform = jax.devices()[0].platform
-    if pallas is None:  # auto: the TPU fast path when it applies
-        pallas = (
-            math == "fast" and ds.layout == "dense"
-            and platform in ("tpu", "axon")
-        )
+    if pallas is None:
+        # auto-selection is OFF until the kernel's Mosaic block mappings are
+        # reworked: real-TPU lowering rejects the current single-row block
+        # specs (second-to-last block dim must be a multiple of 8 or the full
+        # axis).  Interpret-mode (CPU) remains available via pallas=True.
+        pallas = False
     if pallas and ds.layout != "dense":
         raise ValueError("the Pallas SDCA kernel requires layout='dense'")
     if pallas and math != "fast":
@@ -235,14 +265,88 @@ def run_cocoa(
 
     def eval_fn(state):
         w, alpha = state
-        primal = objectives.primal_objective(ds, w, params.lam)
-        gap = primal - objectives.dual_objective(ds, w, alpha, params.lam)
-        test_err = (
-            objectives.classification_error(test_ds, w)
-            if test_ds is not None
-            else None
-        )
-        return primal, gap, test_err
+        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds)
+
+    if device_loop:
+        if debug.debug_iter <= 0:
+            raise ValueError(
+                "device_loop requires debug_iter > 0 (the eval cadence is "
+                "the device loop's chunk axis)"
+            )
+        if debug.chkpt_dir and debug.chkpt_iter > 0:
+            raise ValueError(
+                "device_loop cannot checkpoint (host-side by nature); use "
+                "scan_chunk for checkpointed runs"
+            )
+        raw_kernel = _make_chunk_kernel(mesh, params, k, plus, **parts_kw)
+
+        def chunk_kernel(state, idxs_ckh, shard_arrays):
+            return raw_kernel(state[0], state[1], idxs_ckh, shard_arrays)
+
+        test_arrays = test_ds.shard_arrays() if test_ds is not None else None
+        test_n = test_ds.n if test_ds is not None else 0
+
+        def eval_kernel(state, shard_arrays, test_arrays):
+            w, alpha = state
+            return objectives.eval_metrics(
+                w, alpha, shard_arrays, params.lam, params.n, mesh=mesh,
+                test_shard_arrays=test_arrays, test_n=test_n,
+            )
+
+        from cocoa_tpu.utils.logging import Trajectory
+
+        c = debug.debug_iter
+        traj = Trajectory(alg, quiet=quiet)
+        stopped = False
+        t = start_round
+        # head: advance to the absolute debugIter boundary so eval rounds are
+        # anchored to t % debugIter == 0 exactly like the host drivers (a
+        # resumed start_round is usually off-cadence)
+        head_end = min(params.num_rounds, ((t - 1) // c + 1) * c)
+        if (t - 1) % c != 0 and head_end >= t:
+            chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
+            w, alpha = chunk_step(
+                w, alpha, sampler.chunk_indices(t, head_end - t + 1),
+                shard_arrays,
+            )
+            t = head_end + 1
+            if head_end % c == 0:
+                primal, gap, test_err = eval_fn((w, alpha))
+                traj.log_round(head_end, primal=primal, gap=gap,
+                               test_error=test_err)
+                stopped = gap_target is not None and gap <= gap_target
+
+        n_full = max(0, (params.num_rounds - (t - 1)) // c)
+        if n_full > 0 and not stopped:
+            flat = sampler.chunk_indices(t, n_full * c)
+            idxs_all = flat.reshape(n_full, c, *flat.shape[1:])
+            cache_key = (
+                "cocoa", plus, math, pallas, k, mesh,
+                params.lam, params.n, params.local_iters, params.beta,
+                params.gamma, c, n_full, gap_target, test_n, ds.layout,
+                str(dtype),
+            )
+            (w, alpha), dev_traj = base.drive_on_device(
+                alg, debug, (w, alpha), chunk_kernel, eval_kernel,
+                idxs_all, shard_arrays, test_arrays,
+                quiet=quiet, gap_target=gap_target, start_round=t,
+                cache_key=cache_key, mesh=mesh,
+            )
+            traj.records.extend(dev_traj.records)
+            t += n_full * c
+            stopped = (
+                gap_target is not None and traj.records
+                and traj.records[-1].gap is not None
+                and traj.records[-1].gap <= gap_target
+            )
+        rem = params.num_rounds - (t - 1)
+        if rem > 0 and not stopped:
+            # finish the sub-cadence tail exactly as drive_chunked would:
+            # run it, no eval (num_rounds is off the debugIter cadence)
+            chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
+            idxs_rem = sampler.chunk_indices(t, rem)
+            w, alpha = chunk_step(w, alpha, idxs_rem, shard_arrays)
+        return w, alpha, traj
 
     if scan_chunk > 0:
         chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)

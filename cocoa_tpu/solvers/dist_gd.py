@@ -57,7 +57,7 @@ def run_dist_gd(
               f"distributed over {k} workers")
 
     dtype = ds.labels.dtype
-    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.asarray(w_init, dtype)
+    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.array(w_init, dtype=dtype, copy=True)
     if mesh is not None:
         from cocoa_tpu.parallel.mesh import replicated
 
@@ -72,13 +72,7 @@ def run_dist_gd(
 
     def eval_fn(state):
         (w,) = state
-        primal = objectives.primal_objective(ds, w, params.lam)
-        test_err = (
-            objectives.classification_error(test_ds, w)
-            if test_ds is not None
-            else None
-        )
-        return primal, None, test_err
+        return objectives.evaluate(ds, w, None, params.lam, test_ds=test_ds)
 
     (w,), traj = base.drive(
         "Dist SGD", params, debug, (w,), round_fn, eval_fn,

@@ -59,11 +59,11 @@ def run_minibatch_cd(
               f"distributed over {k} workers")
 
     dtype = ds.labels.dtype
-    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.asarray(w_init, dtype)
+    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.array(w_init, dtype=dtype, copy=True)
     alpha = (
         jnp.zeros((k, ds.n_shard), dtype=dtype)
         if alpha_init is None
-        else jnp.asarray(alpha_init, dtype)
+        else jnp.array(alpha_init, dtype=dtype, copy=True)
     )
     if mesh is not None:
         from cocoa_tpu.parallel.mesh import replicated, sharded_rows
@@ -81,14 +81,7 @@ def run_minibatch_cd(
 
     def eval_fn(state):
         w, alpha = state
-        primal = objectives.primal_objective(ds, w, params.lam)
-        gap = primal - objectives.dual_objective(ds, w, alpha, params.lam)
-        test_err = (
-            objectives.classification_error(test_ds, w)
-            if test_ds is not None
-            else None
-        )
-        return primal, gap, test_err
+        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds)
 
     (w, alpha), traj = base.drive(
         "Mini-batch CD", params, debug, (w, alpha), round_fn, eval_fn,

@@ -74,7 +74,7 @@ def run_sgd(
               f"data examples, distributed over {k} workers")
 
     dtype = ds.labels.dtype
-    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.asarray(w_init, dtype)
+    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.array(w_init, dtype=dtype, copy=True)
     if mesh is not None:
         from cocoa_tpu.parallel.mesh import replicated
 
@@ -92,13 +92,7 @@ def run_sgd(
 
     def eval_fn(state):
         (w,) = state
-        primal = objectives.primal_objective(ds, w, params.lam)
-        test_err = (
-            objectives.classification_error(test_ds, w)
-            if test_ds is not None
-            else None
-        )
-        return primal, None, test_err
+        return objectives.evaluate(ds, w, None, params.lam, test_ds=test_ds)
 
     (w,), traj = base.drive(
         name, params, debug, (w,), round_fn, eval_fn,

@@ -236,6 +236,71 @@ def test_scan_chunk_equals_per_round(tiny_data, use_mesh, plus):
     np.testing.assert_allclose(np.asarray(a_scan), np.asarray(a_loop), atol=0)
 
 
+@pytest.mark.parametrize("use_mesh", [False, True])
+@pytest.mark.parametrize("plus", [True, False])
+def test_device_loop_equals_host_driver(tiny_data, use_mesh, plus):
+    """The fully device-resident while_loop driver (one dispatch, one fetch)
+    produces the same final state AND the same observable trajectory
+    (rounds evaluated, primal, gap, test error) as the host-stepped driver —
+    including a num_rounds % debugIter remainder tail."""
+    k = 4
+    mesh = make_mesh(k) if use_mesh else None
+    ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64, mesh=mesh)
+    test_ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64,
+                            mesh=mesh)
+    p = _params(tiny_data, num_rounds=7)
+    d = _debug(debug_iter=2)
+    w_h, a_h, tr_h = run_cocoa(
+        ds, p, d, plus=plus, mesh=mesh, test_ds=test_ds, quiet=True
+    )
+    w_d, a_d, tr_d = run_cocoa(
+        ds, p, d, plus=plus, mesh=mesh, test_ds=test_ds, quiet=True,
+        device_loop=True,
+    )
+    np.testing.assert_allclose(np.asarray(w_d), np.asarray(w_h), atol=0)
+    np.testing.assert_allclose(np.asarray(a_d), np.asarray(a_h), atol=0)
+    assert [r.round for r in tr_d.records] == [r.round for r in tr_h.records]
+    for rh, rd in zip(tr_h.records, tr_d.records):
+        assert abs(rh.primal - rd.primal) < 1e-12
+        assert abs(rh.gap - rd.gap) < 1e-12
+        assert abs(rh.test_error - rd.test_error) < 1e-12
+
+
+def test_device_loop_off_cadence_resume(tiny_data):
+    """A resumed run whose start_round is off the debugIter cadence must
+    still evaluate at absolute rounds t % debugIter == 0, matching the
+    host-stepped driver (head rounds run host-side up to the boundary)."""
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p1 = _params(tiny_data, num_rounds=1)
+    w1, a1, _ = run_cocoa(ds, p1, _debug(), plus=True, quiet=True)
+    p = _params(tiny_data, num_rounds=9)
+    d = _debug(debug_iter=2)
+    common = dict(plus=True, quiet=True, w_init=w1, alpha_init=a1,
+                  start_round=2)
+    w_h, a_h, tr_h = run_cocoa(ds, p, d, **common)
+    w_d, a_d, tr_d = run_cocoa(ds, p, d, device_loop=True, **common)
+    assert [r.round for r in tr_h.records] == [2, 4, 6, 8]
+    assert [r.round for r in tr_d.records] == [2, 4, 6, 8]
+    for rh, rd in zip(tr_h.records, tr_d.records):
+        assert abs(rh.gap - rd.gap) < 1e-12
+    np.testing.assert_allclose(np.asarray(w_d), np.asarray(w_h), atol=0)
+    np.testing.assert_allclose(np.asarray(a_d), np.asarray(a_h), atol=0)
+
+
+def test_device_loop_gap_target_early_stop(tiny_data):
+    """Device-side early stop halts at the same round the host driver does."""
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=40)
+    d = _debug(debug_iter=2)
+    target = 0.08
+    _, _, tr_h = run_cocoa(ds, p, d, plus=True, quiet=True, gap_target=target)
+    _, _, tr_d = run_cocoa(ds, p, d, plus=True, quiet=True, gap_target=target,
+                           device_loop=True)
+    assert tr_h.records[-1].gap <= target
+    assert tr_d.records[-1].round == tr_h.records[-1].round
+    assert abs(tr_d.records[-1].gap - tr_h.records[-1].gap) < 1e-12
+
+
 def test_resume_equals_uninterrupted(tiny_data, tmp_path):
     """Checkpoint at round 5, resume to 10 → bit-identical to a straight
     10-round run (round-indexed RNG makes rounds independent of history)."""
