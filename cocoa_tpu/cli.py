@@ -10,7 +10,10 @@ always; mini-batch CD, mini-batch SGD, local SGD and DistGD when
 TPU-native additions (no reference analogue): ``--dtype``, ``--layout``,
 ``--rng``, ``--mesh`` (dp size; defaults to min(numSplits, device count);
 ``--mesh=1`` forces the single-chip vmap path), ``--trajOut`` (JSONL
-trajectory dump), ``--gapTarget`` (early stop on duality gap).
+trajectory dump), ``--gapTarget`` (early stop on duality gap), ``--math``
+(exact | fast: margins-decomposition inner loop with auto-Pallas on TPU,
+CoCoA/CoCoA+ only), ``--deviceLoop`` (whole train loop as one on-device
+while_loop; incompatible with checkpointing).
 """
 
 from __future__ import annotations
@@ -27,8 +30,9 @@ from cocoa_tpu.evals import objectives
 from cocoa_tpu.parallel import make_mesh
 from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
 
-_TPU_FLAGS = ("dtype", "layout", "rng")       # map to same-named RunConfig fields
-_EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume", "scanChunk")  # run-level
+_TPU_FLAGS = ("dtype", "layout", "rng", "math")  # same-named RunConfig fields
+_EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume", "scanChunk",
+                "deviceLoop")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -124,6 +128,21 @@ def main(argv=None) -> int:
     debug = cfg.to_debug()
     gap_target = float(extras["gapTarget"]) if extras["gapTarget"] else None
     cfg.scan_chunk = int(extras["scanChunk"]) if extras["scanChunk"] else cfg.scan_chunk
+    cfg.device_loop = (
+        extras["deviceLoop"] is not None
+        and str(extras["deviceLoop"]).lower() != "false"
+    )
+    if cfg.device_loop and cfg.debug_iter <= 0:
+        print("error: --deviceLoop requires --debugIter > 0 (the eval "
+              "cadence is the device loop's chunk axis)", file=sys.stderr)
+        return 2
+    if cfg.device_loop and cfg.chkpt_dir and cfg.chkpt_iter > 0:
+        # resuming (--resume with --chkptIter=0) is fine — only periodic
+        # SAVING is host-side by nature and incompatible with the device loop
+        print("error: --deviceLoop cannot save checkpoints; drop --chkptDir, "
+              "set --chkptIter=0 (resume-only), or use --scanChunk",
+              file=sys.stderr)
+        return 2
     resume = extras["resume"] is not None and str(extras["resume"]).lower() != "false"
     if resume and not cfg.chkpt_dir:
         print("error: --resume requires --chkptDir", file=sys.stderr)
@@ -164,14 +183,15 @@ def main(argv=None) -> int:
 
     common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng)
 
+    cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
+                    math=cfg.math, device_loop=cfg.device_loop)
+
     w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
-                               gap_target=gap_target, scan_chunk=cfg.scan_chunk,
-                               **restore("CoCoA+"), **common)
+                               **cocoa_kw, **restore("CoCoA+"), **common)
     finish(traj, w, alpha)
 
     w, alpha, traj = run_cocoa(ds, params, debug, plus=False,
-                               gap_target=gap_target, scan_chunk=cfg.scan_chunk,
-                               **restore("CoCoA"), **common)
+                               **cocoa_kw, **restore("CoCoA"), **common)
     finish(traj, w, alpha)
 
     if not cfg.just_cocoa:  # hingeDriver.scala:93-110

@@ -69,6 +69,13 @@ class RunConfig:
                                  #   decorrelated across shards (improvement)
     scan_chunk: int = 0          # >0: run rounds device-side in lax.scan blocks
                                  # of this size (one dispatch per block)
+    math: str = "exact"          # "exact": reference-order float ops (bit-
+                                 #   matchable vs the oracle in x64);
+                                 # "fast": margins decomposition — one MXU
+                                 #   matvec per round + incremental Δw dots,
+                                 #   auto-Pallas inner loop on TPU (CoCoA only)
+    device_loop: bool = False    # run the whole train loop (incl. gap-target
+                                 # early stop) as one on-device while_loop
     mesh_shape: Optional[tuple] = None  # (dp,) or (dp, fp); None = (num_splits,)
     loss: str = "hinge"
 

@@ -150,7 +150,9 @@ def shard_dataset(
     np_dtype = np.dtype(dtype)
     sizes = split_sizes(n, k)
     offsets = np.concatenate([[0], np.cumsum(sizes)])
-    n_shard = int(sizes.max()) if k > 0 else 0
+    # pad shard length to a sublane multiple (8 f32 / 16 bf16) so Pallas row
+    # blocks and XLA tiles stay aligned; padded rows are masked everywhere
+    n_shard = -(-int(sizes.max()) // 16) * 16 if k > 0 else 0
 
     labels = np.zeros((k, n_shard), dtype=np_dtype)
     mask = np.zeros((k, n_shard), dtype=np_dtype)

@@ -123,13 +123,14 @@ def eval_metrics(
 
 
 @functools.lru_cache(maxsize=None)
-def _eval_metrics_fn(mesh, lam, n, has_alpha, has_test, test_n):
+def _eval_metrics_fn(mesh, lam, n, test_n):
+    # None arguments (no dual state / no test set) are empty pytrees — jit
+    # specializes on the pytree structure, no separate static flags needed
     @jax.jit
     def f(w, alpha, shard_arrays, test_shard_arrays):
         return eval_metrics(
-            w, alpha if has_alpha else None, shard_arrays, lam, n, mesh=mesh,
-            test_shard_arrays=test_shard_arrays if has_test else None,
-            test_n=test_n,
+            w, alpha, shard_arrays, lam, n, mesh=mesh,
+            test_shard_arrays=test_shard_arrays, test_n=test_n,
         )
 
     return f
@@ -143,12 +144,12 @@ def evaluate(ds: ShardedDataset, w, alpha, lam, test_ds=None):
     import numpy as np
 
     f = _eval_metrics_fn(
-        mesh_of(ds.labels), float(lam), ds.n, alpha is not None,
-        test_ds is not None, test_ds.n if test_ds is not None else 0,
+        mesh_of(ds.labels), float(lam), ds.n,
+        test_ds.n if test_ds is not None else 0,
     )
     out = np.asarray(f(
-        w, w if alpha is None else alpha, ds.shard_arrays(),
-        ds.shard_arrays() if test_ds is None else test_ds.shard_arrays(),
+        w, alpha, ds.shard_arrays(),
+        None if test_ds is None else test_ds.shard_arrays(),
     ))
     primal, gap, test_err = (float(v) for v in out)
     return (

@@ -3,9 +3,9 @@
 The H coordinate steps of local SDCA are inherently sequential
 (CoCoA.scala:148-188); under plain XLA each step pays HBM round-trips for
 the row gather and the Δw update.  This kernel keeps the hot state — the Δw
-accumulator and the shard's α/labels/‖x‖²/margins vectors — resident in VMEM
-across all H steps and lets Pallas's grid pipeline prefetch each sampled row
-HBM→VMEM (double-buffered) while the previous step computes.
+accumulator and the shard's α vector — resident in VMEM scratch across all
+H steps and lets Pallas's grid pipeline prefetch each sampled row HBM→VMEM
+(double-buffered) while the previous step computes.
 
 Uses the margins decomposition (ops/local_sdca.py ``mode_factors``): the
 per-step margin is ``margins0[idx] + sig_eff·(x·Δw)`` with margins0 = X·w₀
@@ -13,13 +13,25 @@ precomputed outside the kernel as one MXU matvec per round.  Per grid step
 the kernel does one (1, d) VPU dot, scalar box-projection logic, one (1, d)
 axpy, and a masked α write.
 
-Grid is (K, H): shard-major, steps inner.  Output blocks (Δw row, α row)
-map to the shard index only, so Pallas keeps them in VMEM across the H
-inner steps and flushes to HBM once per shard — the classic revisited-block
-reduction pattern.
+Grid is (K, H): shard-major, steps inner (TPU grids execute sequentially
+with the last dimension fastest, which is exactly the dependency order).
+
+Mosaic alignment: block shapes must have a second-to-last dim that is a
+multiple of the sublane count (8 for f32) or the full axis.  So:
+
+- the sampled row is DMA'd as an 8-row-aligned ``(1, 8, d)`` block at row
+  ``(idx//8)*8`` (index map returns block index ``idx//8``) and the kernel
+  selects row ``idx % 8`` with an iota mask — shards are padded to a
+  multiple of 16 rows by ``shard_dataset`` so aligned blocks never overrun;
+- the per-shard vectors (margins0/labels/‖x‖²/α) and both outputs use
+  full-array blocks (full axes are always legal) with constant index maps,
+  so they load into VMEM once and outputs flush to HBM once at the end;
+- the mutable per-shard state lives in ``(1, n)`` / ``(1, d)`` VMEM scratch,
+  initialised at each shard's first step and written back to the output
+  blocks (row-masked) at its last step.
 
 Sampled indices arrive via ``PrefetchScalarGridSpec`` so the row BlockSpec's
-index_map can address X[k, idxs[k, i]] ahead of the compute.
+index_map can address X[k, idxs[k, i]//8 ...] ahead of the compute.
 """
 
 from __future__ import annotations
@@ -34,46 +46,83 @@ from jax.experimental.pallas import tpu as pltpu
 from cocoa_tpu.ops.local_sdca import mode_factors
 
 
+def row_block_for(dtype) -> int:
+    """Sublane count for the aligned row block.  2-byte dtypes are rejected:
+    bf16 SDCA can't certify a 1e-4 duality gap anyway, and the kernel's
+    dynamic sublane slices fail Mosaic lowering under 16-sublane tiling (use
+    the fori_loop path, which handles bf16).  f32 is the TPU path; f64 works
+    in interpret mode (the x64 validation tests)."""
+    if jnp.dtype(dtype).itemsize < 4:
+        raise ValueError(
+            f"the Pallas SDCA kernel does not support 2-byte dtypes, got "
+            f"{jnp.dtype(dtype).name}; use math='fast' without pallas"
+        )
+    return 8
+
+
 def _kernel(
     idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
-    x_ref,           # (1, 1, d) VMEM: the sampled row (auto-DMA'd per step)
-    margins0_ref,    # (1, n) VMEM
-    labels_ref,      # (1, n) VMEM
-    sqn_ref,         # (1, n) VMEM
-    alpha_in_ref,    # (1, n) VMEM
-    dw_ref,          # out (1, d) VMEM, revisited across the H inner steps
-    alpha_ref,       # out (1, n) VMEM, revisited
+    x_ref,           # (1, row_block, d) VMEM: aligned block holding the sample
+    margins0_ref,    # (K, n) VMEM (full array)
+    labels_ref,      # (K, n) VMEM
+    sqn_ref,         # (K, n) VMEM
+    alpha_in_ref,    # (K, n) VMEM
+    dw_ref,          # out (K, d) VMEM (full array, flushed once)
+    alpha_ref,       # out (K, n) VMEM (full array, flushed once)
+    dw_acc,          # scratch (1, d) VMEM: this shard's Δw accumulator
+    alpha_sc,        # scratch (1, n) VMEM: this shard's advancing α
+    vec_sc,          # scratch (3, n) VMEM: this shard's labels/‖x‖²/margins0
     *,
     lam_n: float,
     sig_eff: float,
     qii_factor: float,
     frozen: bool,
+    h: int,
+    row_block: int,
 ):
+    k_ = pl.program_id(0)
     i = pl.program_id(1)
-    idx = idxs_ref[pl.program_id(0), i]
+    idx = idxs_ref[k_, i]
 
-    @pl.when(i == 0)
-    def _init():
+    n = alpha_sc.shape[1]
+    k_total = alpha_ref.shape[0]
+    krow = jax.lax.broadcasted_iota(jnp.int32, (k_total, 1), 0) == k_
+
+    @pl.when(jnp.logical_and(k_ == 0, i == 0))
+    def _init_outputs():
         dw_ref[...] = jnp.zeros_like(dw_ref)
         alpha_ref[...] = alpha_in_ref[...]
 
-    n = alpha_ref.shape[1]
+    @pl.when(i == 0)
+    def _init_shard():
+        dw_acc[...] = jnp.zeros_like(dw_acc)
+        # copy this shard's rows into scratch (dynamic sublane slice) so the
+        # per-step scalar picks reduce over n elements, not K·n
+        alpha_sc[...] = alpha_in_ref[pl.ds(k_, 1), :]
+        vec_sc[0:1, :] = labels_ref[pl.ds(k_, 1), :]
+        vec_sc[1:2, :] = sqn_ref[pl.ds(k_, 1), :]
+        vec_sc[2:3, :] = margins0_ref[pl.ds(k_, 1), :]
+
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
     sel = lane == idx
 
-    def pick(ref):
-        return jnp.sum(jnp.where(sel, ref[...], 0.0))
+    def pick(row):
+        """Scalar vec[idx] via a lane-idx mask reduce (dynamic lane index)."""
+        return jnp.sum(jnp.where(sel, row, 0.0))
 
-    y = pick(labels_ref)
-    a = pick(alpha_ref)
-    sq = pick(sqn_ref)
-    m0 = pick(margins0_ref)
+    y = pick(vec_sc[0:1, :])
+    sq = pick(vec_sc[1:2, :])
+    m0 = pick(vec_sc[2:3, :])
+    a = pick(alpha_sc[...])
 
-    x = x_ref[0]                      # (1, d)
+    # select row idx % row_block of the aligned block (dynamic sublane slice)
+    sub = idx - (idx // row_block) * row_block
+    x = x_ref[0, pl.ds(sub, 1), :]
+
     if frozen:
         margin = m0
     else:
-        xdw = jnp.sum(x * dw_ref[...])
+        xdw = jnp.sum(x * dw_acc[...])
         margin = m0 + sig_eff * xdw
     grad = (y * margin - 1.0) * lam_n
 
@@ -89,8 +138,13 @@ def _kernel(
     new_a = jnp.where(proj_grad != 0.0, new_a, a)
 
     coef = y * (new_a - a) / lam_n
-    dw_ref[...] = dw_ref[...] + coef * x
-    alpha_ref[...] = jnp.where(sel, new_a, alpha_ref[...])
+    dw_acc[...] = dw_acc[...] + coef * x
+    alpha_sc[...] = jnp.where(sel, new_a, alpha_sc[...])
+
+    @pl.when(i == h - 1)
+    def _flush_shard():
+        dw_ref[...] = jnp.where(krow, dw_acc[...], dw_ref[...])
+        alpha_ref[...] = jnp.where(krow, alpha_sc[...], alpha_ref[...])
 
 
 @functools.partial(
@@ -114,13 +168,19 @@ def pallas_sdca_round(
     dw (K, d) unreduced per-shard updates; alpha_inner (K, n_shard) the
     locally-advanced alpha (callers apply the outer scaling law).
 
-    Inside ``shard_map`` this must run under ``check_vma=False`` (the
-    chunked driver does; pallas_call's internal slices confuse the VMA
-    checker)."""
+    Requires n_shard % 8 == 0 (shard_dataset pads to 16).  Inside
+    ``shard_map`` this must run under ``check_vma=False`` (the chunked
+    driver does; pallas_call's internal slices confuse the VMA checker)."""
     k, n_shard, d = X.shape
     h = idxs.shape[1]
-    sig_eff, qii_factor = mode_factors(mode, sigma)
     dtype = X.dtype
+    row_block = row_block_for(dtype)
+    if n_shard % row_block != 0:
+        raise ValueError(
+            f"n_shard must be a multiple of {row_block} for the aligned row "
+            f"blocks ({dtype}), got {n_shard} (shard_dataset pads to 16)"
+        )
+    sig_eff, qii_factor = mode_factors(mode, sigma)
 
     kernel = functools.partial(
         _kernel,
@@ -128,22 +188,33 @@ def pallas_sdca_round(
         sig_eff=float(sig_eff),
         qii_factor=float(qii_factor),
         frozen=(mode == "frozen"),
+        h=h,
+        row_block=row_block,
     )
 
+    full = lambda k_, i_, idxs_: (0, 0)  # noqa: E731 — full-array block
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(k, h),
         in_specs=[
-            # the sampled row: block (1,1,d) at [k, idxs[k,i], :]
-            pl.BlockSpec((1, 1, d), lambda k_, i_, idxs_: (k_, idxs_[k_, i_], 0)),
-            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
-            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
-            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
-            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
+            # the sampled row: sublane-aligned block at [k, idx//rb*rb, :]
+            pl.BlockSpec(
+                (1, row_block, d),
+                lambda k_, i_, idxs_: (k_, idxs_[k_, i_] // row_block, 0),
+            ),
+            pl.BlockSpec((k, n_shard), full),
+            pl.BlockSpec((k, n_shard), full),
+            pl.BlockSpec((k, n_shard), full),
+            pl.BlockSpec((k, n_shard), full),
         ],
         out_specs=[
-            pl.BlockSpec((1, d), lambda k_, i_, idxs_: (k_, 0)),
-            pl.BlockSpec((1, n_shard), lambda k_, i_, idxs_: (k_, 0)),
+            pl.BlockSpec((k, d), full),
+            pl.BlockSpec((k, n_shard), full),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, d), dtype),
+            pltpu.VMEM((1, n_shard), dtype),
+            pltpu.VMEM((3, n_shard), dtype),
         ],
     )
 
@@ -154,6 +225,9 @@ def pallas_sdca_round(
             jax.ShapeDtypeStruct((k, d), dtype),
             jax.ShapeDtypeStruct((k, n_shard), dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
         interpret=interpret,
     )(idxs, X, w_margins0, labels, sq_norms, alpha)
     return dw, alpha_inner

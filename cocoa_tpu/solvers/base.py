@@ -126,8 +126,12 @@ def drive_chunked(
 
 _DEVICE_RUNS: dict = {}
 
+# cap on the resident (n_chunks, C, K, H) int32 index table per device-loop
+# dispatch; runs needing more split into super-blocks (tests shrink this)
+MAX_IDX_TABLE_BYTES = 256 << 20
 
-def _build_device_run(chunk_kernel, eval_kernel, n_chunks, gap_target, n_state,
+
+def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                       mesh=None):
     import functools
 
@@ -140,6 +144,8 @@ def _build_device_run(chunk_kernel, eval_kernel, n_chunks, gap_target, n_state,
     def run(*args):
         state = args[:n_state]
         idxs_all, shard_arrays, test_arrays = args[n_state:]
+        # static at trace time; a different block length just retraces
+        n_chunks = idxs_all.shape[0]
 
         def cond(s):
             i, done, state, traj = s
@@ -172,7 +178,6 @@ def _build_device_run(chunk_kernel, eval_kernel, n_chunks, gap_target, n_state,
 
 def drive_on_device(
     name: str,
-    debug: DebugParams,
     state: tuple,
     chunk_kernel: Callable,   # (state, idxs_ckh, shard_arrays) -> state, traceable
     eval_kernel: Callable,    # (state, shard_arrays, test_arrays) -> (3,) metrics
@@ -211,14 +216,14 @@ def drive_on_device(
     given, the built jit executable is reused across calls — without it every
     call re-jits (closures have fresh identity) and pays ~1s of recompile.
     """
-    n_chunks, c = int(idxs_all.shape[0]), int(idxs_all.shape[1])
+    c = int(idxs_all.shape[1])
     tgt = gap_target
     n_state = len(state)
 
     run = _DEVICE_RUNS.get(cache_key) if cache_key is not None else None
     if run is None:
         run = _build_device_run(
-            chunk_kernel, eval_kernel, n_chunks, tgt, n_state, mesh=mesh
+            chunk_kernel, eval_kernel, tgt, n_state, mesh=mesh
         )
         if cache_key is not None:
             _DEVICE_RUNS[cache_key] = run
@@ -235,8 +240,124 @@ def drive_on_device(
         traj.log_round(
             end, primal=primal, gap=gap,
             test_error=None if np.isnan(test_err) else test_err,
+            # per-round wall-clock is unobservable here: the whole run is one
+            # dispatch and one fetch — don't fabricate flat timestamps
+            wall_time=None,
         )
     return state, traj
+
+
+def drive_device_full(
+    name: str,
+    params: Params,
+    debug: DebugParams,
+    state: tuple,
+    chunk_kernel: Callable,   # (state, idxs_ckh, shard_arrays) -> state
+    eval_kernel: Callable,    # (state, shard_arrays, test_arrays) -> (3,)
+    chunk_fn: Callable,       # (t0, c, state) -> state, host-stepped (jitted)
+    eval_fn: Callable,        # (state) -> (primal, gap|None, test_err|None)
+    sampler: "IndexSampler",
+    shard_arrays,
+    test_arrays=None,
+    quiet: bool = False,
+    gap_target: Optional[float] = None,
+    start_round: int = 1,
+    cache_key=None,
+    mesh=None,
+):
+    """Cadence-aligned wrapper around :func:`drive_on_device`, usable by any
+    solver whose round has the (state, idxs, shards) shape: host-steps the
+    off-cadence head (a resumed ``start_round`` is usually not on a
+    ``debugIter`` boundary), rides all full eval-cadence chunks device-side
+    as one dispatch, then host-steps the sub-cadence tail (num_rounds %
+    debugIter remainder, no eval — same observable behavior as
+    :func:`drive_chunked`).  Returns (state, Trajectory)."""
+    if debug.debug_iter <= 0:
+        raise ValueError(
+            "the device loop requires debug_iter > 0 (the eval cadence is "
+            "its chunk axis)"
+        )
+    if debug.chkpt_dir and debug.chkpt_iter > 0:
+        raise ValueError(
+            "the device loop cannot checkpoint (host-side by nature); use "
+            "the chunked driver for checkpointed runs"
+        )
+    c = debug.debug_iter
+    traj = Trajectory(name, quiet=quiet)
+
+    def hit_target():
+        return (
+            gap_target is not None and traj.records
+            and traj.records[-1].gap is not None
+            and traj.records[-1].gap <= gap_target
+        )
+
+    t = start_round
+    # head: advance to the absolute debugIter boundary so eval rounds stay
+    # anchored to t % debugIter == 0 exactly like the host drivers
+    head_end = min(params.num_rounds, ((t - 1) // c + 1) * c)
+    if (t - 1) % c != 0 and head_end >= t:
+        state = chunk_fn(t, head_end - t + 1, state)
+        t = head_end + 1
+        if head_end % c == 0:
+            primal, gap, test_err = eval_fn(state)
+            traj.log_round(head_end, primal=primal, gap=gap,
+                           test_error=test_err)
+
+    n_full = max(0, (params.num_rounds - (t - 1)) // c)
+    if n_full > 0 and not hit_target():
+        # bound the resident index table: one (n_chunks, C, K, H) int32 array
+        # per dispatch.  With localIterFrac=1, H = n/K, so a whole-run table
+        # is num_rounds × n ints — a memory cliff the chunked driver doesn't
+        # have.  Split into equal super-blocks of at most ~256 MB of indices;
+        # the early-stop test between blocks costs one host sync per block.
+        k = int(np.atleast_1d(sampler.counts).shape[0])
+        chunk_ints = c * k * sampler.h
+        max_block = max(1, MAX_IDX_TABLE_BYTES // (4 * chunk_ints))
+        n_blocks = -(-n_full // max_block)
+        per_block = -(-n_full // n_blocks)  # equal sizes → one executable
+        done = t - 1
+        while done < t - 1 + n_full * c and not hit_target():
+            b = min(per_block, (t - 1 + n_full * c - done) // c)
+            flat = sampler.chunk_indices(done + 1, b * c)
+            idxs_all = flat.reshape(b, c, *flat.shape[1:])
+            state, dev_traj = drive_on_device(
+                name, state, chunk_kernel, eval_kernel, idxs_all,
+                shard_arrays, test_arrays, quiet=quiet, gap_target=gap_target,
+                start_round=done + 1, cache_key=cache_key, mesh=mesh,
+            )
+            traj.records.extend(dev_traj.records)
+            done += b * c
+        t = done + 1
+
+    rem = params.num_rounds - (t - 1)
+    if rem > 0 and not hit_target():
+        # sub-cadence tail: run it, no eval (off the debugIter cadence)
+        state = chunk_fn(t, rem, state)
+    return state, traj
+
+
+def align_alpha(alpha_init, ds: ShardedDataset, dtype):
+    """(K, n_shard) alpha from a restored ``alpha_init``, zero-padding the
+    shard axis when the checkpoint predates a larger padded ``n_shard``
+    (rows ≥ counts[k] are never sampled, so zero padding is exact).  A clear
+    error beats the opaque XLA shape mismatch it would otherwise hit."""
+    import jax.numpy as jnp
+
+    a = jnp.array(alpha_init, dtype=dtype, copy=True)
+    if a.ndim != 2 or a.shape[0] != ds.k:
+        raise ValueError(
+            f"alpha_init shape {a.shape} is incompatible with K={ds.k} shards"
+        )
+    if a.shape[1] < int(ds.counts.max()) or a.shape[1] > ds.n_shard:
+        raise ValueError(
+            f"alpha_init has {a.shape[1]} rows per shard but the dataset "
+            f"shards to counts={ds.counts.tolist()} (n_shard={ds.n_shard}) — "
+            f"was the checkpoint written with different data or numSplits?"
+        )
+    if a.shape[1] < ds.n_shard:
+        a = jnp.pad(a, ((0, 0), (0, ds.n_shard - a.shape[1])))
+    return a
 
 
 def check_shards(ds: ShardedDataset) -> None:

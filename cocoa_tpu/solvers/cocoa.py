@@ -226,7 +226,7 @@ def run_cocoa(
     alpha = (
         jnp.zeros((k, ds.n_shard), dtype=dtype)
         if alpha_init is None
-        else jnp.array(alpha_init, dtype=dtype, copy=True)
+        else base.align_alpha(alpha_init, ds, dtype)
     )
     if mesh is not None:
         from cocoa_tpu.parallel.mesh import replicated, sharded_rows
@@ -236,11 +236,15 @@ def run_cocoa(
 
     platform = jax.devices()[0].platform
     if pallas is None:
-        # auto-selection is OFF until the kernel's Mosaic block mappings are
-        # reworked: real-TPU lowering rejects the current single-row block
-        # specs (second-to-last block dim must be a multiple of 8 or the full
-        # axis).  Interpret-mode (CPU) remains available via pallas=True.
-        pallas = False
+        # auto: the Pallas kernel needs fast math + dense layout + f32 + a
+        # real TPU backend (measured ~20% faster than the fori_loop path on
+        # the demo config; the gap widens with shard size as the row DMA
+        # pipeline hides HBM latency)
+        pallas = (
+            math == "fast" and ds.layout == "dense"
+            and jnp.dtype(dtype).itemsize == 4
+            and platform in ("tpu", "axon")
+        )
     if pallas and ds.layout != "dense":
         raise ValueError("the Pallas SDCA kernel requires layout='dense'")
     if pallas and math != "fast":
@@ -268,16 +272,6 @@ def run_cocoa(
         return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds)
 
     if device_loop:
-        if debug.debug_iter <= 0:
-            raise ValueError(
-                "device_loop requires debug_iter > 0 (the eval cadence is "
-                "the device loop's chunk axis)"
-            )
-        if debug.chkpt_dir and debug.chkpt_iter > 0:
-            raise ValueError(
-                "device_loop cannot checkpoint (host-side by nature); use "
-                "scan_chunk for checkpointed runs"
-            )
         raw_kernel = _make_chunk_kernel(mesh, params, k, plus, **parts_kw)
 
         def chunk_kernel(state, idxs_ckh, shard_arrays):
@@ -293,59 +287,25 @@ def run_cocoa(
                 test_shard_arrays=test_arrays, test_n=test_n,
             )
 
-        from cocoa_tpu.utils.logging import Trajectory
+        chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
 
-        c = debug.debug_iter
-        traj = Trajectory(alg, quiet=quiet)
-        stopped = False
-        t = start_round
-        # head: advance to the absolute debugIter boundary so eval rounds are
-        # anchored to t % debugIter == 0 exactly like the host drivers (a
-        # resumed start_round is usually off-cadence)
-        head_end = min(params.num_rounds, ((t - 1) // c + 1) * c)
-        if (t - 1) % c != 0 and head_end >= t:
-            chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
-            w, alpha = chunk_step(
-                w, alpha, sampler.chunk_indices(t, head_end - t + 1),
-                shard_arrays,
-            )
-            t = head_end + 1
-            if head_end % c == 0:
-                primal, gap, test_err = eval_fn((w, alpha))
-                traj.log_round(head_end, primal=primal, gap=gap,
-                               test_error=test_err)
-                stopped = gap_target is not None and gap <= gap_target
+        def chunk_fn(t0, c, state):
+            w, alpha = state
+            return chunk_step(w, alpha, sampler.chunk_indices(t0, c),
+                              shard_arrays)
 
-        n_full = max(0, (params.num_rounds - (t - 1)) // c)
-        if n_full > 0 and not stopped:
-            flat = sampler.chunk_indices(t, n_full * c)
-            idxs_all = flat.reshape(n_full, c, *flat.shape[1:])
-            cache_key = (
-                "cocoa", plus, math, pallas, k, mesh,
-                params.lam, params.n, params.local_iters, params.beta,
-                params.gamma, c, n_full, gap_target, test_n, ds.layout,
-                str(dtype),
-            )
-            (w, alpha), dev_traj = base.drive_on_device(
-                alg, debug, (w, alpha), chunk_kernel, eval_kernel,
-                idxs_all, shard_arrays, test_arrays,
-                quiet=quiet, gap_target=gap_target, start_round=t,
-                cache_key=cache_key, mesh=mesh,
-            )
-            traj.records.extend(dev_traj.records)
-            t += n_full * c
-            stopped = (
-                gap_target is not None and traj.records
-                and traj.records[-1].gap is not None
-                and traj.records[-1].gap <= gap_target
-            )
-        rem = params.num_rounds - (t - 1)
-        if rem > 0 and not stopped:
-            # finish the sub-cadence tail exactly as drive_chunked would:
-            # run it, no eval (num_rounds is off the debugIter cadence)
-            chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
-            idxs_rem = sampler.chunk_indices(t, rem)
-            w, alpha = chunk_step(w, alpha, idxs_rem, shard_arrays)
+        cache_key = (
+            "cocoa", plus, math, pallas, k, mesh,
+            params.lam, params.n, params.local_iters, params.beta,
+            params.gamma, params.num_rounds, debug.debug_iter, start_round,
+            gap_target, test_n, ds.layout, str(dtype),
+        )
+        (w, alpha), traj = base.drive_device_full(
+            alg, params, debug, (w, alpha), chunk_kernel, eval_kernel,
+            chunk_fn, eval_fn, sampler, shard_arrays, test_arrays,
+            quiet=quiet, gap_target=gap_target, start_round=start_round,
+            cache_key=cache_key, mesh=mesh,
+        )
         return w, alpha, traj
 
     if scan_chunk > 0:

@@ -63,7 +63,7 @@ def run_minibatch_cd(
     alpha = (
         jnp.zeros((k, ds.n_shard), dtype=dtype)
         if alpha_init is None
-        else jnp.array(alpha_init, dtype=dtype, copy=True)
+        else base.align_alpha(alpha_init, ds, dtype)
     )
     if mesh is not None:
         from cocoa_tpu.parallel.mesh import replicated, sharded_rows

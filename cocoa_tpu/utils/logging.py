@@ -19,7 +19,9 @@ from typing import Optional
 @dataclasses.dataclass
 class RoundRecord:
     round: int
-    wall_time: float          # seconds since run start
+    wall_time: Optional[float]  # seconds since run start; None when per-round
+                                # timing is unobservable (device-resident loop
+                                # fetches the whole trajectory in one sync)
     primal: Optional[float] = None
     gap: Optional[float] = None
     test_error: Optional[float] = None
@@ -38,11 +40,16 @@ class Trajectory:
     def elapsed(self) -> float:
         return time.perf_counter() - self._t0
 
-    def log_round(self, t, primal=None, gap=None, test_error=None):
+    _STAMP = object()  # sentinel: stamp elapsed() unless overridden
+
+    def log_round(self, t, primal=None, gap=None, test_error=None,
+                  wall_time=_STAMP):
+        """``wall_time=None`` marks the round's timing as unobservable (the
+        device-resident driver syncs once for the whole run)."""
         self.records.append(
             RoundRecord(
                 round=t,
-                wall_time=self.elapsed(),
+                wall_time=self.elapsed() if wall_time is self._STAMP else wall_time,
                 primal=primal,
                 gap=gap,
                 test_error=test_error,

@@ -19,12 +19,15 @@ def test_split_sizes_balanced():
 def test_dense_shards_contiguous(tiny_data):
     ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=np.float64)
     assert ds.layout == "dense"
-    assert ds.X.shape == (4, 24, tiny_data.num_features)
+    # 96 rows / 4 shards = 24 each, padded to the 16-row sublane multiple
+    assert ds.X.shape == (4, 32, tiny_data.num_features)
     dense = tiny_data.to_dense()
-    # shard 1 holds rows 24..48 in order
-    np.testing.assert_allclose(np.asarray(ds.X[1]), dense[24:48])
-    np.testing.assert_allclose(np.asarray(ds.labels[1]), tiny_data.labels[24:48])
-    np.testing.assert_allclose(np.asarray(ds.mask), 1.0)
+    # shard 1 holds rows 24..48 in order (then padding)
+    np.testing.assert_allclose(np.asarray(ds.X[1, :24]), dense[24:48])
+    np.testing.assert_allclose(np.asarray(ds.labels[1, :24]), tiny_data.labels[24:48])
+    np.testing.assert_allclose(np.asarray(ds.X[1, 24:]), 0.0)
+    np.testing.assert_allclose(np.asarray(ds.mask[:, :24]), 1.0)
+    np.testing.assert_allclose(np.asarray(ds.mask[:, 24:]), 0.0)
 
 
 def test_sparse_dense_same_semantics(tiny_data):
@@ -44,13 +47,14 @@ def test_sparse_dense_same_semantics(tiny_data):
 
 
 def test_padding_and_sq_norms(tiny_data):
-    # 96 rows over 5 shards → sizes [20,19,19,19,19], padded to 20
+    # 96 rows over 5 shards → sizes [20,19,19,19,19], padded to the 16-row
+    # sublane multiple (32)
     ds = shard_dataset(tiny_data, k=5, layout="dense", dtype=np.float64)
     assert ds.counts.tolist() == [20, 19, 19, 19, 19]
-    assert ds.n_shard == 20
+    assert ds.n_shard == 32
     m = np.asarray(ds.mask)
-    assert np.all(m[1:, 19] == 0.0)
-    assert np.all(np.asarray(ds.X)[1:, 19] == 0.0)
+    assert np.all(m[1:, 19:] == 0.0)
+    assert np.all(np.asarray(ds.X)[1:, 19:] == 0.0)
     dense = tiny_data.to_dense()
     np.testing.assert_allclose(
         np.asarray(ds.sq_norms[0, :20]),
@@ -71,7 +75,7 @@ def test_mesh_placement(tiny_data):
     assert len(ds.X.sharding.device_set) == 4
     # each device holds exactly its shard
     shard_shapes = {s.data.shape for s in ds.X.addressable_shards}
-    assert shard_shapes == {(1, 24, tiny_data.num_features)}
+    assert shard_shapes == {(1, 32, tiny_data.num_features)}
 
 
 def test_make_mesh_too_many_devices():

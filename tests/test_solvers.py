@@ -287,6 +287,39 @@ def test_device_loop_off_cadence_resume(tiny_data):
     np.testing.assert_allclose(np.asarray(a_d), np.asarray(a_h), atol=0)
 
 
+def test_device_loop_super_blocks_equal_single_dispatch(tiny_data, monkeypatch):
+    """When the run's index table exceeds MAX_IDX_TABLE_BYTES the device loop
+    splits into multiple dispatches (bounding device memory); trajectory and
+    final state must be identical, including uneven last blocks and an
+    early-stop inside a block."""
+    from cocoa_tpu.solvers import base
+
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=10)
+    d = _debug(debug_iter=2)
+    w_one, a_one, tr_one = run_cocoa(ds, p, d, plus=True, quiet=True,
+                                     device_loop=True)
+    # force ~2-chunk super-blocks → blocks of 2,2,1 chunks
+    monkeypatch.setattr(base, "MAX_IDX_TABLE_BYTES",
+                        4 * 2 * d.debug_iter * 4 * p.local_iters)
+    base._DEVICE_RUNS.clear()
+    w_b, a_b, tr_b = run_cocoa(ds, p, d, plus=True, quiet=True,
+                               device_loop=True)
+    np.testing.assert_allclose(np.asarray(w_b), np.asarray(w_one), atol=0)
+    np.testing.assert_allclose(np.asarray(a_b), np.asarray(a_one), atol=0)
+    assert [r.round for r in tr_b.records] == [r.round for r in tr_one.records]
+    for r1, rb in zip(tr_one.records, tr_b.records):
+        assert abs(r1.gap - rb.gap) < 1e-12
+    # early stop inside the second super-block stops at the host round
+    target = float(tr_one.records[2].gap) + 1e-15
+    _, _, tr_h = run_cocoa(ds, p, d, plus=True, quiet=True, gap_target=target)
+    base._DEVICE_RUNS.clear()
+    _, _, tr_s = run_cocoa(ds, p, d, plus=True, quiet=True, gap_target=target,
+                           device_loop=True)
+    assert tr_s.records[-1].round == tr_h.records[-1].round
+    base._DEVICE_RUNS.clear()
+
+
 def test_device_loop_gap_target_early_stop(tiny_data):
     """Device-side early stop halts at the same round the host driver does."""
     ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
