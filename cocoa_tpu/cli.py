@@ -13,7 +13,10 @@ TPU-native additions (no reference analogue): ``--dtype``, ``--layout``,
 trajectory dump), ``--gapTarget`` (early stop on duality gap), ``--math``
 (exact | fast: margins-decomposition inner loop with auto-Pallas on TPU,
 CoCoA/CoCoA+ only), ``--deviceLoop`` (whole train loop as one on-device
-while_loop; incompatible with checkpointing).
+while_loop; incompatible with checkpointing), ``--loss``
+(hinge | smooth_hinge | logistic — all solvers and the duality-gap
+certificate generalize; see ops/losses.py) and ``--smoothing`` (the
+smooth_hinge parameter s).
 """
 
 from __future__ import annotations
@@ -30,14 +33,15 @@ from cocoa_tpu.evals import objectives
 from cocoa_tpu.parallel import make_mesh
 from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
 
-_TPU_FLAGS = ("dtype", "layout", "rng", "math")  # same-named RunConfig fields
+_TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
+              "smoothing")  # same-named RunConfig fields
 _EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
                "debug_iter", "seed"}
-_FLOAT_FIELDS = {"lam", "local_iter_frac", "beta", "gamma"}
+_FLOAT_FIELDS = {"lam", "local_iter_frac", "beta", "gamma", "smoothing"}
 
 
 def parse_args(argv: list[str]):
@@ -88,6 +92,20 @@ def main(argv=None) -> int:
         return 2
     if cfg.num_features <= 0:
         print("error: --numFeatures must be positive", file=sys.stderr)
+        return 2
+    from cocoa_tpu.ops import losses as losses_mod
+
+    if cfg.loss not in losses_mod.LOSSES:
+        print(f"error: --loss must be one of {'|'.join(losses_mod.LOSSES)}, "
+              f"got {cfg.loss!r}", file=sys.stderr)
+        return 2
+    if cfg.loss == "smooth_hinge" and cfg.smoothing <= 0:
+        print(f"error: --smoothing must be > 0 for smooth_hinge, got "
+              f"{cfg.smoothing}", file=sys.stderr)
+        return 2
+    if cfg.math not in ("exact", "fast"):
+        print(f"error: --math must be exact|fast, got {cfg.math!r}",
+              file=sys.stderr)
         return 2
 
     # echo flags, as the reference does (hingeDriver.scala:41-48) — with its
@@ -165,9 +183,11 @@ def main(argv=None) -> int:
         return out
 
     def finish(traj, w, alpha=None):
-        primal = objectives.primal_objective(ds, w, params.lam)
+        primal = objectives.primal_objective(ds, w, params.lam,
+                                             params.loss, params.smoothing)
         gap = (
-            primal - objectives.dual_objective(ds, w, alpha, params.lam)
+            primal - objectives.dual_objective(ds, w, alpha, params.lam,
+                                               params.loss, params.smoothing)
             if alpha is not None
             else None
         )

@@ -27,6 +27,8 @@ class Params:
     beta: float = 1.0           # update scaling; 1 = averaging (hingeDriver.scala:35)
     gamma: float = 1.0          # CoCoA+ aggregation; 1 = adding (hingeDriver.scala:36)
     loss: str = "hinge"         # "hinge" | "smooth_hinge" | "logistic" (extension)
+    smoothing: float = 1.0      # smooth_hinge smoothing parameter s (unused
+                                # by the other losses)
 
 
 @dataclasses.dataclass
@@ -78,6 +80,7 @@ class RunConfig:
                                  # early stop) as one on-device while_loop
     mesh_shape: Optional[tuple] = None  # (dp,) or (dp, fp); None = (num_splits,)
     loss: str = "hinge"
+    smoothing: float = 1.0
 
     def to_params(self, n: int, k: int) -> Params:
         """H = max(1, localIterFrac * n / K) as in hingeDriver.scala:70-71."""
@@ -90,6 +93,7 @@ class RunConfig:
             beta=self.beta,
             gamma=self.gamma,
             loss=self.loss,
+            smoothing=self.smoothing,
         )
 
     def to_debug(self, num_rounds: Optional[int] = None) -> DebugParams:

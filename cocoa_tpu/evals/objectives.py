@@ -25,15 +25,17 @@ import jax
 import jax.numpy as jnp
 
 from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.rows import shard_margins
 from cocoa_tpu.parallel.fanout import fanout, mesh_of
 
 
 @functools.lru_cache(maxsize=None)
-def _hinge_sum_fn(mesh):
+def _loss_sum_fn(mesh, loss, smoothing):
     def per_shard(w, shard):
-        hinge = jnp.maximum(1.0 - shard["labels"] * shard_margins(w, shard), 0.0)
-        return (jnp.sum(hinge * shard["mask"]),)
+        vals = losses.primal(loss, shard["labels"] * shard_margins(w, shard),
+                             smoothing=smoothing)
+        return (jnp.sum(vals * shard["mask"]),)
 
     @jax.jit
     def f(w, shard_arrays):
@@ -44,9 +46,10 @@ def _hinge_sum_fn(mesh):
 
 
 @functools.lru_cache(maxsize=None)
-def _alpha_sum_fn(mesh):
+def _dual_sum_fn(mesh, loss, smoothing):
     def per_shard(w, alpha_k, shard):
-        return (jnp.sum(alpha_k * shard["mask"]),)
+        return (jnp.sum(losses.dual_term(loss, alpha_k, smoothing=smoothing)
+                        * shard["mask"]),)
 
     @jax.jit
     def f(w, alpha, shard_arrays):
@@ -73,6 +76,7 @@ def _error_sum_fn(mesh):
 def eval_metrics(
     w, alpha, shard_arrays, lam, n, mesh=None,
     test_shard_arrays=None, test_n: int = 0,
+    loss: str = "hinge", smoothing: float = 1.0,
 ):
     """Jit-traceable fused evaluation: (primal, gap, test_error) as one
     stacked device array — a single fan-out over the training data (plus one
@@ -90,9 +94,12 @@ def eval_metrics(
 
         def per_shard(w, alpha_k, shard):
             margins = shard_margins(w, shard)
-            hinge = jnp.maximum(1.0 - shard["labels"] * margins, 0.0)
+            vals = losses.primal(loss, shard["labels"] * margins,
+                                 smoothing=smoothing)
+            dual_vals = losses.dual_term(loss, alpha_k, smoothing=smoothing)
             mask = shard["mask"]
-            return (jnp.stack([jnp.sum(hinge * mask), jnp.sum(alpha_k * mask)]),)
+            return (jnp.stack([jnp.sum(vals * mask),
+                               jnp.sum(dual_vals * mask)]),)
 
         (sums,) = fanout(per_shard, mesh, w, alpha, shard_arrays)
         primal = sums[0] / n + 0.5 * lam * w_norm_sq
@@ -102,11 +109,12 @@ def eval_metrics(
 
         def per_shard(w, shard):
             margins = shard_margins(w, shard)
-            hinge = jnp.maximum(1.0 - shard["labels"] * margins, 0.0)
-            return (jnp.sum(hinge * shard["mask"]),)
+            vals = losses.primal(loss, shard["labels"] * margins,
+                                 smoothing=smoothing)
+            return (jnp.sum(vals * shard["mask"]),)
 
-        (hinge_sum,) = fanout(per_shard, mesh, w, shard_arrays)
-        primal = hinge_sum / n + 0.5 * lam * w_norm_sq
+        (loss_sum,) = fanout(per_shard, mesh, w, shard_arrays)
+        primal = loss_sum / n + 0.5 * lam * w_norm_sq
         gap = jnp.asarray(jnp.nan, primal.dtype)
 
     if test_shard_arrays is not None:
@@ -123,7 +131,7 @@ def eval_metrics(
 
 
 @functools.lru_cache(maxsize=None)
-def _eval_metrics_fn(mesh, lam, n, test_n):
+def _eval_metrics_fn(mesh, lam, n, test_n, loss, smoothing):
     # None arguments (no dual state / no test set) are empty pytrees — jit
     # specializes on the pytree structure, no separate static flags needed
     @jax.jit
@@ -131,12 +139,14 @@ def _eval_metrics_fn(mesh, lam, n, test_n):
         return eval_metrics(
             w, alpha, shard_arrays, lam, n, mesh=mesh,
             test_shard_arrays=test_shard_arrays, test_n=test_n,
+            loss=loss, smoothing=smoothing,
         )
 
     return f
 
 
-def evaluate(ds: ShardedDataset, w, alpha, lam, test_ds=None):
+def evaluate(ds: ShardedDataset, w, alpha, lam, test_ds=None,
+             loss: str = "hinge", smoothing: float = 1.0):
     """Fused host-side eval: returns (primal, gap_or_None,
     test_error_or_None) with exactly ONE device→host transfer (a tunneled
     device costs ~90ms per fetch; the unfused path pays four).
@@ -146,6 +156,7 @@ def evaluate(ds: ShardedDataset, w, alpha, lam, test_ds=None):
     f = _eval_metrics_fn(
         mesh_of(ds.labels), float(lam), ds.n,
         test_ds.n if test_ds is not None else 0,
+        loss, float(smoothing),
     )
     out = np.asarray(f(
         w, alpha, ds.shard_arrays(),
@@ -159,19 +170,27 @@ def evaluate(ds: ShardedDataset, w, alpha, lam, test_ds=None):
     )
 
 
-def primal_objective(ds: ShardedDataset, w, lam) -> float:
-    hinge_sum = _hinge_sum_fn(mesh_of(ds.labels))(w, ds.shard_arrays())
-    return float(hinge_sum) / ds.n + 0.5 * lam * float(w @ w)
+def primal_objective(ds: ShardedDataset, w, lam, loss: str = "hinge",
+                     smoothing: float = 1.0) -> float:
+    loss_sum = _loss_sum_fn(mesh_of(ds.labels), loss, float(smoothing))(
+        w, ds.shard_arrays()
+    )
+    return float(loss_sum) / ds.n + 0.5 * lam * float(w @ w)
 
 
-def dual_objective(ds: ShardedDataset, w, alpha, lam) -> float:
+def dual_objective(ds: ShardedDataset, w, alpha, lam, loss: str = "hinge",
+                   smoothing: float = 1.0) -> float:
     """alpha: (K, n_shard) sharded dual variables."""
-    sum_alpha = _alpha_sum_fn(mesh_of(ds.labels))(w, alpha, ds.shard_arrays())
-    return -0.5 * lam * float(w @ w) + float(sum_alpha) / ds.n
+    dual_sum = _dual_sum_fn(mesh_of(ds.labels), loss, float(smoothing))(
+        w, alpha, ds.shard_arrays()
+    )
+    return -0.5 * lam * float(w @ w) + float(dual_sum) / ds.n
 
 
-def duality_gap(ds: ShardedDataset, w, alpha, lam) -> float:
-    return primal_objective(ds, w, lam) - dual_objective(ds, w, alpha, lam)
+def duality_gap(ds: ShardedDataset, w, alpha, lam, loss: str = "hinge",
+                smoothing: float = 1.0) -> float:
+    return (primal_objective(ds, w, lam, loss, smoothing)
+            - dual_objective(ds, w, alpha, lam, loss, smoothing))
 
 
 def classification_error(ds: ShardedDataset, w) -> float:

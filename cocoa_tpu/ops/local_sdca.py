@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.rows import get_row, row_axpy, row_dot
 
 MODES = ("cocoa", "plus", "frozen")
@@ -45,20 +46,23 @@ def local_sdca(
     n: int,                # GLOBAL example count (primal-dual correspondence)
     mode: str = "cocoa",
     sigma: float = 1.0,    # sigma' = K * gamma, used by mode=="plus"
+    loss: str = "hinge",
+    smoothing: float = 1.0,
 ):
     """Run H sequential SDCA steps.  Returns (delta_alpha, delta_w).
 
-    Matches the reference bit-for-bit in x64 given the same index sequence
-    (validated against tests/oracle.py).
+    With ``loss="hinge"`` matches the reference bit-for-bit in x64 given the
+    same index sequence (validated against tests/oracle.py); the dual-ascent
+    coordinate update for other losses comes from ops/losses.py.
     """
     if mode not in MODES:
         raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+    losses.validate(loss, smoothing)
     labels = shard["labels"]
     sq_norms = shard["sq_norms"]
     dtype = w_init.dtype
     lam_n = jnp.asarray(lam * n, dtype)
     sigma_c = jnp.asarray(sigma, dtype)
-    zero = jnp.asarray(0.0, dtype)
     one = jnp.asarray(1.0, dtype)
 
     def step(i, carry):
@@ -72,23 +76,10 @@ def local_sdca(
             margin = row_dot(row, w) + sigma_c * row_dot(row, dw)
         else:
             margin = row_dot(row, w)
-        grad = (y * margin - one) * lam_n
-
-        # projected gradient: clamp against the active box face
-        # (CoCoA.scala:166-170)
-        proj_grad = jnp.where(
-            a <= zero,
-            jnp.minimum(grad, zero),
-            jnp.where(a >= one, jnp.maximum(grad, zero), grad),
-        )
 
         qii = sq_norms[idx] * (sigma_c if mode == "plus" else one)
-        safe_qii = jnp.where(qii != zero, qii, one)
-        new_a = jnp.where(
-            qii != zero, jnp.clip(a - grad / safe_qii, zero, one), one
-        )
-        # no-op step when the projected gradient vanishes (CoCoA.scala:172)
-        new_a = jnp.where(proj_grad != zero, new_a, a)
+        new_a = losses.alpha_step(loss, a, y * margin, qii, lam_n,
+                                  smoothing=smoothing)
 
         coef = y * (new_a - a) / lam_n
         dw = row_axpy(row, coef, dw)
@@ -137,6 +128,8 @@ def local_sdca_fast(
                            # varying-axes type matches under shard_map
     mode: str = "cocoa",
     sigma: float = 1.0,
+    loss: str = "hinge",
+    smoothing: float = 1.0,
 ):
     """Fast-math variant of :func:`local_sdca`: the per-step w dot is
     replaced by the precomputed round margin plus an incremental Δw dot
@@ -147,6 +140,7 @@ def local_sdca_fast(
     The frozen mode skips the Δw dot entirely — its only sequential state is
     alpha itself.
     """
+    losses.validate(loss, smoothing)
     sig_eff, qii_factor = mode_factors(mode, sigma)
     labels = shard["labels"]
     sq_norms = shard["sq_norms"]
@@ -154,8 +148,6 @@ def local_sdca_fast(
     lam_n = jnp.asarray(lam * n, dtype)
     sig_c = jnp.asarray(sig_eff, dtype)
     qf = jnp.asarray(qii_factor, dtype)
-    zero = jnp.asarray(0.0, dtype)
-    one = jnp.asarray(1.0, dtype)
 
     def step(i, carry):
         dw, a_vec = carry
@@ -167,19 +159,9 @@ def local_sdca_fast(
         margin = margins0[idx]
         if mode != "frozen":
             margin = margin + sig_c * row_dot(row, dw)
-        grad = (y * margin - one) * lam_n
-
-        proj_grad = jnp.where(
-            a <= zero,
-            jnp.minimum(grad, zero),
-            jnp.where(a >= one, jnp.maximum(grad, zero), grad),
-        )
         qii = sq_norms[idx] * qf
-        safe_qii = jnp.where(qii != zero, qii, one)
-        new_a = jnp.where(
-            qii != zero, jnp.clip(a - grad / safe_qii, zero, one), one
-        )
-        new_a = jnp.where(proj_grad != zero, new_a, a)
+        new_a = losses.alpha_step(loss, a, y * margin, qii, lam_n,
+                                  smoothing=smoothing)
 
         coef = y * (new_a - a) / lam_n
         dw = row_axpy(row, coef, dw)

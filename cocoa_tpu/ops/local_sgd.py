@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.rows import get_row, row_axpy, row_dot
 
 
@@ -30,13 +31,16 @@ def local_sgd(
     lam: float,
     t_global,            # (t-1)*H*K, traced scalar (SGD.scala:53)
     local: bool,
+    loss: str = "hinge",
+    smoothing: float = 1.0,
 ):
-    """Returns this worker's delta_w."""
+    """Returns this worker's delta_w.  The hinge 0/1 "active" indicator
+    (SGD.scala:115,124) generalizes to the loss's −ℓ'(z) factor."""
+    losses.validate(loss, smoothing)
     labels = shard["labels"]
     dtype = w_init.dtype
     lam_c = jnp.asarray(lam, dtype)
     one = jnp.asarray(1.0, dtype)
-    zero = jnp.asarray(0.0, dtype)
     t0 = jnp.asarray(t_global, dtype)
 
     def step(i, carry):
@@ -46,15 +50,15 @@ def local_sgd(
         idx = idxs[i]
         row = get_row(shard, idx)
         y = labels[idx]
-        active = (one - y * row_dot(row, w)) > zero
+        g = losses.grad_factor(loss, y * row_dot(row, w), smoothing=smoothing)
         if local:
             # the reference also accumulates dw here but overwrites it with
             # w - w_init each step (SGD.scala:132-134); only the final value
             # matters, so the dead accumulation is skipped statically
             w = w * (one - eta * lam_c)
-            w = row_axpy(row, jnp.where(active, y * eta, zero), w)
+            w = row_axpy(row, y * eta * g, w)
         else:
-            dw = row_axpy(row, jnp.where(active, y, zero), dw)
+            dw = row_axpy(row, y * g, dw)
         return w, dw
 
     dw0 = jnp.zeros_like(w_init)

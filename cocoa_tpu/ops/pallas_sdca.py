@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.local_sdca import mode_factors
 
 
@@ -79,6 +80,8 @@ def _kernel(
     frozen: bool,
     h: int,
     row_block: int,
+    loss: str,
+    smoothing: float,
 ):
     k_ = pl.program_id(0)
     i = pl.program_id(1)
@@ -124,18 +127,10 @@ def _kernel(
     else:
         xdw = jnp.sum(x * dw_acc[...])
         margin = m0 + sig_eff * xdw
-    grad = (y * margin - 1.0) * lam_n
-
-    # box projection (CoCoA.scala:166-178)
-    proj_grad = jnp.where(
-        a <= 0.0,
-        jnp.minimum(grad, 0.0),
-        jnp.where(a >= 1.0, jnp.maximum(grad, 0.0), grad),
-    )
-    qii = sq * qii_factor
-    safe_qii = jnp.where(qii != 0.0, qii, 1.0)
-    new_a = jnp.where(qii != 0.0, jnp.clip(a - grad / safe_qii, 0.0, 1.0), 1.0)
-    new_a = jnp.where(proj_grad != 0.0, new_a, a)
+    # the dual coordinate update is pure scalar jnp — shared with the
+    # fori_loop kernels via ops/losses.py (hinge = CoCoA.scala:166-178)
+    new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
+                              smoothing=smoothing)
 
     coef = y * (new_a - a) / lam_n
     dw_acc[...] = dw_acc[...] + coef * x
@@ -149,7 +144,8 @@ def _kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("lam", "n", "mode", "sigma", "interpret"),
+    static_argnames=("lam", "n", "mode", "sigma", "interpret", "loss",
+                     "smoothing"),
 )
 def pallas_sdca_round(
     w_margins0: jax.Array,   # (K, n_shard) precomputed X·w₀ per shard
@@ -163,6 +159,8 @@ def pallas_sdca_round(
     mode: str = "plus",
     sigma: float = 1.0,
     interpret: bool = False,
+    loss: str = "hinge",
+    smoothing: float = 1.0,
 ):
     """One SDCA round for K shards on this chip.  Returns (dw, alpha_inner):
     dw (K, d) unreduced per-shard updates; alpha_inner (K, n_shard) the
@@ -190,6 +188,8 @@ def pallas_sdca_round(
         frozen=(mode == "frozen"),
         h=h,
         row_block=row_block,
+        loss=losses.validate(loss, smoothing),
+        smoothing=float(smoothing),
     )
 
     full = lambda k_, i_, idxs_: (0, 0)  # noqa: E731 — full-array block

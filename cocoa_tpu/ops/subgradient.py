@@ -17,20 +17,22 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.rows import shard_margins
 
 
-def subgradient_pass(w_init: jax.Array, shard: dict, lam: float) -> jax.Array:
-    """Returns this worker's delta_w (DistGD.scala:82-98 semantics)."""
+def subgradient_pass(w_init: jax.Array, shard: dict, lam: float,
+                     loss: str = "hinge", smoothing: float = 1.0) -> jax.Array:
+    """Returns this worker's delta_w (DistGD.scala:82-98 semantics,
+    generalized to any ops/losses.py loss via its −ℓ'(z) factor)."""
+    losses.validate(loss, smoothing)
     labels = shard["labels"]
-    dtype = w_init.dtype
-    one = jnp.asarray(1.0, dtype)
-    zero = jnp.asarray(0.0, dtype)
 
     margins = shard_margins(w_init, shard)                 # (n_shard,)
 
     # padded rows have label 0 ⇒ coef 0 ⇒ contribute nothing
-    coef = jnp.where(one - labels * margins > zero, labels, zero)
+    coef = labels * losses.grad_factor(loss, labels * margins,
+                                       smoothing=smoothing)
 
     if "X" in shard:
         dw = coef @ shard["X"]                             # Xᵀ·coef on the MXU

@@ -64,6 +64,7 @@ def _cocoa_round_parts(
             da, dw = local_sdca(
                 w, alpha_k, shard_k, idxs_k, params.lam, params.n,
                 mode=mode, sigma=sigma,
+                loss=params.loss, smoothing=params.smoothing,
             )
             return dw, alpha_k + scaling * da  # CoCoA.scala:101
 
@@ -85,12 +86,14 @@ def _cocoa_round_parts(
                 shard_k["labels"][None], shard_k["sq_norms"][None],
                 idxs_k[None], params.lam, params.n,
                 mode=mode, sigma=sigma, interpret=pallas_interpret,
+                loss=params.loss, smoothing=params.smoothing,
             )
             da = a_inner[0] - alpha_k
             return dw[0], alpha_k + scaling * da
         da, dw = local_sdca_fast(
             m0, alpha_k, shard_k, idxs_k, params.lam, params.n,
             jnp.zeros_like(w), mode=mode, sigma=sigma,
+            loss=params.loss, smoothing=params.smoothing,
         )
         return dw, alpha_k + scaling * da
 
@@ -106,6 +109,7 @@ def _cocoa_round_parts(
                 m0, alpha, shards["X"], shards["labels"], shards["sq_norms"],
                 idxs_kh, params.lam, params.n,
                 mode=mode, sigma=sigma, interpret=pallas_interpret,
+                loss=params.loss, smoothing=params.smoothing,
             )
             alpha_new = alpha + scaling * (a_inner - alpha)
             return dw.sum(axis=0), alpha_new
@@ -160,7 +164,7 @@ def make_chunk_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
     per configuration so repeated run_* calls don't pay a re-jit."""
     key = (
         mesh, k, plus, params.lam, params.n, params.local_iters,
-        params.beta, params.gamma, params.loss,
+        params.beta, params.gamma, params.loss, params.smoothing,
         tuple(sorted(parts_kw.items())),
     )
     step = _CHUNK_STEPS.get(key)
@@ -239,11 +243,24 @@ def run_cocoa(
         # auto: the Pallas kernel needs fast math + dense layout + f32 + a
         # real TPU backend (measured ~20% faster than the fori_loop path on
         # the demo config; the gap widens with shard size as the row DMA
-        # pipeline hides HBM latency)
+        # pipeline hides HBM latency) — AND the kernel's VMEM-resident
+        # working set must fit.  The single-chip batched path keeps 5
+        # (k, n_shard) vectors + a (k, d) Δw block + (~4, n_shard)+(1, d)
+        # scratch + double-buffered (8, d) row blocks in VMEM; on a mesh the
+        # per-device k is k/mesh-size.  Budget ~12 MB of the ~16 MB VMEM;
+        # oversized runs keep the fori_loop fast path (explicit pallas=True
+        # overrides, and Mosaic then reports the allocation failure itself).
+        k_dev = k if mesh is None else -(-k // mesh.devices.size)
+        itemsize = jnp.dtype(dtype).itemsize
+        vmem_bytes = itemsize * (
+            6 * k_dev * ds.n_shard + (k_dev + 1) * ds.num_features
+            + 4 * ds.n_shard + 2 * 8 * ds.num_features
+        )
         pallas = (
             math == "fast" and ds.layout == "dense"
-            and jnp.dtype(dtype).itemsize == 4
+            and itemsize == 4
             and platform in ("tpu", "axon")
+            and vmem_bytes <= 12 << 20
         )
     if pallas and ds.layout != "dense":
         raise ValueError("the Pallas SDCA kernel requires layout='dense'")
@@ -269,7 +286,8 @@ def run_cocoa(
 
     def eval_fn(state):
         w, alpha = state
-        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds)
+        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds,
+                                   loss=params.loss, smoothing=params.smoothing)
 
     if device_loop:
         raw_kernel = _make_chunk_kernel(mesh, params, k, plus, **parts_kw)
@@ -285,6 +303,7 @@ def run_cocoa(
             return objectives.eval_metrics(
                 w, alpha, shard_arrays, params.lam, params.n, mesh=mesh,
                 test_shard_arrays=test_arrays, test_n=test_n,
+                loss=params.loss, smoothing=params.smoothing,
             )
 
         chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
@@ -297,7 +316,8 @@ def run_cocoa(
         cache_key = (
             "cocoa", plus, math, pallas, k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
-            params.gamma, params.num_rounds, debug.debug_iter, start_round,
+            params.gamma, params.loss, params.smoothing,
+            params.num_rounds, debug.debug_iter, start_round,
             gap_target, test_n, ds.layout, str(dtype),
         )
         (w, alpha), traj = base.drive_device_full(

@@ -27,7 +27,9 @@ def make_round_step(mesh, params: Params, k: int):
     beta = params.beta
 
     def per_shard(w, shard_k):
-        return (subgradient_pass(w, shard_k, lam),)
+        return (subgradient_pass(w, shard_k, lam,
+                                 loss=params.loss,
+                                 smoothing=params.smoothing),)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def round_step(w, t, shard_arrays):
@@ -72,7 +74,8 @@ def run_dist_gd(
 
     def eval_fn(state):
         (w,) = state
-        return objectives.evaluate(ds, w, None, params.lam, test_ds=test_ds)
+        return objectives.evaluate(ds, w, None, params.lam, test_ds=test_ds,
+                                   loss=params.loss, smoothing=params.smoothing)
 
     (w,), traj = base.drive(
         "Dist SGD", params, debug, (w,), round_fn, eval_fn,

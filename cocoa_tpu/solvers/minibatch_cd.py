@@ -25,7 +25,8 @@ def make_round_step(mesh, params: Params, k: int):
 
     def per_shard(w, alpha_k, idxs_k, shard_k):
         da, dw = local_sdca(
-            w, alpha_k, shard_k, idxs_k, params.lam, params.n, mode="frozen"
+            w, alpha_k, shard_k, idxs_k, params.lam, params.n, mode="frozen",
+            loss=params.loss, smoothing=params.smoothing,
         )
         return dw, alpha_k + scaling * da  # MinibatchCD.scala:127-128
 
@@ -81,7 +82,8 @@ def run_minibatch_cd(
 
     def eval_fn(state):
         w, alpha = state
-        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds)
+        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds,
+                                   loss=params.loss, smoothing=params.smoothing)
 
     (w, alpha), traj = base.drive(
         "Mini-batch CD", params, debug, (w, alpha), round_fn, eval_fn,

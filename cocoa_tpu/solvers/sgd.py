@@ -31,7 +31,8 @@ def make_round_step(mesh, params: Params, k: int, local: bool):
     scaling = params.beta / k if local else params.beta / (k * h)  # SGD.scala:34-39
 
     def per_shard(w, idxs_k, t_global, shard_k):
-        return (local_sgd(w, shard_k, idxs_k, lam, t_global, local),)
+        return (local_sgd(w, shard_k, idxs_k, lam, t_global, local,
+                          loss=params.loss, smoothing=params.smoothing),)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def round_step(w, idxs, t, shard_arrays):
@@ -92,7 +93,8 @@ def run_sgd(
 
     def eval_fn(state):
         (w,) = state
-        return objectives.evaluate(ds, w, None, params.lam, test_ds=test_ds)
+        return objectives.evaluate(ds, w, None, params.lam, test_ds=test_ds,
+                                   loss=params.loss, smoothing=params.smoothing)
 
     (w,), traj = base.drive(
         name, params, debug, (w,), round_fn, eval_fn,
