@@ -1,11 +1,14 @@
 """Command-line driver — drop-in for the reference CLI
 (hingeDriver.scala:11-115).
 
-Accepts the same ``--key=value`` flag set (including ``--master``, accepted
-and ignored), loads train/test LIBSVM data, computes H = max(1,
-localIterFrac·n/K), then runs the same algorithm menu: CoCoA+ and CoCoA
-always; mini-batch CD, mini-batch SGD, local SGD and DistGD when
-``--justCoCoA=false`` (hingeDriver.scala:84-110).
+Accepts the same ``--key=value`` flag set, loads train/test LIBSVM data,
+computes H = max(1, localIterFrac·n/K), then runs the same algorithm menu:
+CoCoA+ and CoCoA always; mini-batch CD, mini-batch SGD, local SGD and DistGD
+when ``--justCoCoA=false`` (hingeDriver.scala:84-110).  ``--master`` (the
+Spark cluster-manager flag, hingeDriver.scala:23) keeps its meaning:
+``local``/``local[k]`` runs single-process; ``host:port`` joins the pod's
+multi-controller runtime via ``jax.distributed.initialize`` (with
+``--processId`` / ``--numProcesses`` or auto-detection on TPU pods).
 
 TPU-native additions (no reference analogue): ``--dtype``, ``--layout``,
 ``--rng``, ``--mesh`` (dp size; defaults to min(numSplits, device count);
@@ -36,7 +39,8 @@ from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
 _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
               "smoothing")  # same-named RunConfig fields
 _EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume", "scanChunk",
-                "deviceLoop")  # run-level
+                "deviceLoop", "master", "processId", "numProcesses",
+                "profile")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -63,8 +67,6 @@ def parse_args(argv: list[str]):
             continue
         if key in REFERENCE_FLAGS:
             field = REFERENCE_FLAGS[key]
-            if field is None:  # --master: accepted, ignored
-                continue
         elif key in _TPU_FLAGS:
             field = key
         else:
@@ -95,17 +97,32 @@ def main(argv=None) -> int:
         return 2
     from cocoa_tpu.ops import losses as losses_mod
 
-    if cfg.loss not in losses_mod.LOSSES:
-        print(f"error: --loss must be one of {'|'.join(losses_mod.LOSSES)}, "
-              f"got {cfg.loss!r}", file=sys.stderr)
-        return 2
-    if cfg.loss == "smooth_hinge" and cfg.smoothing <= 0:
-        print(f"error: --smoothing must be > 0 for smooth_hinge, got "
-              f"{cfg.smoothing}", file=sys.stderr)
+    try:
+        losses_mod.validate(cfg.loss, cfg.smoothing)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
         return 2
     if cfg.math not in ("exact", "fast"):
         print(f"error: --math must be exact|fast, got {cfg.math!r}",
               file=sys.stderr)
+        return 2
+
+    # multi-host: --master=host:port connects this process to the pod's
+    # coordinator (the Spark-master analogue) BEFORE any backend use, so
+    # jax.devices() below is the global device set
+    from cocoa_tpu.parallel import distributed
+
+    try:
+        proc_id = int(extras["processId"]) if extras["processId"] else None
+        n_procs = int(extras["numProcesses"]) if extras["numProcesses"] else None
+    except ValueError:
+        print("error: --processId/--numProcesses must be integers",
+              file=sys.stderr)
+        return 2
+    try:
+        distributed.maybe_initialize(extras["master"], proc_id, n_procs)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
         return 2
 
     # echo flags, as the reference does (hingeDriver.scala:41-48) — with its
@@ -206,27 +223,45 @@ def main(argv=None) -> int:
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
                     math=cfg.math, device_loop=cfg.device_loop)
 
-    w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
-                               **cocoa_kw, **restore("CoCoA+"), **common)
-    finish(traj, w, alpha)
-
-    w, alpha, traj = run_cocoa(ds, params, debug, plus=False,
-                               **cocoa_kw, **restore("CoCoA"), **common)
-    finish(traj, w, alpha)
-
-    if not cfg.just_cocoa:  # hingeDriver.scala:93-110
-        w, alpha, traj = run_minibatch_cd(ds, params, debug,
-                                          **restore("Mini-batch CD"), **common)
+    def run_all():
+        w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
+                                   **cocoa_kw, **restore("CoCoA+"), **common)
         finish(traj, w, alpha)
 
-        w, traj = run_sgd(ds, params, debug, local=False, **common)
-        finish(traj, w)
+        w, alpha, traj = run_cocoa(ds, params, debug, plus=False,
+                                   **cocoa_kw, **restore("CoCoA"), **common)
+        finish(traj, w, alpha)
 
-        w, traj = run_sgd(ds, params, debug, local=True, **common)
-        finish(traj, w)
+        if not cfg.just_cocoa:  # hingeDriver.scala:93-110
+            w, alpha, traj = run_minibatch_cd(
+                ds, params, debug, **restore("Mini-batch CD"), **common)
+            finish(traj, w, alpha)
 
-        w, traj = run_dist_gd(ds, params, debug, mesh=mesh, test_ds=test_ds)
-        finish(traj, w)
+            w, traj = run_sgd(ds, params, debug, local=False, **common)
+            finish(traj, w)
+
+            w, traj = run_sgd(ds, params, debug, local=True, **common)
+            finish(traj, w)
+
+            w, traj = run_dist_gd(ds, params, debug, mesh=mesh, test_ds=test_ds)
+            finish(traj, w)
+
+    if extras["profile"]:
+        # --profile=DIR: capture a device trace of the whole run, viewable
+        # in TensorBoard/Perfetto (the reference has no profiler at all —
+        # SURVEY.md §5 requires one as a debug flag).  try/finally so the
+        # trace — the artifact needed to debug a failing run — still flushes
+        # when a solver raises.
+        from jax import profiler
+
+        profiler.start_trace(extras["profile"])
+        try:
+            run_all()
+        finally:
+            profiler.stop_trace()
+            print(f"profiler trace written to {extras['profile']}")
+    else:
+        run_all()
 
     return 0
 

@@ -108,10 +108,9 @@ class RunConfig:
 
 
 # Mapping from reference CLI flag names (hingeDriver.scala:22-38) to RunConfig
-# field names.  "master" maps to None: accepted for drop-in compatibility but
-# ignored (no Spark master here).
+# field names.  "master" is not here: the CLI consumes it as a run-level flag
+# (it selects local vs multi-host mode, cli.py).
 REFERENCE_FLAGS = {
-    "master": None,
     "trainFile": "train_file",
     "testFile": "test_file",
     "numFeatures": "num_features",

@@ -81,8 +81,13 @@ def dual_term(loss: str, a, smoothing: float = 1.0):
     if loss == "smooth_hinge":
         return a - 0.5 * smoothing * a * a
     if loss == "logistic":
-        ac = jnp.clip(a, _EPS, 1.0 - _EPS)
-        return -(ac * jnp.log(ac) + (1.0 - ac) * jnp.log1p(-ac))
+        # xlogy gives the correct 0·log0 = 0 limit at the box corners — an
+        # eps-clip is NOT enough: in f32, 1 − 1e-12 rounds to exactly 1.0 and
+        # (1−α)·log1p(−α) becomes 0·(−inf) = NaN once a coordinate saturates
+        from jax.scipy.special import xlogy
+
+        ac = jnp.clip(a, 0.0, 1.0)
+        return -(xlogy(ac, ac) + xlogy(1.0 - ac, 1.0 - ac))
     raise ValueError(f"unknown loss {loss!r}")
 
 

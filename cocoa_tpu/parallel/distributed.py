@@ -1,0 +1,73 @@
+"""Multi-host (multi-process) runtime initialization.
+
+The reference's ``--master`` flag selects the Spark cluster manager
+(hingeDriver.scala:23: ``local[4]`` or a ``spark://host:port`` URL); workers
+then talk over Spark's Netty RPC fabric.  The TPU-native counterpart is JAX's
+multi-controller runtime: every host of a pod slice runs the same program,
+``jax.distributed.initialize`` connects them through a coordinator, and
+``jax.devices()`` becomes the global device set — after which the very same
+``shard_map`` + ``lax.psum`` code path runs over ICI/DCN with zero further
+changes (the collectives are compiled in, not library calls; SURVEY.md §2.3).
+
+``--master=local[...]`` / ``local`` / empty keeps the single-process path,
+exactly like the reference's local mode.  Anything of the form ``host:port``
+(or ``spark://host:port``, accepted for drop-in compatibility) is treated as
+the coordinator address.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def parse_master(master: Optional[str]) -> Optional[str]:
+    """Coordinator address from a reference-style --master value, or None
+    for local mode."""
+    if not master:
+        return None
+    m = master.strip()
+    if m == "local" or m.startswith("local["):
+        return None
+    for prefix in ("spark://", "jax://", "grpc://"):
+        if m.startswith(prefix):
+            m = m[len(prefix):]
+            if ":" not in m:
+                # an explicit scheme unambiguously requests cluster mode —
+                # silently degrading to local would train K independent
+                # copies, one per host
+                raise ValueError(
+                    f"--master={master!r} requests cluster mode but has no "
+                    f"port; use {prefix}host:port"
+                )
+            return m
+    return m if ":" in m else None
+
+
+def maybe_initialize(
+    master: Optional[str],
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+) -> bool:
+    """Connect this process to the multi-host runtime if --master names a
+    coordinator.  Returns True iff distributed mode was initialized.
+
+    ``process_id`` / ``num_processes`` fall back to COCOA_PROCESS_ID /
+    COCOA_NUM_PROCESSES, then to JAX's auto-detection (TPU pods populate
+    both from the metadata server).
+    """
+    coordinator = parse_master(master)
+    if coordinator is None:
+        return False
+    import jax
+
+    if process_id is None and os.environ.get("COCOA_PROCESS_ID"):
+        process_id = int(os.environ["COCOA_PROCESS_ID"])
+    if num_processes is None and os.environ.get("COCOA_NUM_PROCESSES"):
+        num_processes = int(os.environ["COCOA_NUM_PROCESSES"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True

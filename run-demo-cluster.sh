@@ -1,0 +1,16 @@
+#!/bin/bash
+# Multi-host pod launcher — the analogue of the reference's spark-submit
+# cluster launcher (run-demo-cluster.sh:3-9).  Run the SAME command on every
+# host of the pod slice; JAX discovers peers through the coordinator:
+#
+#   COCOA_COORDINATOR=<host0-addr:port> ./run-demo-cluster.sh \
+#       --trainFile=... --numFeatures=... [flags]
+#
+# --master=<addr:port> (the reference's flag, hingeDriver.scala:23) is
+# honored as the coordinator address too; process id / process count are
+# auto-detected on TPU pods (jax.distributed.initialize), or set
+# COCOA_PROCESS_ID / COCOA_NUM_PROCESSES explicitly.
+cd "$(dirname "$0")"
+ARGS=()
+[ -n "$COCOA_COORDINATOR" ] && ARGS+=("--master=$COCOA_COORDINATOR")
+exec python -m cocoa_tpu.cli "${ARGS[@]}" "$@"

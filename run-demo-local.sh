@@ -1,0 +1,16 @@
+#!/bin/bash
+# Demo run — same config as the reference launcher (run-demo-local.sh:2-9):
+# all six algorithms on the bundled small dataset, K=4 shards, T=100 rounds,
+# H = 0.1·n/K = 50, λ=1e-3.  On a single chip the 4 logical shards run on the
+# vmap path; on a ≥4-device mesh they map 1:1 onto devices.
+cd "$(dirname "$0")"
+exec python -m cocoa_tpu.cli \
+  --trainFile=data/small_train.dat \
+  --testFile=data/small_test.dat \
+  --numFeatures=9947 \
+  --numRounds=100 \
+  --localIterFrac=0.1 \
+  --numSplits=4 \
+  --lambda=.001 \
+  --justCoCoA=false \
+  "$@"

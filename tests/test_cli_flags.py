@@ -1,0 +1,73 @@
+"""CLI run-level flags: --master parsing (multi-host coordinator), --profile.
+
+The reference's --master selects Spark local vs cluster mode
+(hingeDriver.scala:22-23); here local modes keep the single-process path and
+host:port values name the jax.distributed coordinator.
+"""
+
+import pytest
+
+from cocoa_tpu.cli import parse_args
+from cocoa_tpu.parallel.distributed import parse_master
+
+
+@pytest.mark.parametrize(
+    "master,expected",
+    [
+        (None, None),
+        ("", None),
+        ("local", None),
+        ("local[4]", None),
+        ("local[*]", None),
+        ("host0:8476", "host0:8476"),
+        ("spark://host0:7077", "host0:7077"),  # drop-in for the reference URL
+        ("grpc://10.0.0.1:1234", "10.0.0.1:1234"),
+        ("justahost", None),  # no port — not a coordinator address
+    ],
+)
+def test_parse_master(master, expected):
+    assert parse_master(master) == expected
+
+
+def test_parse_master_scheme_without_port_errors():
+    # an explicit scheme requests cluster mode; silently running local would
+    # train one independent copy per host
+    with pytest.raises(ValueError, match="no.*port|port"):
+        parse_master("spark://host0")
+
+
+def test_cli_captures_run_level_flags():
+    cfg, extras = parse_args(
+        ["--master=local[4]", "--profile=/tmp/trace", "--processId=0",
+         "--numProcesses=2", "--trainFile=x", "--numFeatures=3"]
+    )
+    assert extras["master"] == "local[4]"
+    assert extras["profile"] == "/tmp/trace"
+    assert extras["processId"] == "0"
+    assert extras["numProcesses"] == "2"
+    assert cfg.train_file == "x"
+
+
+def test_cli_rejects_unknown_flag():
+    with pytest.raises(SystemExit):
+        parse_args(["--notAFlag=1"])
+
+
+@pytest.mark.parametrize(
+    "argv",
+    [
+        ["--trainFile=x", "--numFeatures=3", "--master=spark://host0"],
+        ["--trainFile=x", "--numFeatures=3", "--processId=abc"],
+        ["--trainFile=x", "--numFeatures=3", "--processId"],
+        ["--trainFile=x", "--numFeatures=3", "--loss=nope"],
+        ["--trainFile=x", "--numFeatures=3", "--loss=smooth_hinge",
+         "--smoothing=0"],
+    ],
+)
+def test_cli_bad_flags_exit_cleanly(argv, capsys):
+    # malformed flags follow the CLI convention: 'error: ...' + return 2,
+    # not a raw traceback
+    from cocoa_tpu.cli import main
+
+    assert main(argv) == 2
+    assert "error:" in capsys.readouterr().err

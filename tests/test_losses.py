@@ -37,6 +37,19 @@ def _debug(**kw):
 # ---------------------------------------------------------------- analytic
 
 @pytest.mark.parametrize("loss", ALL)
+def test_dual_term_finite_at_box_corners_f32(loss):
+    # regression: in f32 an eps-clip rounds 1−1e-12 to exactly 1.0, and the
+    # logistic entropy hit 0·log(0) = NaN once a coordinate saturated —
+    # poisoning the duality gap and any --gapTarget early stop
+    a = jnp.asarray([0.0, 1.0, 0.5], dtype=jnp.float32)
+    out = losses.dual_term(loss, a, S)
+    assert out.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(out)))
+    if loss == "logistic":  # entropy is exactly 0 at both corners
+        np.testing.assert_allclose(np.asarray(out[:2]), [0.0, 0.0])
+
+
+@pytest.mark.parametrize("loss", ALL)
 def test_grad_factor_is_negative_derivative(loss):
     """g(z) = −ℓ'(z) by central finite differences (away from kinks)."""
     z = np.array([-2.3, -0.4, 0.1, 0.77, 1.9, 3.2])
