@@ -38,7 +38,7 @@ from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
 
 _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
               "smoothing")  # same-named RunConfig fields
-_EXTRA_FLAGS = ("mesh", "trajOut", "gapTarget", "resume", "scanChunk",
+_EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile")  # run-level
 
@@ -86,6 +86,14 @@ def parse_args(argv: list[str]):
 
 
 def main(argv=None) -> int:
+    import os
+
+    # honor JAX_PLATFORMS even when a sitecustomize force-selected a platform
+    # via jax.config (which outranks the env var); must happen before the
+    # first jax.devices() call locks the backend in
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     argv = sys.argv[1:] if argv is None else argv
     cfg, extras = parse_args(argv)
 
@@ -141,17 +149,38 @@ def main(argv=None) -> int:
     # mesh selection: K shards need a K-device dp mesh; anything else runs
     # the single-chip vmap path (all K logical shards on one device).  An
     # explicit --mesh that can't be honored is an error; inferred sizes
-    # fall back silently.
+    # fall back silently.  --fp=F adds a feature axis: a (K, F) mesh over
+    # K*F devices, w and X columns split over fp.
     mesh = None
-    explicit = extras["mesh"] is not None
-    mesh_size = int(extras["mesh"]) if explicit else min(k, len(jax.devices()))
-    if explicit and (mesh_size > len(jax.devices()) or (mesh_size > 1 and mesh_size != k)):
-        print(f"error: --mesh={mesh_size} needs exactly numSplits={k} devices "
-              f"(have {len(jax.devices())}); use --mesh=1 for the single-chip path",
+    try:
+        fp = int(extras["fp"]) if extras["fp"] else 1
+    except ValueError:
+        print(f"error: --fp must be an integer, got {extras['fp']!r}",
               file=sys.stderr)
         return 2
-    if mesh_size == k and k > 1:
-        mesh = make_mesh(k)
+    if fp < 1:
+        print(f"error: --fp must be >= 1, got {fp}", file=sys.stderr)
+        return 2
+    explicit = extras["mesh"] is not None
+    try:
+        mesh_size = int(extras["mesh"]) if explicit else min(k, len(jax.devices()) // fp)
+    except ValueError:
+        print(f"error: --mesh must be an integer, got {extras['mesh']!r}",
+              file=sys.stderr)
+        return 2
+    if explicit and (mesh_size * fp > len(jax.devices())
+                     or (mesh_size > 1 and mesh_size != k)):
+        print(f"error: --mesh={mesh_size} (x fp={fp}) needs exactly "
+              f"numSplits={k} x fp devices (have {len(jax.devices())}); "
+              f"use --mesh=1 for the single-chip path", file=sys.stderr)
+        return 2
+    if fp > 1 and mesh_size != k:
+        print(f"error: --fp={fp} requires a {k}x{fp}-device mesh "
+              f"(numSplits x fp; have {len(jax.devices())} devices)",
+              file=sys.stderr)
+        return 2
+    if mesh_size == k and (k > 1 or fp > 1):
+        mesh = make_mesh(k, fp=fp)
 
     ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
     test_ds = None

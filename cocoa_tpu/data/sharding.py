@@ -55,7 +55,8 @@ class ShardedDataset:
 
     layout: str                       # "dense" | "sparse"
     n: int                            # total real examples
-    num_features: int
+    num_features: int                 # d (padded up to an fp multiple on a
+                                      #   feature-parallel mesh; w matches)
     counts: np.ndarray                # (K,) int, host-side
     labels: jax.Array                 # (K, n_shard)
     mask: jax.Array                   # (K, n_shard)  1.0 real / 0.0 pad
@@ -146,6 +147,16 @@ def shard_dataset(
         nnz = int(data.indptr[-1])
         density = nnz / max(1, n * d)
         layout = "sparse" if density < 0.10 else "dense"
+        if mesh_lib.has_fp(mesh):
+            layout = "dense"  # fp sharding is dense-only (see below)
+    if layout == "sparse" and mesh_lib.has_fp(mesh):
+        # padded-CSR rows index the full feature space; splitting them over
+        # fp would need per-device re-bucketing of each row's nnz (ragged) —
+        # use the dense layout for feature-parallel runs
+        raise ValueError(
+            "feature-axis (fp) sharding requires layout='dense'; the "
+            "padded-CSR layout cannot column-partition"
+        )
 
     np_dtype = np.dtype(dtype)
     sizes = split_sizes(n, k)
@@ -171,6 +182,12 @@ def shard_dataset(
         sq_norms[s, :m] = row_sq[lo:hi]
 
     kwargs: dict = {}
+    if layout == "dense" and mesh_lib.has_fp(mesh):
+        # pad the feature dim to an fp multiple so columns split evenly;
+        # zero columns touch nothing (no update ever flows into them, and w's
+        # matching padded entries stay exactly 0)
+        fp = mesh.shape[mesh_lib.FP_AXIS]
+        d = -(-d // fp) * fp
     if layout == "dense":
         X = np.zeros((k, n_shard, d), dtype=np_dtype)
         for s in range(k):
@@ -197,8 +214,17 @@ def shard_dataset(
         kwargs["sp_indices"] = sp_idx
         kwargs["sp_values"] = sp_val
 
-    def put(arr):
+    def put(arr, fp_last=False):
         if mesh is not None:
+            if fp_last and mesh_lib.has_fp(mesh):
+                # X: rows over dp, columns over fp — each device holds an
+                # (n_shard, d/fp) block matching its slice of w
+                spec = jax.sharding.PartitionSpec(
+                    mesh_lib.DP_AXIS, None, mesh_lib.FP_AXIS
+                )
+                return jax.device_put(
+                    arr, jax.sharding.NamedSharding(mesh, spec)
+                )
             return jax.device_put(
                 arr, mesh_lib.sharded_rows(mesh, extra_dims=arr.ndim - 1)
             )
@@ -212,7 +238,7 @@ def shard_dataset(
         labels=put(labels),
         mask=put(mask),
         sq_norms=put(sq_norms),
-        X=put(kwargs["X"]) if "X" in kwargs else None,
+        X=put(kwargs["X"], fp_last=True) if "X" in kwargs else None,
         sp_indices=put(kwargs["sp_indices"]) if "sp_indices" in kwargs else None,
         sp_values=put(kwargs["sp_values"]) if "sp_values" in kwargs else None,
     )

@@ -20,7 +20,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from cocoa_tpu.parallel.mesh import DP_AXIS
+from cocoa_tpu.parallel.mesh import DP_AXIS, manual_axes
 
 
 def _to_varying(x):
@@ -63,8 +63,11 @@ def fanout(
         )
         n_aux = len(jax.eval_shape(per_shard, w, *probe)) - 1
         out_specs = (P(), *([P(DP_AXIS)] * n_aux))
+        # on a (dp, fp) mesh, shard_map is manual over dp only; the feature
+        # axis stays GSPMD-auto (specs then only constrain the dp placement)
         return jax.shard_map(
-            wrapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+            wrapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            axis_names=manual_axes(mesh),
         )(w, *sharded)
 
     in_axes = (None, *([0] * len(sharded)))
@@ -133,7 +136,7 @@ def chunk_fanout(
         out_specs = (P(), jax.tree.map(lambda _: P(DP_AXIS), carry_sharded))
         return jax.shard_map(
             wrapped, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=check_vma,
+            check_vma=check_vma, axis_names=manual_axes(mesh),
         )(w, carry_sharded, xs_sharded, static_sharded)
 
     # local path: scan over rounds; per round, vmap over shards + in-device sum

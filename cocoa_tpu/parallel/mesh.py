@@ -16,8 +16,14 @@ Mesh axes:
 
 - ``dp`` — data parallelism over example shards (the reference's only
   parallelism strategy; K = number of Spark partitions).
-- ``fp`` — optional feature-dimension sharding of ``w``/``X`` for very large d
-  (a TPU extension with no reference analogue; see SURVEY.md §2.2).
+- ``fp`` — feature-dimension sharding of ``w``/``X`` columns for very large d
+  (a TPU extension with no reference analogue; see SURVEY.md §2.2).  The fp
+  axis is ``AxisType.Auto``: solvers shard_map manually over dp only and
+  GSPMD inserts the fp collectives for every d-contraction (data/sharding.py
+  places X as P('dp', None, 'fp'); w is P('fp') via :func:`primal_sharding`).
+  fp is a *capacity* axis — it fits a d/F slice of the model and data columns
+  per device; the sequential SDCA inner loop still pays one fp-reduction per
+  coordinate step, so use it when d forces it, not for speed.
 
 On a real pod the mesh should be built so ``dp`` rides ICI; a multi-slice
 deployment puts the slowest axis on DCN.  Tests simulate K devices on CPU via
@@ -29,7 +35,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
 
 DP_AXIS = "dp"
 FP_AXIS = "fp"
@@ -46,6 +52,12 @@ def make_mesh(
     the device count cannot satisfy the request — shards must map 1:1 onto
     mesh positions (unlike Spark, where K partitions multiplex onto fewer
     executors; on TPU the mesh *is* the worker set).
+
+    The fp axis is created with ``AxisType.Auto``: the solvers run
+    ``shard_map`` manually over dp only and leave the feature dimension to
+    GSPMD — annotate the shardings (X columns and w on fp), let XLA insert
+    the collectives for every d-contraction.  dp stays Explicit/manual so the
+    one Δw psum per round remains the visible communication contract.
     """
     devices = list(devices if devices is not None else jax.devices())
     if k is None:
@@ -58,7 +70,21 @@ def make_mesh(
         )
     if fp == 1:
         return jax.make_mesh((k,), (DP_AXIS,), devices=devices[:need])
-    return jax.make_mesh((k, fp), (DP_AXIS, FP_AXIS), devices=devices[:need])
+    return jax.make_mesh(
+        (k, fp), (DP_AXIS, FP_AXIS), devices=devices[:need],
+        axis_types=(AxisType.Explicit, AxisType.Auto),
+    )
+
+
+def has_fp(mesh: Optional[Mesh]) -> bool:
+    """True when the mesh carries a feature-parallel axis."""
+    return mesh is not None and FP_AXIS in mesh.axis_names
+
+
+def manual_axes(mesh: Optional[Mesh]) -> frozenset:
+    """The axes shard_map runs manually over: dp only on an fp mesh (the
+    feature axis is GSPMD-auto), every axis otherwise (empty set = all)."""
+    return frozenset({DP_AXIS}) if has_fp(mesh) else frozenset()
 
 
 def sharded_rows(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
@@ -67,5 +93,12 @@ def sharded_rows(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    """Sharding for fully replicated arrays (the global primal vector w)."""
+    """Sharding for fully replicated arrays."""
     return NamedSharding(mesh, P())
+
+
+def primal_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the global primal vector w: replicated on a dp mesh,
+    split over the feature axis on a (dp, fp) mesh — each device then holds
+    d/fp of w (and the matching column block of X, see data/sharding.py)."""
+    return NamedSharding(mesh, P(FP_AXIS) if has_fp(mesh) else P())

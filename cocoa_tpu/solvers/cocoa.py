@@ -233,10 +233,12 @@ def run_cocoa(
         else base.align_alpha(alpha_init, ds, dtype)
     )
     if mesh is not None:
-        from cocoa_tpu.parallel.mesh import replicated, sharded_rows
+        from cocoa_tpu.parallel.mesh import primal_sharding, sharded_rows
 
-        w = jax.device_put(w, replicated(mesh))
+        w = jax.device_put(w, primal_sharding(mesh))
         alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
+
+    from cocoa_tpu.parallel.mesh import has_fp
 
     platform = jax.devices()[0].platform
     if pallas is None:
@@ -261,9 +263,17 @@ def run_cocoa(
             and itemsize == 4
             and platform in ("tpu", "axon")
             and vmem_bytes <= 12 << 20
+            # the kernel's VMEM blocks assume the full d per device;
+            # feature-parallel runs keep the fori_loop fast path
+            and not has_fp(mesh)
         )
     if pallas and ds.layout != "dense":
         raise ValueError("the Pallas SDCA kernel requires layout='dense'")
+    if pallas and has_fp(mesh):
+        raise ValueError(
+            "the Pallas SDCA kernel does not support feature-parallel (fp) "
+            "meshes; use pallas=False"
+        )
     if pallas and math != "fast":
         raise ValueError("pallas=True requires math='fast'")
     if pallas and platform not in ("tpu", "axon", "cpu"):

@@ -61,9 +61,9 @@ def run_dist_gd(
     dtype = ds.labels.dtype
     w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.array(w_init, dtype=dtype, copy=True)
     if mesh is not None:
-        from cocoa_tpu.parallel.mesh import replicated
+        from cocoa_tpu.parallel.mesh import primal_sharding
 
-        w = jax.device_put(w, replicated(mesh))
+        w = jax.device_put(w, primal_sharding(mesh))
 
     step = make_round_step(mesh, params, k)
     shard_arrays = ds.shard_arrays()

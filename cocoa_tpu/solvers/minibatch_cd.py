@@ -67,9 +67,9 @@ def run_minibatch_cd(
         else base.align_alpha(alpha_init, ds, dtype)
     )
     if mesh is not None:
-        from cocoa_tpu.parallel.mesh import replicated, sharded_rows
+        from cocoa_tpu.parallel.mesh import primal_sharding, sharded_rows
 
-        w = jax.device_put(w, replicated(mesh))
+        w = jax.device_put(w, primal_sharding(mesh))
         alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
 
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)

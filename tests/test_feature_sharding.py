@@ -1,0 +1,153 @@
+"""Feature-axis (fp) parallelism: the (dp, fp) 2-D mesh extension.
+
+The reference's only parallelism is data parallelism over example shards
+(SURVEY.md §2.2); the feature dimension d is the TPU-native second axis —
+w and X's columns split over fp (each device holds d/fp of w and the matching
+column block of every row), shard_map stays manual over dp (the one Δw psum
+per round), and GSPMD auto-inserts the fp collectives for every
+d-contraction.  Correctness bar: identical math to the dp-only and local
+paths — same w, same alpha, same duality gap.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.evals import objectives
+from cocoa_tpu.parallel import DP_AXIS, FP_AXIS, make_mesh
+from cocoa_tpu.parallel.mesh import has_fp, primal_sharding
+from cocoa_tpu.solvers import run_cocoa, run_minibatch_cd, run_sgd
+
+K, FP = 4, 2  # 4 dp x 2 fp = the full virtual 8-device CPU mesh
+
+
+def _params(data, **kw):
+    kw.setdefault("num_rounds", 10)
+    kw.setdefault("local_iters", 16)
+    kw.setdefault("lam", 0.01)
+    return Params(n=data.n, **kw)
+
+
+def _debug():
+    return DebugParams(debug_iter=5, seed=11)
+
+
+@pytest.fixture(scope="module")
+def fp_mesh():
+    return make_mesh(K, fp=FP)
+
+
+def test_mesh_axes(fp_mesh):
+    assert fp_mesh.axis_names == (DP_AXIS, FP_AXIS)
+    assert fp_mesh.shape[DP_AXIS] == K and fp_mesh.shape[FP_AXIS] == FP
+    assert has_fp(fp_mesh) and not has_fp(make_mesh(K)) and not has_fp(None)
+
+
+def test_x_is_column_sharded(tiny_data, fp_mesh):
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                       mesh=fp_mesh)
+    d = tiny_data.num_features
+    shapes = {s.data.shape for s in ds.X.addressable_shards}
+    assert shapes == {(1, ds.n_shard, d // FP)}  # rows over dp, cols over fp
+    # labels/alpha-like arrays: dp-sharded, fp-replicated
+    assert {s.data.shape for s in ds.labels.addressable_shards} == {(1, ds.n_shard)}
+
+
+def test_sparse_layout_rejected(tiny_data, fp_mesh):
+    with pytest.raises(ValueError, match="dense"):
+        shard_dataset(tiny_data, k=K, layout="sparse", dtype=jnp.float64,
+                      mesh=fp_mesh)
+    # auto resolves to dense on an fp mesh even for sparse-ish data
+    ds = shard_dataset(tiny_data, k=K, layout="auto", dtype=jnp.float64,
+                       mesh=fp_mesh)
+    assert ds.layout == "dense"
+
+
+@pytest.mark.parametrize("plus", [True, False])
+@pytest.mark.parametrize("math", ["exact", "fast"])
+def test_cocoa_fp_matches_local(tiny_data, fp_mesh, plus, math):
+    params, debug = _params(tiny_data), _debug()
+    kw = dict(plus=plus, math=math, quiet=True)
+
+    ds_local = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    w0, a0, _ = run_cocoa(ds_local, params, debug, **kw)
+
+    ds_fp = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=fp_mesh)
+    w1, a1, _ = run_cocoa(ds_fp, params, debug, mesh=fp_mesh, **kw)
+
+    assert w1.sharding.spec == primal_sharding(fp_mesh).spec
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), atol=1e-9)
+
+    gap0 = objectives.duality_gap(ds_local, w0, a0, params.lam)
+    gap1 = objectives.duality_gap(ds_fp, w1, a1, params.lam)
+    assert gap1 >= -1e-9
+    np.testing.assert_allclose(gap1, gap0, atol=1e-9)
+
+
+def test_cocoa_fp_matches_dp_only(tiny_data, fp_mesh):
+    # same K on a (K,) mesh and a (K, FP) mesh — identical trajectories
+    params, debug = _params(tiny_data), _debug()
+    mesh_dp = make_mesh(K)
+    ds_dp = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=mesh_dp)
+    w0, a0, _ = run_cocoa(ds_dp, params, debug, plus=True, mesh=mesh_dp,
+                          quiet=True)
+
+    ds_fp = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=fp_mesh)
+    w1, a1, _ = run_cocoa(ds_fp, params, debug, plus=True, mesh=fp_mesh,
+                          quiet=True)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), atol=1e-9)
+
+
+def test_cocoa_fp_scan_chunk(tiny_data, fp_mesh):
+    # the device-side scan driver on an fp mesh — same observable trajectory
+    params, debug = _params(tiny_data), _debug()
+    ds_fp = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=fp_mesh)
+    w0, a0, _ = run_cocoa(ds_fp, params, debug, plus=True, mesh=fp_mesh,
+                          quiet=True)
+    w1, a1, _ = run_cocoa(ds_fp, params, debug, plus=True, mesh=fp_mesh,
+                          quiet=True, scan_chunk=5)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), atol=1e-9)
+
+
+def test_minibatch_cd_fp_matches_local(tiny_data, fp_mesh):
+    params, debug = _params(tiny_data), _debug()
+    ds_local = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    w0, a0, _ = run_minibatch_cd(ds_local, params, debug, quiet=True)
+    ds_fp = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=fp_mesh)
+    w1, a1, _ = run_minibatch_cd(ds_fp, params, debug, mesh=fp_mesh, quiet=True)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), atol=1e-9)
+
+
+def test_dist_gd_fp_matches_local(tiny_data, fp_mesh):
+    from cocoa_tpu.solvers import run_dist_gd
+
+    params, debug = _params(tiny_data), _debug()
+    ds_local = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    w0, _ = run_dist_gd(ds_local, params, debug, quiet=True)
+    ds_fp = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=fp_mesh)
+    w1, _ = run_dist_gd(ds_fp, params, debug, mesh=fp_mesh, quiet=True)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-9)
+
+
+@pytest.mark.parametrize("local", [True, False])
+def test_sgd_fp_matches_local(tiny_data, fp_mesh, local):
+    params, debug = _params(tiny_data), _debug()
+    ds_local = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    w0, _ = run_sgd(ds_local, params, debug, local=local, quiet=True)
+    ds_fp = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=fp_mesh)
+    w1, _ = run_sgd(ds_fp, params, debug, local=local, mesh=fp_mesh, quiet=True)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-9)
