@@ -142,7 +142,11 @@ def main(argv=None) -> int:
     if dtype == jnp.float64:
         jax.config.update("jax_enable_x64", True)
 
-    data = load_libsvm(cfg.train_file, cfg.num_features)
+    try:
+        data = load_libsvm(cfg.train_file, cfg.num_features)
+    except (OSError, ValueError) as e:  # missing file, bad numFeatures, ...
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     n = data.n
     k = cfg.num_splits
 
@@ -174,6 +178,11 @@ def main(argv=None) -> int:
               f"numSplits={k} x fp devices (have {len(jax.devices())}); "
               f"use --mesh=1 for the single-chip path", file=sys.stderr)
         return 2
+    if fp > 1 and explicit and mesh_size == 1:
+        print(f"error: --fp={fp} needs a device mesh and is incompatible "
+              f"with the --mesh=1 single-chip path; drop --mesh or pass "
+              f"--mesh={k}", file=sys.stderr)
+        return 2
     if fp > 1 and mesh_size != k:
         print(f"error: --fp={fp} requires a {k}x{fp}-device mesh "
               f"(numSplits x fp; have {len(jax.devices())} devices)",
@@ -182,11 +191,15 @@ def main(argv=None) -> int:
     if mesh_size == k and (k > 1 or fp > 1):
         mesh = make_mesh(k, fp=fp)
 
-    ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
-    test_ds = None
-    if cfg.test_file:
-        test_data = load_libsvm(cfg.test_file, cfg.num_features)
-        test_ds = shard_dataset(test_data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
+    try:
+        ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
+        test_ds = None
+        if cfg.test_file:
+            test_data = load_libsvm(cfg.test_file, cfg.num_features)
+            test_ds = shard_dataset(test_data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
+    except (OSError, ValueError) as e:  # e.g. --layout=sparse with --fp>1
+        print(f"error: {e}", file=sys.stderr)
+        return 2
 
     params = cfg.to_params(n, k)
     debug = cfg.to_debug()

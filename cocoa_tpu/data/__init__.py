@@ -1,2 +1,8 @@
 from cocoa_tpu.data.libsvm import load_libsvm, LibsvmData  # noqa: F401
 from cocoa_tpu.data.sharding import ShardedDataset, shard_dataset  # noqa: F401
+from cocoa_tpu.data.synth import (  # noqa: F401
+    synth_dense,
+    synth_dense_sharded,
+    synth_sparse,
+    write_libsvm,
+)

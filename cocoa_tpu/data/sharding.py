@@ -31,6 +31,14 @@ from cocoa_tpu.data.libsvm import LibsvmData
 from cocoa_tpu.parallel import mesh as mesh_lib
 
 
+def pad_rows(n_rows: int) -> int:
+    """Shard length rounded up to a sublane multiple (8 f32 / 16 bf16) so
+    Pallas row blocks and XLA tiles stay aligned; padded rows are masked
+    everywhere.  This is THE layout contract — every producer of a
+    :class:`ShardedDataset` (here and data/synth.py) must use it."""
+    return -(-n_rows // 16) * 16
+
+
 def split_sizes(n: int, k: int) -> np.ndarray:
     """Balanced contiguous split: first n % k shards get one extra row.
 
@@ -161,9 +169,7 @@ def shard_dataset(
     np_dtype = np.dtype(dtype)
     sizes = split_sizes(n, k)
     offsets = np.concatenate([[0], np.cumsum(sizes)])
-    # pad shard length to a sublane multiple (8 f32 / 16 bf16) so Pallas row
-    # blocks and XLA tiles stay aligned; padded rows are masked everywhere
-    n_shard = -(-int(sizes.max()) // 16) * 16 if k > 0 else 0
+    n_shard = pad_rows(int(sizes.max())) if k > 0 else 0
 
     labels = np.zeros((k, n_shard), dtype=np_dtype)
     mask = np.zeros((k, n_shard), dtype=np_dtype)
@@ -182,12 +188,8 @@ def shard_dataset(
         sq_norms[s, :m] = row_sq[lo:hi]
 
     kwargs: dict = {}
-    if layout == "dense" and mesh_lib.has_fp(mesh):
-        # pad the feature dim to an fp multiple so columns split evenly;
-        # zero columns touch nothing (no update ever flows into them, and w's
-        # matching padded entries stay exactly 0)
-        fp = mesh.shape[mesh_lib.FP_AXIS]
-        d = -(-d // fp) * fp
+    if layout == "dense":
+        d = mesh_lib.pad_features(d, mesh)
     if layout == "dense":
         X = np.zeros((k, n_shard, d), dtype=np_dtype)
         for s in range(k):
@@ -216,15 +218,8 @@ def shard_dataset(
 
     def put(arr, fp_last=False):
         if mesh is not None:
-            if fp_last and mesh_lib.has_fp(mesh):
-                # X: rows over dp, columns over fp — each device holds an
-                # (n_shard, d/fp) block matching its slice of w
-                spec = jax.sharding.PartitionSpec(
-                    mesh_lib.DP_AXIS, None, mesh_lib.FP_AXIS
-                )
-                return jax.device_put(
-                    arr, jax.sharding.NamedSharding(mesh, spec)
-                )
+            if fp_last:
+                return jax.device_put(arr, mesh_lib.x_sharding(mesh))
             return jax.device_put(
                 arr, mesh_lib.sharded_rows(mesh, extra_dims=arr.ndim - 1)
             )

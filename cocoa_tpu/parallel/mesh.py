@@ -102,3 +102,20 @@ def primal_sharding(mesh: Mesh) -> NamedSharding:
     split over the feature axis on a (dp, fp) mesh — each device then holds
     d/fp of w (and the matching column block of X, see data/sharding.py)."""
     return NamedSharding(mesh, P(FP_AXIS) if has_fp(mesh) else P())
+
+
+def x_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the dense (K, n_shard, d) design matrix: rows over dp,
+    columns over fp (when present) so each device holds the (n_shard, d/fp)
+    block matching its slice of w."""
+    return NamedSharding(
+        mesh, P(DP_AXIS, None, FP_AXIS if has_fp(mesh) else None)
+    )
+
+
+def pad_features(d: int, mesh: Optional[Mesh]) -> int:
+    """d rounded up to an fp multiple (the feature-parallel column split
+    needs equal blocks; zero pad columns touch nothing — no update ever
+    flows into them and w's matching entries stay exactly 0)."""
+    fp = mesh.shape[FP_AXIS] if has_fp(mesh) else 1
+    return -(-d // fp) * fp

@@ -57,13 +57,54 @@ def test_x_is_column_sharded(tiny_data, fp_mesh):
 
 
 def test_sparse_layout_rejected(tiny_data, fp_mesh):
+    from cocoa_tpu.data import synth_sparse
+
     with pytest.raises(ValueError, match="dense"):
         shard_dataset(tiny_data, k=K, layout="sparse", dtype=jnp.float64,
                       mesh=fp_mesh)
-    # auto resolves to dense on an fp mesh even for sparse-ish data
-    ds = shard_dataset(tiny_data, k=K, layout="auto", dtype=jnp.float64,
+    # auto resolves to dense on an fp mesh even for genuinely sparse data
+    # (density < 10%, which auto would otherwise lay out sparse)
+    sparse_data = synth_sparse(64, 512, nnz_mean=10, seed=0)
+    assert sparse_data.indptr[-1] / (64 * 512) < 0.10
+    ds = shard_dataset(sparse_data, k=K, layout="auto", dtype=jnp.float64,
                        mesh=fp_mesh)
     assert ds.layout == "dense"
+
+
+def test_fp_pads_odd_feature_dim(tiny_data, fp_mesh):
+    """d not divisible by fp: columns pad to an fp multiple, the pad tail of
+    w stays exactly 0, and the trajectory matches the unpadded local run."""
+    import dataclasses as dc
+
+    d_odd = tiny_data.num_features - 1  # 23, not divisible by FP=2
+    # drop feature 23 from every row so d=23 is valid
+    keep = tiny_data.indices < d_odd
+    new_nnz = np.cumsum(
+        [np.sum(keep[tiny_data.indptr[i]:tiny_data.indptr[i + 1]])
+         for i in range(tiny_data.n)])
+    odd = dc.replace(
+        tiny_data,
+        indptr=np.concatenate([[0], new_nnz]).astype(np.int64),
+        indices=tiny_data.indices[keep],
+        values=tiny_data.values[keep],
+        num_features=d_odd,
+    )
+    params, debug = _params(odd), _debug()
+
+    ds_local = shard_dataset(odd, k=K, layout="dense", dtype=jnp.float64)
+    assert ds_local.num_features == d_odd
+    w0, a0, _ = run_cocoa(ds_local, params, debug, plus=True, quiet=True)
+
+    ds_fp = shard_dataset(odd, k=K, layout="dense", dtype=jnp.float64,
+                          mesh=fp_mesh)
+    assert ds_fp.num_features == d_odd + 1  # padded to an fp multiple
+    np.testing.assert_array_equal(np.asarray(ds_fp.X)[..., d_odd:], 0.0)
+    w1, a1, _ = run_cocoa(ds_fp, params, debug, plus=True, mesh=fp_mesh,
+                          quiet=True)
+    np.testing.assert_array_equal(np.asarray(w1)[d_odd:], 0.0)
+    np.testing.assert_allclose(np.asarray(w1)[:d_odd], np.asarray(w0),
+                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), atol=1e-9)
 
 
 @pytest.mark.parametrize("plus", [True, False])

@@ -1,0 +1,150 @@
+"""Synthetic benchmark-dataset generators (cocoa_tpu/data/synth.py).
+
+The generators exist to produce the baseline numbers the reference never
+published (SURVEY.md #6, BASELINE.md): epsilon-like dense and rcv1-like
+sparse stand-ins.  Validated here: statistical shape (unit rows, density,
+label balance), determinism, equivalence of the device-side sharded
+generator with the host->shard_dataset path's layout contract, LIBSVM
+round-trips through both parsers, and that the planted problem is actually
+solvable (the duality gap closes under CoCoA+).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data import (
+    load_libsvm,
+    shard_dataset,
+    synth_dense,
+    synth_dense_sharded,
+    synth_sparse,
+    write_libsvm,
+)
+from cocoa_tpu.parallel import make_mesh
+
+
+def test_synth_dense_stats():
+    data = synth_dense(128, 40, seed=3)
+    X = data.to_dense()
+    np.testing.assert_allclose(np.linalg.norm(X, axis=1), 1.0, rtol=1e-12)
+    assert set(np.unique(data.labels)) == {-1.0, 1.0}
+    # planted separator -> roughly balanced labels
+    assert 0.25 < np.mean(data.labels > 0) < 0.75
+    # deterministic in the seed
+    data2 = synth_dense(128, 40, seed=3)
+    np.testing.assert_array_equal(data.values, data2.values)
+    np.testing.assert_array_equal(data.labels, data2.labels)
+    assert not np.array_equal(data.labels, synth_dense(128, 40, seed=4).labels)
+
+
+def test_synth_sparse_stats():
+    n, d, nnz_mean = 200, 500, 30
+    data = synth_sparse(n, d, nnz_mean=nnz_mean, seed=1)
+    row_nnz = np.diff(data.indptr)
+    assert row_nnz.min() >= 1
+    # Poisson(30) minus dedup loss keeps the mean in a wide band
+    assert 15 <= row_nnz.mean() <= 35
+    # rows are unit-normalized
+    for i in range(0, n, 17):
+        _, vals = data.row(i)
+        np.testing.assert_allclose(np.linalg.norm(vals), 1.0, rtol=1e-12)
+    # columns are Zipf-hot: low ids must be much more popular than the tail
+    lo = np.sum(data.indices < d // 10)
+    assert lo > 0.3 * data.indices.size
+    # rows have no duplicate column ids (layout contract)
+    for i in range(0, n, 13):
+        idx, _ = data.row(i)
+        assert np.unique(idx).size == idx.size
+    assert 0.25 < np.mean(data.labels > 0) < 0.75
+
+
+def test_write_libsvm_roundtrip(tmp_path):
+    data = synth_sparse(60, 200, nnz_mean=12, seed=5)
+    path = str(tmp_path / "synth.dat")
+    write_libsvm(data, path, precision=17)
+    for prefer_native in (False, True):
+        back = load_libsvm(path, data.num_features,
+                           prefer_native=prefer_native)
+        np.testing.assert_array_equal(back.labels, data.labels)
+        np.testing.assert_array_equal(back.indptr, data.indptr)
+        np.testing.assert_array_equal(back.indices, data.indices)
+        np.testing.assert_allclose(back.values, data.values, rtol=1e-15)
+
+
+@pytest.mark.parametrize("mesh_k", [None, 4])
+def test_synth_dense_sharded_layout(mesh_k):
+    n, d, k = 100, 32, 4
+    mesh = make_mesh(mesh_k) if mesh_k else None
+    ds = synth_dense_sharded(n, d, k, seed=2, dtype=jnp.float64, mesh=mesh)
+    assert ds.layout == "dense"
+    assert ds.n == n and ds.num_features == d and ds.k == k
+    assert ds.n_shard % 16 == 0
+    counts = np.asarray(ds.counts)
+    np.testing.assert_array_equal(counts, [25, 25, 25, 25])
+    X = np.asarray(ds.X)
+    mask = np.asarray(ds.mask)
+    labels = np.asarray(ds.labels)
+    sq = np.asarray(ds.sq_norms)
+    for s in range(k):
+        c = counts[s]
+        # real rows: unit norm, +-1 labels, mask 1, sq_norms match X
+        np.testing.assert_allclose(
+            np.linalg.norm(X[s, :c], axis=1), 1.0, rtol=1e-6)
+        assert set(np.unique(labels[s, :c])) <= {-1.0, 1.0}
+        np.testing.assert_array_equal(mask[s, :c], 1.0)
+        np.testing.assert_allclose(
+            sq[s], np.sum(X[s] * X[s], axis=-1), rtol=1e-6)
+        # padded rows zeroed
+        np.testing.assert_array_equal(X[s, c:], 0.0)
+        np.testing.assert_array_equal(labels[s, c:], 0.0)
+        np.testing.assert_array_equal(mask[s, c:], 0.0)
+    if mesh is not None:
+        assert len(ds.X.sharding.device_set) == mesh_k
+
+
+def test_synth_dense_sharded_mesh_invariant():
+    """Same (n, d, k, seed) -> same data with and without a mesh."""
+    n, d, k = 64, 16, 4
+    a = synth_dense_sharded(n, d, k, seed=9, dtype=jnp.float32)
+    b = synth_dense_sharded(n, d, k, seed=9, dtype=jnp.float32,
+                            mesh=make_mesh(4))
+    np.testing.assert_array_equal(np.asarray(a.X), np.asarray(b.X))
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+
+
+def test_synth_dense_sharded_fp_mesh():
+    """fp mesh: columns split over the feature axis, d padded to a multiple."""
+    mesh = make_mesh(4, fp=2)
+    ds = synth_dense_sharded(50, 30, 4, seed=1, dtype=jnp.float32, mesh=mesh)
+    assert ds.num_features == 30  # already even
+    shapes = {s.data.shape for s in ds.X.addressable_shards}
+    assert shapes == {(1, ds.n_shard, 15)}
+
+
+def test_synth_problem_converges():
+    """The planted problem is solvable: CoCoA+ closes the duality gap."""
+    from cocoa_tpu.solvers import run_cocoa
+
+    n, d, k = 256, 32, 4
+    ds = synth_dense_sharded(n, d, k, seed=0, flip=0.02, dtype=jnp.float64)
+    params = Params(n=n, num_rounds=300, local_iters=64, lam=1e-3)
+    debug = DebugParams(debug_iter=25, seed=0)
+    _, _, traj = run_cocoa(ds, params, debug, plus=True, quiet=True,
+                           gap_target=1e-3)
+    assert traj.records[-1].gap is not None
+    assert traj.records[-1].gap <= 1e-3
+
+
+def test_synth_sparse_solvable_via_shard_dataset():
+    """synth_sparse -> shard_dataset(sparse layout) -> CoCoA converges."""
+    from cocoa_tpu.solvers import run_cocoa
+
+    data = synth_sparse(240, 300, nnz_mean=20, seed=3)
+    ds = shard_dataset(data, k=4, layout="sparse", dtype=jnp.float64)
+    params = Params(n=data.n, num_rounds=150, local_iters=60, lam=1e-3)
+    _, _, traj = run_cocoa(ds, params, DebugParams(debug_iter=25, seed=0),
+                           plus=True, quiet=True, gap_target=5e-3)
+    assert traj.records[-1].gap <= 5e-3
