@@ -178,7 +178,7 @@ def main(argv=None) -> int:
               f"numSplits={k} x fp devices (have {len(jax.devices())}); "
               f"use --mesh=1 for the single-chip path", file=sys.stderr)
         return 2
-    if fp > 1 and explicit and mesh_size == 1:
+    if fp > 1 and explicit and mesh_size == 1 and k > 1:
         print(f"error: --fp={fp} needs a device mesh and is incompatible "
               f"with the --mesh=1 single-chip path; drop --mesh or pass "
               f"--mesh={k}", file=sys.stderr)

@@ -190,7 +190,6 @@ def shard_dataset(
     kwargs: dict = {}
     if layout == "dense":
         d = mesh_lib.pad_features(d, mesh)
-    if layout == "dense":
         X = np.zeros((k, n_shard, d), dtype=np_dtype)
         for s in range(k):
             lo, hi = offsets[s], offsets[s + 1]

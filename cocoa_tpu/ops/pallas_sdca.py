@@ -11,27 +11,36 @@ Uses the margins decomposition (ops/local_sdca.py ``mode_factors``): the
 per-step margin is ``margins0[idx] + sig_eff·(x·Δw)`` with margins0 = X·w₀
 precomputed outside the kernel as one MXU matvec per round.  Per grid step
 the kernel does one (1, d) VPU dot, scalar box-projection logic, one (1, d)
-axpy, and a masked α write.
+axpy, and an α write.
 
 Grid is (K, H): shard-major, steps inner (TPU grids execute sequentially
 with the last dimension fastest, which is exactly the dependency order).
 
-Mosaic alignment: block shapes must have a second-to-last dim that is a
-multiple of the sublane count (8 for f32) or the full axis.  So:
+**Lane-blocked scalar access.** TPU vectors have no cheap dynamic lane
+indexing; the v1 kernel read every per-step scalar (y, ‖x‖², margins0[idx],
+α[idx]) with a full-width iota-mask reduce — O(n_shard) VPU work per step,
+which at epsilon scale (n_shard = 100K) made each pick cost more than the
+O(d) coordinate update itself.  Instead, the per-shard vectors are laid out
+as (n_shard/128, 128) — lane blocks — so a scalar read is a *dynamic
+sublane slice* (legal and cheap) of one (1, 128) row followed by a 128-wide
+mask pick, and the α write masks one (1, 128) row.  Per-step cost is
+O(d + 128) regardless of shard size.  The caller pads n_shard to a multiple
+of 128 and reshapes; padded entries are never indexed.
+
+Mosaic alignment rules used:
 
 - the sampled row is DMA'd as an 8-row-aligned ``(1, 8, d)`` block at row
   ``(idx//8)*8`` (index map returns block index ``idx//8``) and the kernel
-  selects row ``idx % 8`` with an iota mask — shards are padded to a
-  multiple of 16 rows by ``shard_dataset`` so aligned blocks never overrun;
-- the per-shard vectors (margins0/labels/‖x‖²/α) and both outputs use
-  full-array blocks (full axes are always legal) with constant index maps,
-  so they load into VMEM once and outputs flush to HBM once at the end;
-- the mutable per-shard state lives in ``(1, n)`` / ``(1, d)`` VMEM scratch,
-  initialised at each shard's first step and written back to the output
-  blocks (row-masked) at its last step.
-
-Sampled indices arrive via ``PrefetchScalarGridSpec`` so the row BlockSpec's
-index_map can address X[k, idxs[k, i]//8 ...] ahead of the compute.
+  selects row ``idx % 8`` with a dynamic sublane slice — shards are padded
+  to a multiple of 16 rows by ``shard_dataset`` so aligned blocks never
+  overrun;
+- the per-shard vectors arrive as ``(1, n_blocks, 128)`` blocks selected by
+  the grid's k index (their second-to-last dim is the full axis, which is
+  always legal); they stay VMEM-resident across that shard's H steps and
+  re-DMA only when k advances;
+- outputs (Δw, α) are per-shard blocks too: the kernel writes them at the
+  shard's last step and Pallas flushes each block to HBM when the grid
+  moves to the next shard — no cross-shard masking.
 """
 
 from __future__ import annotations
@@ -45,6 +54,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.local_sdca import mode_factors
+
+LANES = 128
 
 
 def row_block_for(dtype) -> int:
@@ -64,15 +75,14 @@ def row_block_for(dtype) -> int:
 def _kernel(
     idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
     x_ref,           # (1, row_block, d) VMEM: aligned block holding the sample
-    margins0_ref,    # (K, n) VMEM (full array)
-    labels_ref,      # (K, n) VMEM
-    sqn_ref,         # (K, n) VMEM
-    alpha_in_ref,    # (K, n) VMEM
-    dw_ref,          # out (K, d) VMEM (full array, flushed once)
-    alpha_ref,       # out (K, n) VMEM (full array, flushed once)
+    margins0_ref,    # (1, n_blocks, LANES) VMEM: shard k's lane-blocked X·w₀
+    labels_ref,      # (1, n_blocks, LANES) VMEM
+    sqn_ref,         # (1, n_blocks, LANES) VMEM
+    alpha_in_ref,    # (1, n_blocks, LANES) VMEM
+    dw_ref,          # out (1, 1, d) VMEM: shard k's Δw (flushed on k advance)
+    alpha_ref,       # out (1, n_blocks, LANES) VMEM (flushed on k advance)
     dw_acc,          # scratch (1, d) VMEM: this shard's Δw accumulator
-    alpha_sc,        # scratch (1, n) VMEM: this shard's advancing α
-    vec_sc,          # scratch (3, n) VMEM: this shard's labels/‖x‖²/margins0
+    alpha_sc,        # scratch (n_blocks, LANES) VMEM: the advancing α
     *,
     lam_n: float,
     sig_eff: float,
@@ -86,37 +96,25 @@ def _kernel(
     k_ = pl.program_id(0)
     i = pl.program_id(1)
     idx = idxs_ref[k_, i]
-
-    n = alpha_sc.shape[1]
-    k_total = alpha_ref.shape[0]
-    krow = jax.lax.broadcasted_iota(jnp.int32, (k_total, 1), 0) == k_
-
-    @pl.when(jnp.logical_and(k_ == 0, i == 0))
-    def _init_outputs():
-        dw_ref[...] = jnp.zeros_like(dw_ref)
-        alpha_ref[...] = alpha_in_ref[...]
+    blk = idx // LANES
+    sub_lane = idx - blk * LANES
 
     @pl.when(i == 0)
     def _init_shard():
         dw_acc[...] = jnp.zeros_like(dw_acc)
-        # copy this shard's rows into scratch (dynamic sublane slice) so the
-        # per-step scalar picks reduce over n elements, not K·n
-        alpha_sc[...] = alpha_in_ref[pl.ds(k_, 1), :]
-        vec_sc[0:1, :] = labels_ref[pl.ds(k_, 1), :]
-        vec_sc[1:2, :] = sqn_ref[pl.ds(k_, 1), :]
-        vec_sc[2:3, :] = margins0_ref[pl.ds(k_, 1), :]
+        alpha_sc[...] = alpha_in_ref[0]
 
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
-    sel = lane == idx
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    sel = lane == sub_lane
 
-    def pick(row):
-        """Scalar vec[idx] via a lane-idx mask reduce (dynamic lane index)."""
-        return jnp.sum(jnp.where(sel, row, 0.0))
+    def pick(ref):
+        """Scalar ref[idx]: dynamic sublane slice + 128-wide mask reduce."""
+        return jnp.sum(jnp.where(sel, ref[0, pl.ds(blk, 1), :], 0.0))
 
-    y = pick(vec_sc[0:1, :])
-    sq = pick(vec_sc[1:2, :])
-    m0 = pick(vec_sc[2:3, :])
-    a = pick(alpha_sc[...])
+    y = pick(labels_ref)
+    sq = pick(sqn_ref)
+    m0 = pick(margins0_ref)
+    a = jnp.sum(jnp.where(sel, alpha_sc[pl.ds(blk, 1), :], 0.0))
 
     # select row idx % row_block of the aligned block (dynamic sublane slice)
     sub = idx - (idx // row_block) * row_block
@@ -134,12 +132,14 @@ def _kernel(
 
     coef = y * (new_a - a) / lam_n
     dw_acc[...] = dw_acc[...] + coef * x
-    alpha_sc[...] = jnp.where(sel, new_a, alpha_sc[...])
+    alpha_sc[pl.ds(blk, 1), :] = jnp.where(
+        sel, new_a, alpha_sc[pl.ds(blk, 1), :]
+    )
 
     @pl.when(i == h - 1)
     def _flush_shard():
-        dw_ref[...] = jnp.where(krow, dw_acc[...], dw_ref[...])
-        alpha_ref[...] = jnp.where(krow, alpha_sc[...], alpha_ref[...])
+        dw_ref[0] = dw_acc[...]
+        alpha_ref[0] = alpha_sc[...]
 
 
 @functools.partial(
@@ -180,6 +180,14 @@ def pallas_sdca_round(
         )
     sig_eff, qii_factor = mode_factors(mode, sigma)
 
+    # lane-block the per-shard vectors: (K, n_shard) -> (K, n_blocks, 128).
+    # Sampled indices never exceed the shard's true row count, so zero
+    # padding is inert.
+    n_pad = -(-n_shard // LANES) * LANES
+    pad = [(0, 0), (0, n_pad - n_shard)]
+    blocked = lambda v: jnp.pad(v, pad).reshape(k, n_pad // LANES, LANES)  # noqa: E731
+    n_blocks = n_pad // LANES
+
     kernel = functools.partial(
         _kernel,
         lam_n=float(lam * n),
@@ -192,7 +200,9 @@ def pallas_sdca_round(
         smoothing=float(smoothing),
     )
 
-    full = lambda k_, i_, idxs_: (0, 0)  # noqa: E731 — full-array block
+    shard_vec = pl.BlockSpec(
+        (1, n_blocks, LANES), lambda k_, i_, idxs_: (k_, 0, 0)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(k, h),
@@ -202,32 +212,35 @@ def pallas_sdca_round(
                 (1, row_block, d),
                 lambda k_, i_, idxs_: (k_, idxs_[k_, i_] // row_block, 0),
             ),
-            pl.BlockSpec((k, n_shard), full),
-            pl.BlockSpec((k, n_shard), full),
-            pl.BlockSpec((k, n_shard), full),
-            pl.BlockSpec((k, n_shard), full),
+            shard_vec,  # margins0
+            shard_vec,  # labels
+            shard_vec,  # sq_norms
+            shard_vec,  # alpha_in
         ],
         out_specs=[
-            pl.BlockSpec((k, d), full),
-            pl.BlockSpec((k, n_shard), full),
+            # (1, 1, d): a (1, d) block is illegal (second-to-last dim must
+            # divide 8 or span the axis), a singleton middle axis spans
+            pl.BlockSpec((1, 1, d), lambda k_, i_, idxs_: (k_, 0, 0)),
+            shard_vec,
         ],
         scratch_shapes=[
             pltpu.VMEM((1, d), dtype),
-            pltpu.VMEM((1, n_shard), dtype),
-            pltpu.VMEM((3, n_shard), dtype),
+            pltpu.VMEM((n_blocks, LANES), dtype),
         ],
     )
 
-    dw, alpha_inner = pl.pallas_call(
+    dw, alpha_blocked = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((k, d), dtype),
-            jax.ShapeDtypeStruct((k, n_shard), dtype),
+            jax.ShapeDtypeStruct((k, 1, d), dtype),
+            jax.ShapeDtypeStruct((k, n_blocks, LANES), dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(idxs, X, w_margins0, labels, sq_norms, alpha)
-    return dw, alpha_inner
+    )(idxs, X, blocked(w_margins0), blocked(labels), blocked(sq_norms),
+      blocked(alpha))
+    alpha_inner = alpha_blocked.reshape(k, n_pad)[:, :n_shard]
+    return dw.reshape(k, d), alpha_inner

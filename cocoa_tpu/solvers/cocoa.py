@@ -244,19 +244,23 @@ def run_cocoa(
     if pallas is None:
         # auto: the Pallas kernel needs fast math + dense layout + f32 + a
         # real TPU backend (measured ~20% faster than the fori_loop path on
-        # the demo config; the gap widens with shard size as the row DMA
+        # the demo config and ~1.5x at epsilon scale, where its lane-blocked
+        # scalar access keeps the per-step cost O(d + 128) while the row DMA
         # pipeline hides HBM latency) — AND the kernel's VMEM-resident
-        # working set must fit.  The single-chip batched path keeps 5
-        # (k, n_shard) vectors + a (k, d) Δw block + (~4, n_shard)+(1, d)
-        # scratch + double-buffered (8, d) row blocks in VMEM; on a mesh the
-        # per-device k is k/mesh-size.  Budget ~12 MB of the ~16 MB VMEM;
-        # oversized runs keep the fori_loop fast path (explicit pallas=True
-        # overrides, and Mosaic then reports the allocation failure itself).
-        k_dev = k if mesh is None else -(-k // mesh.devices.size)
+        # working set must fit.  Blocks are per-shard regardless of K (the
+        # grid re-DMAs them as k advances): 4 input vectors + the α output
+        # (double-buffered across the k transition) + the α scratch, each
+        # n_shard padded to a lane multiple, plus the Δw scratch/output and
+        # double-buffered (8, d) row blocks.  Budget ~12 MB of the ~16 MB
+        # VMEM; oversized runs keep the fori_loop fast path (explicit
+        # pallas=True overrides, and Mosaic then reports the allocation
+        # failure itself).
+        from cocoa_tpu.ops.pallas_sdca import LANES
+
         itemsize = jnp.dtype(dtype).itemsize
+        n_pad = -(-ds.n_shard // LANES) * LANES
         vmem_bytes = itemsize * (
-            6 * k_dev * ds.n_shard + (k_dev + 1) * ds.num_features
-            + 4 * ds.n_shard + 2 * 8 * ds.num_features
+            11 * n_pad + (2 * 8 + 4) * ds.num_features
         )
         pallas = (
             math == "fast" and ds.layout == "dense"
