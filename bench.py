@@ -21,9 +21,9 @@ import os
 import sys
 import time
 
-# Pinned oracle wall-clock for this config (measured on this machine; see
-# module docstring).  Re-measure with COCOA_BENCH_BASELINE=measure.
-ORACLE_BASELINE_S = None  # filled after first measurement; None = measure live
+# Pinned oracle wall-clock for this config (median of repeated runs on this
+# machine; see module docstring).  Re-measure with COCOA_BENCH_BASELINE=measure.
+ORACLE_BASELINE_S = 2.11
 
 GAP_TARGET = 1e-4
 MAX_ROUNDS = 600  # the demo config crosses 1e-4 around round ~440

@@ -9,31 +9,40 @@ H steps and lets Pallas's grid pipeline prefetch each sampled row HBM→VMEM
 
 Uses the margins decomposition (ops/local_sdca.py ``mode_factors``): the
 per-step margin is ``margins0[idx] + sig_eff·(x·Δw)`` with margins0 = X·w₀
-precomputed outside the kernel as one MXU matvec per round.  Per grid step
-the kernel does one (1, d) VPU dot, scalar box-projection logic, one (1, d)
-axpy, and an α write.
+precomputed outside the kernel as one MXU matvec per round.  Per step the
+kernel does one row·Δw dot, scalar box-projection logic, one row axpy, and
+an α write.
 
-Grid is (K, H): shard-major, steps inner (TPU grids execute sequentially
-with the last dimension fastest, which is exactly the dependency order).
+**Folded rows.**  A (1, d) row uses one sublane — 1/8 of the VPU.  The
+caller reinterprets each dense row as an (8, d/8) tile instead (a free
+reshape: the row is contiguous in HBM), so the per-step O(d) work — the
+Δw dot and the axpy — runs at full VPU width, and the sampled row is its
+own tile-aligned DMA unit (no sublane-alignment tricks).  Requires
+d % 8 == 0; ``shard_dataset`` pads dense feature columns to a multiple of 8
+(zero columns touch nothing), and the wrapper pads on the fly otherwise.
 
-**Lane-blocked scalar access.** TPU vectors have no cheap dynamic lane
-indexing; the v1 kernel read every per-step scalar (y, ‖x‖², margins0[idx],
-α[idx]) with a full-width iota-mask reduce — O(n_shard) VPU work per step,
-which at epsilon scale (n_shard = 100K) made each pick cost more than the
-O(d) coordinate update itself.  Instead, the per-shard vectors are laid out
-as (n_shard/128, 128) — lane blocks — so a scalar read is a *dynamic
-sublane slice* (legal and cheap) of one (1, 128) row followed by a 128-wide
-mask pick, and the α write masks one (1, 128) row.  Per-step cost is
-O(d + 128) regardless of shard size.  The caller pads n_shard to a multiple
-of 128 and reshapes; padded entries are never indexed.
+**Step groups.**  Grid is (K, ceil(H/S)): shard-major, step groups inner
+(TPU grids execute sequentially with the last dimension fastest, which is
+exactly the dependency order).  Each grid iteration runs S sequential
+coordinate steps (unrolled in the kernel body) against S independently-
+prefetched row blocks, amortizing per-grid-step fixed costs — grid
+bookkeeping, DMA issue, pipeline bubbles — over S steps.  Groups past H
+(when S ∤ H) clamp their row index and zero their update — inert, any H
+works.
 
-Mosaic alignment rules used:
+**Lane-blocked scalar access.**  TPU vectors have no cheap dynamic lane
+indexing; reading a per-step scalar (y, ‖x‖², margins0[idx], α[idx]) with a
+full-width iota-mask reduce costs O(n_shard) VPU work per step, which at
+epsilon scale (n_shard = 100K) would dwarf the O(d) coordinate update.
+Instead, the per-shard vectors are laid out as (n_shard/128, 128) — lane
+blocks — so a scalar read is a *dynamic sublane slice* (legal and cheap) of
+one (1, 128) row followed by a 128-wide mask pick, and the α write masks
+one (1, 128) row.  Per-step cost is O(d + 128) regardless of shard size.
 
-- the sampled row is DMA'd as an 8-row-aligned ``(1, 8, d)`` block at row
-  ``(idx//8)*8`` (index map returns block index ``idx//8``) and the kernel
-  selects row ``idx % 8`` with a dynamic sublane slice — shards are padded
-  to a multiple of 16 rows by ``shard_dataset`` so aligned blocks never
-  overrun;
+Block/alignment rules used:
+
+- the sampled row arrives as a (1, 1, 8, d/8) block of the folded
+  (K, n_shard, 8, d/8) X, selected by ``idxs`` via scalar prefetch;
 - the per-shard vectors arrive as ``(1, n_blocks, 128)`` blocks selected by
   the grid's k index (their second-to-last dim is the full axis, which is
   always legal); they stay VMEM-resident across that shard's H steps and
@@ -56,48 +65,85 @@ from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.local_sdca import mode_factors
 
 LANES = 128
+SUBLANES = 8  # f32 sublane count: rows fold to (8, d/8)
+VMEM_BUDGET = 12 << 20  # leave ~4 MB of the ~16 MB VMEM for the compiler
+UNROLL_CANDIDATES = (16, 8, 4, 2, 1)
 
 
-def row_block_for(dtype) -> int:
-    """Sublane count for the aligned row block.  2-byte dtypes are rejected:
-    bf16 SDCA can't certify a 1e-4 duality gap anyway, and the kernel's
-    dynamic sublane slices fail Mosaic lowering under 16-sublane tiling (use
-    the fori_loop path, which handles bf16).  f32 is the TPU path; f64 works
-    in interpret mode (the x64 validation tests)."""
+def check_dtype(dtype) -> None:
+    """2-byte dtypes are rejected: bf16 SDCA can't certify a 1e-4 duality
+    gap anyway, and the folded-row layout assumes 8-sublane (4-byte) tiling
+    (use the fori_loop path, which handles bf16).  f32 is the TPU path; f64
+    works in interpret mode (the x64 validation tests)."""
     if jnp.dtype(dtype).itemsize < 4:
         raise ValueError(
             f"the Pallas SDCA kernel does not support 2-byte dtypes, got "
             f"{jnp.dtype(dtype).name}; use math='fast' without pallas"
         )
-    return 8
+
+
+def vmem_estimate(n_shard: int, d: int, itemsize: int, unroll: int) -> int:
+    """Rough VMEM working set of the kernel: the 4 lane-blocked per-shard
+    input vectors + α output (double-buffered across the k advance) + the α
+    scratch (11 n_pad-vectors total), the Δw scratch/output plus temporaries
+    (~4 d-vectors), and ``unroll`` double-buffered folded row blocks."""
+    n_pad = -(-n_shard // LANES) * LANES
+    return itemsize * (11 * n_pad + (2 * unroll + 4) * d)
+
+
+def pick_unroll(n_shard: int, d: int, itemsize: int, h: int) -> int:
+    """Largest step-group size whose row blocks still fit the VMEM budget
+    (0 if even S=1 does not fit — caller should stay on the fori_loop
+    path)."""
+    for s in UNROLL_CANDIDATES:
+        if s <= max(1, h) and vmem_estimate(n_shard, d, itemsize, s) <= VMEM_BUDGET:
+            return s
+    return 0
+
+
+def fold_rows(X: jax.Array) -> jax.Array:
+    """(K, n_shard, d) -> (K, n_shard, 8, d/8): the kernel's folded-row
+    operand.  The fold is a physical relayout on TPU (the 3-D and 4-D tiled
+    layouts differ), so hot paths call this ONCE per dispatch — outside
+    ``lax.scan``/``lax.while_loop`` — and pass the folded array through the
+    loop; folding inside the round body would relayout the whole X every
+    round (measured: 2×0.3 ms/round at demo scale, the entire kernel's cost
+    many times over)."""
+    k, n_shard, d = X.shape
+    if d % SUBLANES:
+        X = jnp.pad(X, ((0, 0), (0, 0), (0, SUBLANES - d % SUBLANES)))
+        d = X.shape[-1]
+    return X.reshape(k, n_shard, SUBLANES, d // SUBLANES)
 
 
 def _kernel(
     idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
-    x_ref,           # (1, row_block, d) VMEM: aligned block holding the sample
-    margins0_ref,    # (1, n_blocks, LANES) VMEM: shard k's lane-blocked X·w₀
-    labels_ref,      # (1, n_blocks, LANES) VMEM
-    sqn_ref,         # (1, n_blocks, LANES) VMEM
-    alpha_in_ref,    # (1, n_blocks, LANES) VMEM
-    dw_ref,          # out (1, 1, d) VMEM: shard k's Δw (flushed on k advance)
-    alpha_ref,       # out (1, n_blocks, LANES) VMEM (flushed on k advance)
-    dw_acc,          # scratch (1, d) VMEM: this shard's Δw accumulator
-    alpha_sc,        # scratch (n_blocks, LANES) VMEM: the advancing α
-    *,
+    *refs,           # S row blocks, 4 shard vecs, 2 outs, 2 scratch (below)
     lam_n: float,
     sig_eff: float,
     qii_factor: float,
     frozen: bool,
     h: int,
-    row_block: int,
     loss: str,
     smoothing: float,
+    unroll: int,
+    n_groups: int,
 ):
+    # refs layout:
+    #   x_refs[j]      (1, 1, 8, d8) VMEM: folded row of sample j
+    #   margins0_ref   (1, n_blocks, LANES) VMEM: shard k's lane-blocked X·w₀
+    #   labels_ref     (1, n_blocks, LANES) VMEM
+    #   sqn_ref        (1, n_blocks, LANES) VMEM
+    #   alpha_in_ref   (1, n_blocks, LANES) VMEM
+    #   dw_ref         out (1, 8, d8) VMEM: shard k's Δw (flushed on k advance)
+    #   alpha_ref      out (1, n_blocks, LANES) VMEM (flushed on k advance)
+    #   dw_acc         scratch (8, d8) VMEM: this shard's Δw accumulator
+    #   alpha_sc       scratch (n_blocks, LANES) VMEM: the advancing α
+    x_refs = refs[:unroll]
+    (margins0_ref, labels_ref, sqn_ref, alpha_in_ref,
+     dw_ref, alpha_ref, dw_acc, alpha_sc) = refs[unroll:]
     k_ = pl.program_id(0)
     i = pl.program_id(1)
-    idx = idxs_ref[k_, i]
-    blk = idx // LANES
-    sub_lane = idx - blk * LANES
 
     @pl.when(i == 0)
     def _init_shard():
@@ -105,38 +151,47 @@ def _kernel(
         alpha_sc[...] = alpha_in_ref[0]
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-    sel = lane == sub_lane
 
-    def pick(ref):
-        """Scalar ref[idx]: dynamic sublane slice + 128-wide mask reduce."""
-        return jnp.sum(jnp.where(sel, ref[0, pl.ds(blk, 1), :], 0.0))
+    # S sequential coordinate steps per grid iteration, each against its own
+    # prefetched row block; step j reads the dw_acc/alpha_sc written by j-1
+    for j in range(unroll):
+        step = i * unroll + j
+        # groups past H clamp their index (the row spec's index map does the
+        # same clamp, so the DMA'd block matches) and zero their update
+        idx = idxs_ref[k_, jnp.minimum(step, h - 1)]
+        live = step < h
+        blk = idx // LANES
+        sub_lane = idx - blk * LANES
+        sel = lane == sub_lane
 
-    y = pick(labels_ref)
-    sq = pick(sqn_ref)
-    m0 = pick(margins0_ref)
-    a = jnp.sum(jnp.where(sel, alpha_sc[pl.ds(blk, 1), :], 0.0))
+        def pick(ref, blk=blk, sel=sel):
+            """Scalar ref[idx]: dynamic sublane slice + 128-wide mask reduce."""
+            return jnp.sum(jnp.where(sel, ref[0, pl.ds(blk, 1), :], 0.0))
 
-    # select row idx % row_block of the aligned block (dynamic sublane slice)
-    sub = idx - (idx // row_block) * row_block
-    x = x_ref[0, pl.ds(sub, 1), :]
+        y = pick(labels_ref)
+        sq = pick(sqn_ref)
+        m0 = pick(margins0_ref)
+        a = jnp.sum(jnp.where(sel, alpha_sc[pl.ds(blk, 1), :], 0.0))
 
-    if frozen:
-        margin = m0
-    else:
-        xdw = jnp.sum(x * dw_acc[...])
-        margin = m0 + sig_eff * xdw
-    # the dual coordinate update is pure scalar jnp — shared with the
-    # fori_loop kernels via ops/losses.py (hinge = CoCoA.scala:166-178)
-    new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
-                              smoothing=smoothing)
+        x = x_refs[j][0, 0]  # (8, d8): the folded sampled row
 
-    coef = y * (new_a - a) / lam_n
-    dw_acc[...] = dw_acc[...] + coef * x
-    alpha_sc[pl.ds(blk, 1), :] = jnp.where(
-        sel, new_a, alpha_sc[pl.ds(blk, 1), :]
-    )
+        if frozen:
+            margin = m0
+        else:
+            xdw = jnp.sum(x * dw_acc[...])
+            margin = m0 + sig_eff * xdw
+        # the dual coordinate update is pure scalar jnp — shared with the
+        # fori_loop kernels via ops/losses.py (hinge = CoCoA.scala:166-178)
+        new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
+                                  smoothing=smoothing)
 
-    @pl.when(i == h - 1)
+        coef = jnp.where(live, y * (new_a - a) / lam_n, 0.0)
+        dw_acc[...] = dw_acc[...] + coef * x
+        alpha_sc[pl.ds(blk, 1), :] = jnp.where(
+            sel & live, new_a, alpha_sc[pl.ds(blk, 1), :]
+        )
+
+    @pl.when(i == n_groups - 1)
     def _flush_shard():
         dw_ref[0] = dw_acc[...]
         alpha_ref[0] = alpha_sc[...]
@@ -145,7 +200,7 @@ def _kernel(
 @functools.partial(
     jax.jit,
     static_argnames=("lam", "n", "mode", "sigma", "interpret", "loss",
-                     "smoothing"),
+                     "smoothing", "unroll"),
 )
 def pallas_sdca_round(
     w_margins0: jax.Array,   # (K, n_shard) precomputed X·w₀ per shard
@@ -161,23 +216,42 @@ def pallas_sdca_round(
     interpret: bool = False,
     loss: str = "hinge",
     smoothing: float = 1.0,
+    unroll: int = 0,
 ):
     """One SDCA round for K shards on this chip.  Returns (dw, alpha_inner):
     dw (K, d) unreduced per-shard updates; alpha_inner (K, n_shard) the
     locally-advanced alpha (callers apply the outer scaling law).
 
-    Requires n_shard % 8 == 0 (shard_dataset pads to 16).  Inside
-    ``shard_map`` this must run under ``check_vma=False`` (the chunked
-    driver does; pallas_call's internal slices confuse the VMA checker)."""
-    k, n_shard, d = X.shape
+    ``unroll`` = coordinate steps per grid iteration (0 = auto: the largest
+    of 16/8/4/2/1 whose row blocks fit the VMEM budget).  Any value yields
+    the same math — it only changes DMA batching.
+
+    Inside ``shard_map`` this must run under ``check_vma=False`` (the
+    chunked driver does; pallas_call's internal slices confuse the VMA
+    checker)."""
+    if X.ndim == 4:
+        # pre-folded (K, n_shard, 8, d/8) — the hot paths fold once per run
+        # OUTSIDE the round loop: folding in here would relayout the whole X
+        # every round (the 3-D and 4-D tiled layouts differ physically)
+        k, n_shard, _, d8 = X.shape
+        d = d_orig = SUBLANES * d8
+        X_folded = X
+    else:
+        k, n_shard, d = X.shape
+        d_orig = d
+        if d % SUBLANES:
+            # hot configs avoid this copy: shard_dataset pads dense d to 8
+            pad = SUBLANES - d % SUBLANES
+            X = jnp.pad(X, ((0, 0), (0, 0), (0, pad)))
+            d += pad
+        d8 = d // SUBLANES
+        X_folded = X.reshape(k, n_shard, SUBLANES, d8)
     h = idxs.shape[1]
     dtype = X.dtype
-    row_block = row_block_for(dtype)
-    if n_shard % row_block != 0:
-        raise ValueError(
-            f"n_shard must be a multiple of {row_block} for the aligned row "
-            f"blocks ({dtype}), got {n_shard} (shard_dataset pads to 16)"
-        )
+    check_dtype(dtype)
+    if not unroll:
+        unroll = pick_unroll(n_shard, d, jnp.dtype(dtype).itemsize, h) or 1
+    n_groups = -(-h // unroll)
     sig_eff, qii_factor = mode_factors(mode, sigma)
 
     # lane-block the per-shard vectors: (K, n_shard) -> (K, n_blocks, 128).
@@ -195,36 +269,40 @@ def pallas_sdca_round(
         qii_factor=float(qii_factor),
         frozen=(mode == "frozen"),
         h=h,
-        row_block=row_block,
         loss=losses.validate(loss, smoothing),
         smoothing=float(smoothing),
+        unroll=unroll,
+        n_groups=n_groups,
     )
+
+    def row_spec(j):
+        # sample j of group i: the folded row at [k, idx, :, :]; groups past
+        # H clamp to the last sample (matching the kernel)
+        def index_map(k_, i_, idxs_):
+            step = jnp.minimum(i_ * unroll + j, h - 1)
+            return (k_, idxs_[k_, step], 0, 0)
+
+        return pl.BlockSpec((1, 1, SUBLANES, d8), index_map)
 
     shard_vec = pl.BlockSpec(
         (1, n_blocks, LANES), lambda k_, i_, idxs_: (k_, 0, 0)
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(k, h),
+        grid=(k, n_groups),
         in_specs=[
-            # the sampled row: sublane-aligned block at [k, idx//rb*rb, :]
-            pl.BlockSpec(
-                (1, row_block, d),
-                lambda k_, i_, idxs_: (k_, idxs_[k_, i_] // row_block, 0),
-            ),
+            *[row_spec(j) for j in range(unroll)],
             shard_vec,  # margins0
             shard_vec,  # labels
             shard_vec,  # sq_norms
             shard_vec,  # alpha_in
         ],
         out_specs=[
-            # (1, 1, d): a (1, d) block is illegal (second-to-last dim must
-            # divide 8 or span the axis), a singleton middle axis spans
-            pl.BlockSpec((1, 1, d), lambda k_, i_, idxs_: (k_, 0, 0)),
+            pl.BlockSpec((1, SUBLANES, d8), lambda k_, i_, idxs_: (k_, 0, 0)),
             shard_vec,
         ],
         scratch_shapes=[
-            pltpu.VMEM((1, d), dtype),
+            pltpu.VMEM((SUBLANES, d8), dtype),
             pltpu.VMEM((n_blocks, LANES), dtype),
         ],
     )
@@ -233,14 +311,14 @@ def pallas_sdca_round(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((k, 1, d), dtype),
+            jax.ShapeDtypeStruct((k, SUBLANES, d8), dtype),
             jax.ShapeDtypeStruct((k, n_blocks, LANES), dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(idxs, X, blocked(w_margins0), blocked(labels), blocked(sq_norms),
-      blocked(alpha))
+    )(idxs, *([X_folded] * unroll), blocked(w_margins0), blocked(labels),
+      blocked(sq_norms), blocked(alpha))
     alpha_inner = alpha_blocked.reshape(k, n_pad)[:, :n_shard]
-    return dw.reshape(k, d), alpha_inner
+    return dw.reshape(k, d)[:, :d_orig], alpha_inner

@@ -114,8 +114,12 @@ def x_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def pad_features(d: int, mesh: Optional[Mesh]) -> int:
-    """d rounded up to an fp multiple (the feature-parallel column split
-    needs equal blocks; zero pad columns touch nothing — no update ever
-    flows into them and w's matching entries stay exactly 0)."""
+    """d rounded up to an fp-and-sublane multiple.  The feature-parallel
+    column split needs equal blocks; the Pallas SDCA kernel's folded-row
+    layout needs d % 8 == 0.  Zero pad columns touch nothing — no update
+    ever flows into them and w's matching entries stay exactly 0."""
+    import math
+
     fp = mesh.shape[FP_AXIS] if has_fp(mesh) else 1
-    return -(-d // fp) * fp
+    m = math.lcm(fp, 8)
+    return -(-d // m) * m

@@ -81,8 +81,9 @@ def _cocoa_round_parts(
             # confuse the VMA checker)
             from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
 
+            Xf = shard_k.get("X_folded", shard_k["X"])
             dw, a_inner = pallas_sdca_round(
-                m0[None], alpha_k[None], shard_k["X"][None],
+                m0[None], alpha_k[None], Xf[None],
                 shard_k["labels"][None], shard_k["sq_norms"][None],
                 idxs_k[None], params.lam, params.n,
                 mode=mode, sigma=sigma, interpret=pallas_interpret,
@@ -105,8 +106,9 @@ def _cocoa_round_parts(
             from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
 
             m0 = shard_margins(w, shards)   # (K, n_shard): batched matvec
+            Xf = shards.get("X_folded", shards["X"])
             dw, a_inner = pallas_sdca_round(
-                m0, alpha, shards["X"], shards["labels"], shards["sq_norms"],
+                m0, alpha, Xf, shards["labels"], shards["sq_norms"],
                 idxs_kh, params.lam, params.n,
                 mode=mode, sigma=sigma, interpret=pallas_interpret,
                 loss=params.loss, smoothing=params.smoothing,
@@ -135,7 +137,9 @@ def _make_chunk_kernel(mesh, params: Params, k: int, plus: bool, **parts_kw):
     """The un-jitted traceable chunk body shared by :func:`make_chunk_step`
     and the device-resident driver (so the two cannot diverge):
     (w, alpha, idxs_ckh, shard_arrays) -> (w', alpha'), C rounds as one
-    ``lax.scan`` (parallel/fanout.py chunk_fanout)."""
+    ``lax.scan`` (parallel/fanout.py chunk_fanout).  On Pallas configs the
+    caller (run_cocoa) pre-folds ``shard_arrays["X_folded"]`` once per run —
+    the kernel itself never folds, so no per-dispatch relayout."""
     from cocoa_tpu.parallel.fanout import chunk_fanout
 
     per_shard, per_round_batched, apply_fn = _cocoa_round_parts(
@@ -243,30 +247,25 @@ def run_cocoa(
     platform = jax.devices()[0].platform
     if pallas is None:
         # auto: the Pallas kernel needs fast math + dense layout + f32 + a
-        # real TPU backend (measured ~20% faster than the fori_loop path on
-        # the demo config and ~1.5x at epsilon scale, where its lane-blocked
-        # scalar access keeps the per-step cost O(d + 128) while the row DMA
-        # pipeline hides HBM latency) — AND the kernel's VMEM-resident
-        # working set must fit.  Blocks are per-shard regardless of K (the
-        # grid re-DMAs them as k advances): 4 input vectors + the α output
-        # (double-buffered across the k transition) + the α scratch, each
-        # n_shard padded to a lane multiple, plus the Δw scratch/output and
-        # double-buffered (8, d) row blocks.  Budget ~12 MB of the ~16 MB
-        # VMEM; oversized runs keep the fori_loop fast path (explicit
-        # pallas=True overrides, and Mosaic then reports the allocation
-        # failure itself).
-        from cocoa_tpu.ops.pallas_sdca import LANES
+        # real TPU backend (measured ~4x faster rounds than the fori_loop
+        # path at epsilon scale: folded rows run the O(d) work at full VPU
+        # width, lane-blocked scalar access keeps the per-step cost
+        # O(d + 128), and the row-block DMA pipeline hides HBM latency) —
+        # AND the kernel's VMEM-resident
+        # working set must fit (pallas_sdca.vmem_estimate/pick_unroll own
+        # that accounting — pick_unroll also chooses how many row DMAs to
+        # batch per grid step).  Oversized runs keep the fori_loop fast path
+        # (explicit pallas=True overrides, and Mosaic then reports the
+        # allocation failure itself).
+        from cocoa_tpu.ops.pallas_sdca import pick_unroll
 
         itemsize = jnp.dtype(dtype).itemsize
-        n_pad = -(-ds.n_shard // LANES) * LANES
-        vmem_bytes = itemsize * (
-            11 * n_pad + (2 * 8 + 4) * ds.num_features
-        )
         pallas = (
             math == "fast" and ds.layout == "dense"
             and itemsize == 4
             and platform in ("tpu", "axon")
-            and vmem_bytes <= 12 << 20
+            and pick_unroll(ds.n_shard, ds.num_features, itemsize,
+                            params.local_iters) > 0
             # the kernel's VMEM blocks assume the full d per device;
             # feature-parallel runs keep the fori_loop fast path
             and not has_fp(mesh)
@@ -297,6 +296,13 @@ def run_cocoa(
 
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
     shard_arrays = ds.shard_arrays()
+    if pallas:
+        # fold X for the kernel ONCE per run, up front — the per-dispatch
+        # prepare hooks below then no-op (idempotent), so the host-stepped
+        # scan_chunk path does not pay the relayout every dispatch
+        from cocoa_tpu.ops.pallas_sdca import fold_rows
+
+        shard_arrays = {**shard_arrays, "X_folded": fold_rows(shard_arrays["X"])}
 
     def eval_fn(state):
         w, alpha = state

@@ -88,6 +88,40 @@ def test_pallas_interpret_matches_xla_fast(tiny_data, mode, sigma):
                                    np.asarray(da), atol=1e-14)
 
 
+@pytest.mark.parametrize("unroll", [1, 2, 4, 8])
+def test_pallas_unroll_invariant(tiny_data, unroll):
+    """The step-group size S is a pure DMA-batching knob: every S must
+    produce the same (dw, alpha) to machine precision — same op sequence,
+    XLA may fuse the unrolled body differently — including S ∤ H (the
+    clamped inert tail)."""
+    k = 2
+    ds = shard_dataset(tiny_data, k=k, layout="dense", dtype=jnp.float64)
+    rng = np.random.default_rng(3)
+    d = tiny_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(k, ds.n_shard)) * 0.3 + 0.3, 0, 1)
+    )
+    h = 27  # not divisible by any S > 1
+    idxs = jnp.asarray(
+        sample_indices_per_shard(9, range(1, 2), h, ds.counts)[:, 0, :]
+    )
+    m0 = jnp.einsum("knd,d->kn", ds.X, w)
+    kw = dict(mode="plus", sigma=2.0, interpret=True)
+    dw_1, a_1 = pallas_sdca_round(
+        m0, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
+        unroll=1, **kw,
+    )
+    dw_s, a_s = pallas_sdca_round(
+        m0, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
+        unroll=unroll, **kw,
+    )
+    np.testing.assert_allclose(np.asarray(dw_s), np.asarray(dw_1),
+                               rtol=0, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(a_s), np.asarray(a_1),
+                               rtol=0, atol=1e-13)
+
+
 @pytest.mark.parametrize("plus", [True, False])
 def test_fast_solver_converges_like_exact(tiny_data, plus):
     ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)

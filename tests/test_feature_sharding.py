@@ -72,8 +72,10 @@ def test_sparse_layout_rejected(tiny_data, fp_mesh):
 
 
 def test_fp_pads_odd_feature_dim(tiny_data, fp_mesh):
-    """d not divisible by fp: columns pad to an fp multiple, the pad tail of
-    w stays exactly 0, and the trajectory matches the unpadded local run."""
+    """d not divisible by fp: columns pad to an fp-and-sublane multiple, the
+    pad tail of w stays exactly 0, and the trajectory matches the local
+    run.  (Dense layouts always pad d to a multiple of 8 — the Pallas
+    folded-row contract — so both runs here land on d=24.)"""
     import dataclasses as dc
 
     d_odd = tiny_data.num_features - 1  # 23, not divisible by FP=2
@@ -92,18 +94,19 @@ def test_fp_pads_odd_feature_dim(tiny_data, fp_mesh):
     params, debug = _params(odd), _debug()
 
     ds_local = shard_dataset(odd, k=K, layout="dense", dtype=jnp.float64)
-    assert ds_local.num_features == d_odd
+    assert ds_local.num_features == d_odd + 1  # sublane multiple
     w0, a0, _ = run_cocoa(ds_local, params, debug, plus=True, quiet=True)
+    np.testing.assert_array_equal(np.asarray(w0)[d_odd:], 0.0)
 
     ds_fp = shard_dataset(odd, k=K, layout="dense", dtype=jnp.float64,
                           mesh=fp_mesh)
-    assert ds_fp.num_features == d_odd + 1  # padded to an fp multiple
+    assert ds_fp.num_features == d_odd + 1  # lcm(fp, 8) multiple
     np.testing.assert_array_equal(np.asarray(ds_fp.X)[..., d_odd:], 0.0)
     w1, a1, _ = run_cocoa(ds_fp, params, debug, plus=True, mesh=fp_mesh,
                           quiet=True)
     np.testing.assert_array_equal(np.asarray(w1)[d_odd:], 0.0)
-    np.testing.assert_allclose(np.asarray(w1)[:d_odd], np.asarray(w0),
-                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(w1)[:d_odd],
+                               np.asarray(w0)[:d_odd], atol=1e-9)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), atol=1e-9)
 
 

@@ -115,7 +115,7 @@ def test_zero_row_qii_zero_sets_alpha_one(tiny_data):
     )
     ds = shard_dataset(data, k=1, layout="dense", dtype=jnp.float64)
     shard = {k: v[0] for k, v in ds.shard_arrays().items()}
-    w = jnp.zeros(d, dtype=jnp.float64)
+    w = jnp.zeros(ds.num_features, dtype=jnp.float64)  # d padded to 8
     alpha = jnp.zeros(2, dtype=jnp.float64)
     idxs = jnp.asarray([0], dtype=jnp.int32)  # hit the empty row
     da, dw = local_sdca(w, alpha, shard, idxs, 0.5, 2, mode="cocoa")

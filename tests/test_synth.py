@@ -119,9 +119,9 @@ def test_synth_dense_sharded_fp_mesh():
     """fp mesh: columns split over the feature axis, d padded to a multiple."""
     mesh = make_mesh(4, fp=2)
     ds = synth_dense_sharded(50, 30, 4, seed=1, dtype=jnp.float32, mesh=mesh)
-    assert ds.num_features == 30  # already even
+    assert ds.num_features == 32  # lcm(fp=2, sublane=8) multiple
     shapes = {s.data.shape for s in ds.X.addressable_shards}
-    assert shapes == {(1, ds.n_shard, 15)}
+    assert shapes == {(1, ds.n_shard, 16)}
 
 
 def test_synth_problem_converges():
