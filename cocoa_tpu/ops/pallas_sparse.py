@@ -1,0 +1,310 @@
+"""Pallas TPU kernel for the sequential SDCA inner loop — padded-CSR layout.
+
+The XLA lowering of the sparse inner loop (ops/local_sdca.py with the
+padded-CSR row accessors, ops/rows.py:46,53) serializes the per-nonzero
+gather into ``Δw``/``w`` and the scatter-add back — measured ~44 µs per
+coordinate step at rcv1 scale, plus a ~13 ms/round batched gather to
+precompute the round's margins.  This kernel removes both:
+
+- ``w`` and the Δw accumulator live **lane-blocked** in VMEM as
+  (ceil(d/128), 128) tiles (d=47K ⇒ ~185 KB each), so a nonzero's
+  coordinate read is a dynamic *sublane* slice (legal and cheap) of one
+  (1, 128) row + a 128-wide mask pick, and the scatter is a masked (1, 128)
+  row update — per nonzero O(128) VPU work regardless of d.
+- margins are computed **in-kernel** from the VMEM-resident ``w``
+  (``margin = x·w + sig_eff·(x·Δw)``, the same decomposition as
+  ops/local_sdca.py ``mode_factors`` with margins0 evaluated on the fly),
+  so the per-round whole-shard margins gather disappears.
+
+Addressing constraint: Mosaic has no vector→scalar extraction, so every
+dynamic address must come from SMEM.  The sampled rows' **feature indices**
+are therefore gathered host^W device-side outside the kernel into a
+(K, H, max_nnz) int32 table and scalar-prefetched (SMEM); the row
+**values** stay in VMEM — the value of nonzero j is picked vectorially
+with a static lane-j mask (j is a Python unroll index), never needed as a
+scalar address.
+
+Grid is (K, H): shard-major, steps inner (sequential, the dependency
+order).  Padded nonzero slots carry index 0 / value 0 and contribute
+exactly 0 to every pick and scatter — no masking needed (same inertness
+trick as the XLA path, ops/rows.py:10-11).
+
+Size guards: the SMEM index table is K·H_seg·max_nnz ints and must stay
+under ``SMEM_IDX_BUDGET`` (512 KB — the 712 KB full-round rcv1 table
+fails Mosaic compilation, so rounds split into SMEM-sized segments with
+the lane-blocked Δw/α carried between them); ``sparse_kernel_fits``
+checks the VMEM working set (lane-blocked d-vectors + per-shard
+vectors).  Oversized configs keep the XLA fori_loop path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cocoa_tpu.ops import losses
+from cocoa_tpu.ops.local_sdca import mode_factors
+from cocoa_tpu.ops.pallas_sdca import LANES, check_dtype
+
+ROW_BLOCK = 8          # aligned sublane block for the per-step value row
+SMEM_IDX_BUDGET = 512 << 10
+VMEM_BUDGET = 12 << 20
+
+
+def sparse_vmem_estimate(n_shard: int, d: int, max_nnz: int, itemsize: int) -> int:
+    """Lane-blocked d-vectors — w (x1), Δw carried input (double-buffered,
+    x2), Δw output (double-buffered, x2), Δw scratch (x1), plus slack for
+    temporaries (x1) — the per-shard vectors (4 inputs + α output
+    double-buffered + α scratch), and the double-buffered (8, max_nnz)
+    value block."""
+    n_pad = -(-n_shard // LANES) * LANES
+    d_pad = -(-d // LANES) * LANES
+    return itemsize * (11 * n_pad + 7 * d_pad + 2 * ROW_BLOCK * max_nnz)
+
+
+def sparse_kernel_fits(k: int, n_shard: int, d: int, max_nnz: int, h: int,
+                       itemsize: int) -> bool:
+    """VMEM feasibility (the SMEM index-table limit is handled by splitting
+    the round into segments — see :func:`pallas_sparse_sdca_round`)."""
+    del h
+    return (
+        segment_len(k, max_nnz) >= 1
+        and sparse_vmem_estimate(n_shard, d, max_nnz, itemsize) <= VMEM_BUDGET
+    )
+
+
+def segment_len(k: int, max_nnz: int) -> int:
+    """Steps per kernel invocation so the (K, H_seg, max_nnz) int32 SMEM
+    feature-index table stays inside the budget."""
+    return SMEM_IDX_BUDGET // (4 * k * max(1, max_nnz))
+
+
+def _kernel(
+    idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
+    gidx_ref,        # scalar-prefetch: (K, H, W) int32 feature indices
+    val_ref,         # (1, ROW_BLOCK, W) VMEM: aligned block holding the row
+    w_ref,           # (1, n_dblk, LANES) VMEM: lane-blocked w (replicated)
+    labels_ref,      # (1, n_blocks, LANES) VMEM
+    sqn_ref,         # (1, n_blocks, LANES) VMEM
+    alpha_in_ref,    # (1, n_blocks, LANES) VMEM
+    dw_in_ref,       # (1, n_dblk, LANES) VMEM: Δw carried from prior segment
+    dw_ref,          # out (1, n_dblk, LANES): shard k's lane-blocked Δw
+    alpha_ref,       # out (1, n_blocks, LANES)
+    dw_acc,          # scratch (n_dblk, LANES)
+    alpha_sc,        # scratch (n_blocks, LANES)
+    *,
+    lam_n: float,
+    sig_eff: float,
+    qii_factor: float,
+    frozen: bool,
+    h: int,
+    w_nnz: int,
+    loss: str,
+    smoothing: float,
+):
+    k_ = pl.program_id(0)
+    i = pl.program_id(1)
+    idx = idxs_ref[k_, i]
+
+    @pl.when(i == 0)
+    def _init_shard():
+        dw_acc[...] = dw_in_ref[0]
+        alpha_sc[...] = alpha_in_ref[0]
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    blk = idx // LANES
+    sub_lane = idx - blk * LANES
+    sel = lane == sub_lane
+
+    def pick(ref):
+        return jnp.sum(jnp.where(sel, ref[0, pl.ds(blk, 1), :], 0.0))
+
+    y = pick(labels_ref)
+    sq = pick(sqn_ref)
+    a = jnp.sum(jnp.where(sel, alpha_sc[pl.ds(blk, 1), :], 0.0))
+
+    # the sampled row's values: sublane idx % 8 of the aligned value block
+    sub = idx - (idx // ROW_BLOCK) * ROW_BLOCK
+    val_row = val_ref[0, pl.ds(sub, 1), :]          # (1, W)
+    vlane = jax.lax.broadcasted_iota(jnp.int32, val_row.shape, 1)
+
+    # margin = x·w + sig_eff·(x·Δw), one pass over the row's nonzeros; the
+    # feature addresses come from SMEM, the values from lane-j masks (j is
+    # a Python index).  Padded slots (idx 0, val 0) contribute exactly 0.
+    margin = jnp.asarray(0.0, val_row.dtype)
+    fblk = []
+    fsel = []
+    vals = []
+    for j in range(w_nnz):
+        f = gidx_ref[k_, i, j]
+        fb = f // LANES
+        fs = lane == (f - fb * LANES)
+        vj = jnp.sum(jnp.where(vlane == j, val_row, 0.0))
+        fblk.append(fb)
+        fsel.append(fs)
+        vals.append(vj)
+        coord = jnp.sum(jnp.where(fs, w_ref[0, pl.ds(fb, 1), :], 0.0))
+        if not frozen:
+            coord = coord + sig_eff * jnp.sum(
+                jnp.where(fs, dw_acc[pl.ds(fb, 1), :], 0.0)
+            )
+        margin = margin + vj * coord
+
+    new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
+                              smoothing=smoothing)
+    coef = y * (new_a - a) / lam_n
+
+    # scatter-add coef·x into Δw: one masked (1, 128) row update per nonzero
+    for j in range(w_nnz):
+        dw_acc[pl.ds(fblk[j], 1), :] = jnp.where(
+            fsel[j],
+            dw_acc[pl.ds(fblk[j], 1), :] + coef * vals[j],
+            dw_acc[pl.ds(fblk[j], 1), :],
+        )
+    alpha_sc[pl.ds(blk, 1), :] = jnp.where(
+        sel, new_a, alpha_sc[pl.ds(blk, 1), :]
+    )
+
+    @pl.when(i == h - 1)
+    def _flush_shard():
+        dw_ref[0] = dw_acc[...]
+        alpha_ref[0] = alpha_sc[...]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lam", "n", "mode", "sigma", "interpret", "loss",
+                     "smoothing"),
+)
+def pallas_sparse_sdca_round(
+    w: jax.Array,            # (d,) the round's primal vector (replicated)
+    alpha: jax.Array,        # (K, n_shard)
+    sp_indices: jax.Array,   # (K, n_shard, W) int32 padded-CSR columns
+    sp_values: jax.Array,    # (K, n_shard, W) padded-CSR values
+    labels: jax.Array,       # (K, n_shard)
+    sq_norms: jax.Array,     # (K, n_shard)
+    idxs: jax.Array,         # (K, H) int32
+    lam: float,
+    n: int,
+    mode: str = "plus",
+    sigma: float = 1.0,
+    interpret: bool = False,
+    loss: str = "hinge",
+    smoothing: float = 1.0,
+):
+    """One sparse SDCA round for K shards on this chip.  Returns
+    (dw, alpha_inner): dw (K, d) unreduced per-shard updates (dense — Δw is
+    dense in the reference too, CoCoA.scala:145); alpha_inner (K, n_shard)
+    the locally-advanced alpha.  Unlike the dense kernel no margins input is
+    needed: the kernel reads x·w from the VMEM-resident w.
+
+    When H exceeds the SMEM index-table budget the round is split into
+    segments of :func:`segment_len` steps, each one ``pallas_call``; the
+    lane-blocked (Δw, α) carry between segments (a few MB of HBM traffic —
+    the table cannot be blocked, scalar-prefetch operands live whole in
+    SMEM).  Same math regardless of segmentation.
+
+    Requires n_shard % 8 == 0 (shard_dataset pads to 16).  Inside
+    ``shard_map`` run with ``check_vma=False`` (as the chunked driver does).
+    """
+    k, n_shard, w_nnz = sp_indices.shape
+    h = idxs.shape[1]
+    d = w.shape[0]
+    dtype = w.dtype
+    check_dtype(dtype)
+    if n_shard % ROW_BLOCK != 0:
+        raise ValueError(
+            f"n_shard must be a multiple of {ROW_BLOCK}, got {n_shard} "
+            f"(shard_dataset pads to 16)"
+        )
+    sig_eff, qii_factor = mode_factors(mode, sigma)
+    h_seg = max(1, segment_len(k, w_nnz))
+
+    # lane-block the per-shard vectors and the d-vectors
+    n_pad = -(-n_shard // LANES) * LANES
+    pad = [(0, 0), (0, n_pad - n_shard)]
+    blocked = lambda v: jnp.pad(v, pad).reshape(k, n_pad // LANES, LANES)  # noqa: E731
+    n_blocks = n_pad // LANES
+    d_pad = -(-d // LANES) * LANES
+    n_dblk = d_pad // LANES
+    w_blocked = jnp.pad(w, (0, d_pad - d)).reshape(1, n_dblk, LANES)
+
+    labels_b = blocked(labels)
+    sqn_b = blocked(sq_norms)
+    alpha_b = blocked(alpha)
+    dw_b = jnp.zeros((k, n_dblk, LANES), dtype)
+    idxs = idxs.astype(jnp.int32)
+
+    shard_vec = pl.BlockSpec(
+        (1, n_blocks, LANES), lambda k_, i_, idxs_, gidx_: (k_, 0, 0)
+    )
+    dvec_in = pl.BlockSpec(
+        (1, n_dblk, LANES), lambda k_, i_, idxs_, gidx_: (0, 0, 0)
+    )
+    dvec_k = pl.BlockSpec(
+        (1, n_dblk, LANES), lambda k_, i_, idxs_, gidx_: (k_, 0, 0)
+    )
+
+    for lo in range(0, h, h_seg):
+        seg = idxs[:, lo:lo + h_seg]
+        h_this = seg.shape[1]
+        # the segment's feature indices, gathered into the SMEM prefetch
+        # table (addresses must be scalars; Mosaic cannot read them from
+        # VMEM)
+        gidx = jnp.take_along_axis(
+            sp_indices, seg[:, :, None], axis=1
+        )  # (K, h_this, W)
+
+        kernel = functools.partial(
+            _kernel,
+            lam_n=float(lam * n),
+            sig_eff=float(sig_eff),
+            qii_factor=float(qii_factor),
+            frozen=(mode == "frozen"),
+            h=h_this,
+            w_nnz=w_nnz,
+            loss=losses.validate(loss, smoothing),
+            smoothing=float(smoothing),
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(k, h_this),
+            in_specs=[
+                # the sampled row's values: 8-row aligned block at idx//8*8
+                pl.BlockSpec(
+                    (1, ROW_BLOCK, w_nnz),
+                    lambda k_, i_, idxs_, gidx_: (
+                        k_, idxs_[k_, i_] // ROW_BLOCK, 0
+                    ),
+                ),
+                dvec_in,    # w (replicated across shards)
+                shard_vec,  # labels
+                shard_vec,  # sq_norms
+                shard_vec,  # alpha_in
+                dvec_k,     # dw_in (carried between segments)
+            ],
+            out_specs=[dvec_k, shard_vec],
+            scratch_shapes=[
+                pltpu.VMEM((n_dblk, LANES), dtype),
+                pltpu.VMEM((n_blocks, LANES), dtype),
+            ],
+        )
+        dw_b, alpha_b = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((k, n_dblk, LANES), dtype),
+                jax.ShapeDtypeStruct((k, n_blocks, LANES), dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(seg, gidx, sp_values, w_blocked, labels_b, sqn_b, alpha_b, dw_b)
+
+    alpha_inner = alpha_b.reshape(k, n_pad)[:, :n_shard]
+    return dw_b.reshape(k, d_pad)[:, :d], alpha_inner

@@ -27,6 +27,33 @@ from cocoa_tpu.ops import local_sdca
 from cocoa_tpu.solvers import base
 
 
+def _pallas_batched(w, alpha, idxs_kh, shards, params, mode, sigma,
+                    interpret):
+    """One Pallas SDCA round over all K shards: dense kernel (margins
+    precomputed as one MXU matvec, folded-row X) or sparse kernel (margins
+    read in-kernel from the VMEM-resident w).  Returns (dw (K, d),
+    alpha_inner (K, n_shard))."""
+    common = dict(mode=mode, sigma=sigma, interpret=interpret,
+                  loss=params.loss, smoothing=params.smoothing)
+    if "sp_indices" in shards:
+        from cocoa_tpu.ops.pallas_sparse import pallas_sparse_sdca_round
+
+        return pallas_sparse_sdca_round(
+            w, alpha, shards["sp_indices"], shards["sp_values"],
+            shards["labels"], shards["sq_norms"], idxs_kh,
+            params.lam, params.n, **common,
+        )
+    from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
+    from cocoa_tpu.ops.rows import shard_margins
+
+    m0 = shard_margins(w, shards)   # (K, n_shard): batched matvec
+    Xf = shards.get("X_folded", shards["X"])
+    return pallas_sdca_round(
+        m0, alpha, Xf, shards["labels"], shards["sq_norms"], idxs_kh,
+        params.lam, params.n, **common,
+    )
+
+
 def _cocoa_round_parts(
     params: Params,
     k: int,
@@ -44,8 +71,9 @@ def _cocoa_round_parts(
     ``math="fast"`` uses the margins decomposition (ops/local_sdca.py
     ``mode_factors``): one MXU matvec per round + an incremental Δw dot per
     step — equal in real arithmetic, rounds differently than the reference
-    order.  ``pallas=True`` (dense layout only) further runs the inner loop
-    as the Pallas TPU kernel.  Returns (per_shard, per_round_batched | None,
+    order.  ``pallas=True`` further runs the inner loop as a Pallas TPU
+    kernel — ops/pallas_sdca.py for the dense layout, ops/pallas_sparse.py
+    for padded-CSR.  Returns (per_shard, per_round_batched | None,
     apply_fn)."""
     if math not in ("exact", "fast"):
         raise ValueError(f"math must be 'exact' or 'fast', got {math!r}")
@@ -74,23 +102,18 @@ def _cocoa_round_parts(
     from cocoa_tpu.ops.rows import shard_margins
 
     def per_shard(w, alpha_k, idxs_k, shard_k):
-        m0 = shard_margins(w, shard_k)
         if pallas:
             # only reached inside the chunked mesh driver, which runs its
             # shard_map with check_vma=False (pallas_call's internal slices
             # confuse the VMA checker)
-            from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
-
-            Xf = shard_k.get("X_folded", shard_k["X"])
-            dw, a_inner = pallas_sdca_round(
-                m0[None], alpha_k[None], Xf[None],
-                shard_k["labels"][None], shard_k["sq_norms"][None],
-                idxs_k[None], params.lam, params.n,
-                mode=mode, sigma=sigma, interpret=pallas_interpret,
-                loss=params.loss, smoothing=params.smoothing,
+            batched = jax.tree.map(lambda a: a[None], shard_k)
+            dw, a_inner = _pallas_batched(
+                w, alpha_k[None], idxs_k[None], batched, params, mode,
+                sigma, pallas_interpret,
             )
             da = a_inner[0] - alpha_k
             return dw[0], alpha_k + scaling * da
+        m0 = shard_margins(w, shard_k)
         da, dw = local_sdca_fast(
             m0, alpha_k, shard_k, idxs_k, params.lam, params.n,
             jnp.zeros_like(w), mode=mode, sigma=sigma,
@@ -100,18 +123,12 @@ def _cocoa_round_parts(
 
     per_round_batched = None
     if pallas:
-        # the Pallas kernel owns the shard axis via its (K, H) grid — used on
-        # the single-chip path instead of vmap(per_shard)
+        # the Pallas kernels own the shard axis via their (K, H) grids —
+        # used on the single-chip path instead of vmap(per_shard)
         def per_round_batched(w, alpha, idxs_kh, shards):
-            from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
-
-            m0 = shard_margins(w, shards)   # (K, n_shard): batched matvec
-            Xf = shards.get("X_folded", shards["X"])
-            dw, a_inner = pallas_sdca_round(
-                m0, alpha, Xf, shards["labels"], shards["sq_norms"],
-                idxs_kh, params.lam, params.n,
-                mode=mode, sigma=sigma, interpret=pallas_interpret,
-                loss=params.loss, smoothing=params.smoothing,
+            dw, a_inner = _pallas_batched(
+                w, alpha, idxs_kh, shards, params, mode, sigma,
+                pallas_interpret,
             )
             alpha_new = alpha + scaling * (a_inner - alpha)
             return dw.sum(axis=0), alpha_new
@@ -211,9 +228,10 @@ def run_cocoa(
     ``math="fast"`` enables the margins-decomposition inner loop (equal in
     real arithmetic; floating-point rounds differ from the reference order —
     trajectories agree to ~1e-6, convergence behavior is unchanged).
-    ``pallas`` (None = auto: fast math + dense layout + TPU backend) runs
-    the inner loop as the Pallas TPU kernel; requires ``math="fast"`` and
-    the dense layout.
+    ``pallas`` (None = auto: fast math + f32 + TPU backend + fits on-chip)
+    runs the inner loop as a Pallas TPU kernel — the folded-row dense
+    kernel or the lane-blocked sparse (padded-CSR) kernel, by layout;
+    requires ``math="fast"``.
 
     ``device_loop=True`` runs the ENTIRE training loop — all rounds, the
     ``debugIter``-cadence evaluations, and the gap-target early-stop — as
@@ -246,32 +264,40 @@ def run_cocoa(
 
     platform = jax.devices()[0].platform
     if pallas is None:
-        # auto: the Pallas kernel needs fast math + dense layout + f32 + a
-        # real TPU backend (measured ~4x faster rounds than the fori_loop
-        # path at epsilon scale: folded rows run the O(d) work at full VPU
-        # width, lane-blocked scalar access keeps the per-step cost
-        # O(d + 128), and the row-block DMA pipeline hides HBM latency) —
-        # AND the kernel's VMEM-resident
+        # auto: the Pallas kernels need fast math + f32 + a real TPU
+        # backend (measured vs the fori_loop path: ~4x faster rounds at
+        # epsilon scale dense — folded rows run the O(d) work at full VPU
+        # width; ~25x faster steps at rcv1 scale sparse — lane-blocked
+        # w/Δw make a nonzero's access O(128) and margins never leave
+        # VMEM) — AND the kernel's VMEM-resident
         # working set must fit (pallas_sdca.vmem_estimate/pick_unroll own
         # that accounting — pick_unroll also chooses how many row DMAs to
         # batch per grid step).  Oversized runs keep the fori_loop fast path
         # (explicit pallas=True overrides, and Mosaic then reports the
         # allocation failure itself).
         from cocoa_tpu.ops.pallas_sdca import pick_unroll
+        from cocoa_tpu.ops.pallas_sparse import sparse_kernel_fits
 
         itemsize = jnp.dtype(dtype).itemsize
+        if ds.layout == "dense":
+            fits = pick_unroll(ds.n_shard, ds.num_features, itemsize,
+                               params.local_iters) > 0
+        else:
+            # sparse kernel: the SMEM feature-index table and the
+            # lane-blocked d-vectors must fit (pallas_sparse docstring)
+            fits = sparse_kernel_fits(
+                k, ds.n_shard, ds.num_features,
+                int(ds.sp_indices.shape[-1]), params.local_iters, itemsize,
+            )
         pallas = (
-            math == "fast" and ds.layout == "dense"
+            math == "fast"
             and itemsize == 4
             and platform in ("tpu", "axon")
-            and pick_unroll(ds.n_shard, ds.num_features, itemsize,
-                            params.local_iters) > 0
-            # the kernel's VMEM blocks assume the full d per device;
+            and fits
+            # the kernels' VMEM blocks assume the full d per device;
             # feature-parallel runs keep the fori_loop fast path
             and not has_fp(mesh)
         )
-    if pallas and ds.layout != "dense":
-        raise ValueError("the Pallas SDCA kernel requires layout='dense'")
     if pallas and has_fp(mesh):
         raise ValueError(
             "the Pallas SDCA kernel does not support feature-parallel (fp) "
@@ -296,10 +322,9 @@ def run_cocoa(
 
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
     shard_arrays = ds.shard_arrays()
-    if pallas:
-        # fold X for the kernel ONCE per run, up front — the per-dispatch
-        # prepare hooks below then no-op (idempotent), so the host-stepped
-        # scan_chunk path does not pay the relayout every dispatch
+    if pallas and ds.layout == "dense":
+        # fold X for the dense kernel ONCE per run, up front — folding
+        # inside the round loop would relayout the whole X every round
         from cocoa_tpu.ops.pallas_sdca import fold_rows
 
         shard_arrays = {**shard_arrays, "X_folded": fold_rows(shard_arrays["X"])}

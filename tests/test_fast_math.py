@@ -88,6 +88,55 @@ def test_pallas_interpret_matches_xla_fast(tiny_data, mode, sigma):
                                    np.asarray(da), atol=1e-14)
 
 
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0), ("frozen", 1.0)])
+def test_pallas_sparse_interpret_matches_xla_fast(tiny_data, mode, sigma):
+    """The sparse (padded-CSR) kernel — in-kernel margins, SMEM feature
+    addressing, lane-blocked w/Δw — must match the XLA fast path."""
+    from cocoa_tpu.ops.pallas_sparse import pallas_sparse_sdca_round
+    from cocoa_tpu.ops.rows import shard_margins
+
+    k = 4
+    ds = shard_dataset(tiny_data, k=k, layout="sparse", dtype=jnp.float64)
+    rng = np.random.default_rng(4)
+    d = ds.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(k, ds.n_shard)) * 0.3 + 0.3, 0, 1)
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(6, range(1, 2), 30, ds.counts)[:, 0, :]
+    )
+    dw_p, a_p = pallas_sparse_sdca_round(
+        w, alpha, ds.sp_indices, ds.sp_values, ds.labels, ds.sq_norms,
+        idxs, 0.01, tiny_data.n, mode=mode, sigma=sigma, interpret=True,
+    )
+    for s in range(k):
+        shard = {kk: v[s] for kk, v in ds.shard_arrays().items()}
+        m0 = shard_margins(w, shard)
+        da, dw = local_sdca_fast(
+            m0, alpha[s], shard, idxs[s], 0.01, tiny_data.n,
+            jnp.zeros(d, dtype=jnp.float64), mode=mode, sigma=sigma,
+        )
+        np.testing.assert_allclose(np.asarray(dw_p[s]), np.asarray(dw),
+                                   atol=1e-13)
+        np.testing.assert_allclose(np.asarray(a_p[s] - alpha[s]),
+                                   np.asarray(da), atol=1e-13)
+
+
+def test_pallas_sparse_solver_end_to_end_interpret(tiny_data):
+    """Full CoCoA+ run through the sparse Pallas kernel (interpret mode,
+    chunked driver) tracks the fori_loop fast path."""
+    ds = shard_dataset(tiny_data, k=4, layout="sparse", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=15, local_iters=20)
+    dbg = DebugParams(debug_iter=15, seed=0)
+    w_f, a_f, traj_f = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                                 math="fast", pallas=False, scan_chunk=5)
+    w_p, a_p, traj_p = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                                 math="fast", pallas=True, scan_chunk=5)
+    np.testing.assert_allclose(np.asarray(w_p), np.asarray(w_f), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_f), atol=1e-10)
+
+
 @pytest.mark.parametrize("unroll", [1, 2, 4, 8])
 def test_pallas_unroll_invariant(tiny_data, unroll):
     """The step-group size S is a pure DMA-batching knob: every S must
@@ -200,8 +249,8 @@ def test_pallas_mesh_equals_local(tiny_data):
     assert tm.records[-1].gap == pytest.approx(tl.records[-1].gap, abs=1e-12)
 
 
-def test_pallas_requires_dense(tiny_data):
-    ds = shard_dataset(tiny_data, k=2, layout="sparse", dtype=jnp.float64)
-    with pytest.raises(ValueError, match="dense"):
+def test_pallas_requires_fast_math(tiny_data):
+    ds = shard_dataset(tiny_data, k=2, layout="dense", dtype=jnp.float64)
+    with pytest.raises(ValueError, match="fast"):
         run_cocoa(ds, _params(tiny_data), _DBG, plus=True, quiet=True,
-                  math="fast", pallas=True)
+                  math="exact", pallas=True)
