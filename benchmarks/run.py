@@ -1,0 +1,271 @@
+"""Benchmark suite — generates the numbers BASELINE.md says this rebuild
+must produce (the reference publishes none; see BASELINE.md).
+
+Configs (BASELINE.json "eval" list):
+
+- ``demo``     — the reference's only in-repo baseline: CoCoA+ on
+  data/small_train.dat (n=2000, d=9947, K=4, H=50, λ=1e-3,
+  run-demo-local.sh:2-9), wall-clock + comm-rounds to a 1e-4 duality gap.
+- ``epsilon``  — epsilon-like dense synthetic (400K×2000, unit rows,
+  data/synth.py), K=8, H=0.1·n/K, λ=1e-3, to 1e-4 gap.
+- ``rcv1``     — rcv1.binary-like sparse synthetic (20242×47236, ~75
+  nnz/row), K=8, H=0.1·n/K, λ=1e-4, to 1e-3 and 1e-4 gaps.
+- ``mbcd-rcv1`` / ``sgd-epsilon`` — the baseline algorithms on the same
+  data (fixed round budgets; they have no duality-gap certificate to
+  target — SGD is primal-only, and mini-batch CD's β/(K·H) scaling makes
+  gap progress per round much slower than CoCoA's, exactly the point the
+  CoCoA papers make).
+
+Each timed run is warm (the first run compiles, the second is measured).
+``--quick`` shrinks the synthetic sizes ~10x for smoke-testing the suite.
+
+The ``vs_oracle`` column is the speedup over the literal NumPy oracle of
+the Scala update rules (tests/oracle.py) executing the same number of
+rounds single-threaded — measured directly for the demo config and
+extrapolated from 3 oracle rounds at the big scales (the oracle is the
+reference's *math* without Spark overhead, so this flatters the
+reference).
+
+Writes one JSON line per config to benchmarks/results.jsonl and a
+markdown table to benchmarks/RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+DEMO_TRAIN = "/root/reference/data/small_train.dat"
+DEMO_TEST = "/root/reference/data/small_test.dat"
+DEMO_D = 9947
+
+
+def _time_warm(fn):
+    fn()  # compile
+    t0 = time.perf_counter()
+    out = fn()
+    return time.perf_counter() - t0, out
+
+
+def _oracle_rounds_per_s(ds_like, lam, h, k, n, rounds=3):
+    """Single-thread NumPy oracle round rate on this problem (CoCoA+,
+    additive), measured over a few rounds."""
+    import oracle
+
+    from cocoa_tpu.utils.prng import sample_indices
+
+    X, y = ds_like
+    sizes = np.full(k, X.shape[0] // k)
+    sizes[: X.shape[0] % k] += 1
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    shards = [
+        (X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(k)
+    ]
+    w = np.zeros(X.shape[1])
+    alphas = [np.zeros(Xk.shape[0]) for Xk, _ in shards]
+    t0 = time.perf_counter()
+    for t in range(1, rounds + 1):
+        dw_sum = np.zeros_like(w)
+        for s, (Xk, yk) in enumerate(shards):
+            idxs = sample_indices(0, range(t, t + 1), h, Xk.shape[0])[0]
+            da, dw = oracle.local_sdca(
+                Xk, yk, w, alphas[s], idxs, lam, n, True, float(k)
+            )
+            alphas[s] += da
+            dw_sum += dw
+        w += dw_sum
+    return rounds / (time.perf_counter() - t0)
+
+
+def bench_demo(results):
+    import jax.numpy as jnp
+
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data import load_libsvm, shard_dataset
+    from cocoa_tpu.solvers import run_cocoa
+
+    data = load_libsvm(DEMO_TRAIN, DEMO_D)
+    ds = shard_dataset(data, k=4, layout="dense", dtype=jnp.float32)
+    params = Params(n=data.n, num_rounds=600, local_iters=50, lam=1e-3)
+    debug = DebugParams(debug_iter=10, seed=0)
+
+    def go():
+        return run_cocoa(ds, params, debug, plus=True, quiet=True,
+                         math="fast", device_loop=True, gap_target=1e-4)
+
+    secs, (w, a, traj) = _time_warm(go)
+    rec = traj.records[-1]
+    rate = _oracle_rounds_per_s(
+        (data.to_dense(), data.labels), 1e-3, 50, 4, data.n
+    )
+    results.append(dict(
+        config="demo-cocoa+", n=data.n, d=DEMO_D, k=4, h=50,
+        lam=1e-3, gap_target=1e-4, rounds=rec.round, gap=float(rec.gap),
+        wallclock_s=round(secs, 3),
+        vs_oracle=round(rec.round / rate / secs, 1),
+        oracle_basis="measured (3 rounds)",
+    ))
+
+
+def bench_epsilon(results, quick):
+    import jax.numpy as jnp
+
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data.synth import synth_dense_sharded
+    from cocoa_tpu.solvers import run_cocoa
+
+    n, d, k = (40_000, 2000, 8) if quick else (400_000, 2000, 8)
+    h = n // k // 10
+    ds = synth_dense_sharded(n, d, k, seed=0)
+    params = Params(n=n, num_rounds=400, local_iters=h, lam=1e-3)
+    debug = DebugParams(debug_iter=10, seed=0)
+
+    def go():
+        return run_cocoa(ds, params, debug, plus=True, quiet=True,
+                         math="fast", device_loop=True, gap_target=1e-4)
+
+    secs, (w, a, traj) = _time_warm(go)
+    rec = traj.records[-1]
+    # oracle rate on a small same-d subsample, scaled by n (per-round work
+    # is O(H·d) per shard with H ∝ n — linear in n at fixed d, k)
+    n_sub = min(n, 20_000)
+    rng = np.random.default_rng(0)
+    Xs = rng.standard_normal((n_sub, d))
+    Xs /= np.linalg.norm(Xs, axis=1, keepdims=True)
+    ys = np.where(Xs @ rng.standard_normal(d) >= 0, 1.0, -1.0)
+    rate_sub = _oracle_rounds_per_s((Xs, ys), 1e-3, n_sub // k // 10, k, n_sub)
+    rate = rate_sub * n_sub / n
+    results.append(dict(
+        config="epsilon-cocoa+", n=n, d=d, k=k, h=h,
+        lam=1e-3, gap_target=1e-4, rounds=rec.round, gap=float(rec.gap),
+        wallclock_s=round(secs, 3),
+        vs_oracle=round(rec.round / rate / secs, 1),
+        oracle_basis=f"extrapolated from n={n_sub} subsample",
+    ))
+
+    # Local SGD on the same data (primal-only baseline; fixed 100 rounds)
+    from cocoa_tpu.solvers import run_sgd
+
+    p2 = Params(n=n, num_rounds=100, local_iters=h, lam=1e-3)
+    d2 = DebugParams(debug_iter=100, seed=0)
+
+    def go_sgd():
+        return run_sgd(ds, p2, d2, local=True, quiet=True)
+
+    secs2, (w2, traj2) = _time_warm(go_sgd)
+    rec2 = traj2.records[-1]
+    results.append(dict(
+        config="epsilon-localsgd", n=n, d=d, k=k, h=h, lam=1e-3,
+        rounds=rec2.round, primal=float(rec2.primal),
+        wallclock_s=round(secs2, 3),
+    ))
+
+
+def bench_rcv1(results, quick):
+    import jax.numpy as jnp
+
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data.sharding import shard_dataset
+    from cocoa_tpu.data.synth import synth_sparse
+    from cocoa_tpu.solvers import run_cocoa, run_minibatch_cd
+
+    n, d, k = (4000, 47236, 8) if quick else (20242, 47236, 8)
+    data = synth_sparse(n, d, nnz_mean=75, seed=0)
+    ds = shard_dataset(data, k=k, layout="sparse", dtype=jnp.float32)
+    h = n // k // 10
+    debug = DebugParams(debug_iter=25, seed=0)
+
+    for gap_target in (1e-3, 1e-4):
+        params = Params(n=n, num_rounds=1500, local_iters=h, lam=1e-4)
+
+        def go():
+            return run_cocoa(ds, params, debug, plus=True, quiet=True,
+                             math="fast", device_loop=True,
+                             gap_target=gap_target)
+
+        secs, (w, a, traj) = _time_warm(go)
+        rec = traj.records[-1]
+        results.append(dict(
+            config=f"rcv1-cocoa+({gap_target:g})", n=n, d=d, k=k, h=h,
+            lam=1e-4, gap_target=gap_target, rounds=rec.round,
+            gap=float(rec.gap), wallclock_s=round(secs, 3),
+        ))
+
+    # Mini-batch CD on the same data (fixed 100 rounds; its β/(K·H)
+    # scaling needs far more rounds per unit of gap progress — the CoCoA
+    # papers' point)
+    p2 = Params(n=n, num_rounds=100, local_iters=h, lam=1e-4)
+    d2 = DebugParams(debug_iter=100, seed=0)
+
+    def go_mbcd():
+        return run_minibatch_cd(ds, p2, d2, quiet=True)
+
+    secs2, (w2, a2, traj2) = _time_warm(go_mbcd)
+    rec2 = traj2.records[-1]
+    results.append(dict(
+        config="rcv1-mbcd", n=n, d=d, k=k, h=h, lam=1e-4,
+        rounds=rec2.round, gap=float(rec2.gap), primal=float(rec2.primal),
+        wallclock_s=round(secs2, 3),
+    ))
+
+
+def write_results(results, out_dir):
+    jl = os.path.join(out_dir, "results.jsonl")
+    with open(jl, "w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+    md = os.path.join(out_dir, "RESULTS.md")
+    cols = ["config", "n", "d", "k", "h", "lam", "gap_target", "rounds",
+            "gap", "primal", "wallclock_s", "vs_oracle"]
+    with open(md, "w") as f:
+        f.write("# Benchmark results\n\n")
+        f.write("Produced by `python benchmarks/run.py` on the attached "
+                "TPU device (single chip, K logical shards).  See the "
+                "module docstring for config definitions and the "
+                "`vs_oracle` methodology.\n\n")
+        f.write("| " + " | ".join(cols) + " |\n")
+        f.write("|" + "---|" * len(cols) + "\n")
+        for r in results:
+            f.write("| " + " | ".join(
+                str(r.get(c, "")) if not isinstance(r.get(c), float)
+                else f"{r[c]:.4g}" for c in cols
+            ) + " |\n")
+    print(f"wrote {jl} and {md}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="~10x smaller synthetic sizes (smoke test)")
+    ap.add_argument("--only", default="",
+                    help="comma-separated subset: demo,epsilon,rcv1")
+    args = ap.parse_args()
+    only = set(args.only.split(",")) if args.only else None
+
+    results = []
+    if only is None or "demo" in only:
+        bench_demo(results)
+        print(json.dumps(results[-1]))
+    if only is None or "epsilon" in only:
+        bench_epsilon(results, args.quick)
+        for r in results[-2:]:
+            print(json.dumps(r))
+    if only is None or "rcv1" in only:
+        bench_rcv1(results, args.quick)
+        for r in results[-3:]:
+            print(json.dumps(r))
+    write_results(results, os.path.dirname(os.path.abspath(__file__)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
