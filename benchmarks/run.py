@@ -218,12 +218,16 @@ def bench_rcv1(results, quick):
     ))
 
 
-def write_results(results, out_dir):
-    jl = os.path.join(out_dir, "results.jsonl")
+def write_results(results, out_dir, partial=False):
+    """Full runs own results.jsonl / RESULTS.md (the artifacts BASELINE.md
+    cites); --quick / --only runs write to *.partial.* so they can never
+    clobber the recorded numbers."""
+    suffix = ".partial" if partial else ""
+    jl = os.path.join(out_dir, f"results{suffix}.jsonl")
     with open(jl, "w") as f:
         for r in results:
             f.write(json.dumps(r) + "\n")
-    md = os.path.join(out_dir, "RESULTS.md")
+    md = os.path.join(out_dir, f"RESULTS{suffix}.md")
     cols = ["config", "n", "d", "k", "h", "lam", "gap_target", "rounds",
             "gap", "primal", "wallclock_s", "vs_oracle"]
     with open(md, "w") as f:
@@ -263,7 +267,8 @@ def main():
         bench_rcv1(results, args.quick)
         for r in results[-3:]:
             print(json.dumps(r))
-    write_results(results, os.path.dirname(os.path.abspath(__file__)))
+    write_results(results, os.path.dirname(os.path.abspath(__file__)),
+                  partial=args.quick or only is not None)
     return 0
 
 

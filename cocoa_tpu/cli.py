@@ -275,17 +275,23 @@ def main(argv=None) -> int:
         finish(traj, w, alpha)
 
         if not cfg.just_cocoa:  # hingeDriver.scala:93-110
+            loop_kw = dict(scan_chunk=cfg.scan_chunk,
+                           device_loop=cfg.device_loop)
             w, alpha, traj = run_minibatch_cd(
-                ds, params, debug, **restore("Mini-batch CD"), **common)
+                ds, params, debug, math=cfg.math, **loop_kw,
+                **restore("Mini-batch CD"), **common)
             finish(traj, w, alpha)
 
-            w, traj = run_sgd(ds, params, debug, local=False, **common)
+            w, traj = run_sgd(ds, params, debug, local=False, **loop_kw,
+                              **common)
             finish(traj, w)
 
-            w, traj = run_sgd(ds, params, debug, local=True, **common)
+            w, traj = run_sgd(ds, params, debug, local=True, **loop_kw,
+                              **common)
             finish(traj, w)
 
-            w, traj = run_dist_gd(ds, params, debug, mesh=mesh, test_ds=test_ds)
+            w, traj = run_dist_gd(ds, params, debug, mesh=mesh,
+                                  test_ds=test_ds, **loop_kw)
             finish(traj, w)
 
     if extras["profile"]:

@@ -92,35 +92,48 @@ def chunk_fanout(
     apply_fn: Callable,
     w: jax.Array,
     carry_sharded,      # pytree, leaves (K, ...): shard-local carry (e.g. alpha)
-    xs_sharded,         # pytree, leaves (C, K, ...): per-round per-shard inputs
+    xs_sharded,         # pytree: per-round inputs, see below
     static_sharded,     # pytree, leaves (K, ...): shard data (not scanned)
     per_round_batched: Optional[Callable] = None,
     check_vma: bool = True,
 ):
     """Run C rounds device-side as one ``lax.scan`` (one dispatch per chunk).
 
+    ``xs_sharded`` leaves are scanned over their leading C dim; leaves of
+    ndim ≥ 2 are (C, K, ...) per-shard inputs (sliced per device on the
+    mesh path), leaves of ndim == 1 are (C,) replicated per-round scalars
+    (e.g. the round number t for η(t) schedules — SGD.scala:44,
+    DistGD.scala:35).
+
     ``per_round(w, carry_k, x_k, static_k) -> (dw, carry_k')`` is one outer
     round seen from a single shard, returning its *unreduced* Δw;
-    ``apply_fn(w, dw_sum) -> w'`` is the replicated driver-side update.
-    Returns (w_final, carry_final) with the same placement semantics as
-    ``fanout`` (w replicated, carry keeping its leading K dim).
+    ``apply_fn(w, dw_sum, x_k) -> w'`` is the replicated driver-side update
+    (``x_k`` passed so t-dependent step sizes can be applied).  Returns
+    (w_final, carry_final) with the same placement semantics as ``fanout``
+    (w replicated, carry keeping its leading K dim).
 
     ``per_round_batched(w, carry, x, static) -> (dw_sum, carry')``, when
     given, replaces the vmap on the single-chip path with one call over all
     K shards at once — required for inner solvers that manage the shard axis
-    themselves (the Pallas kernel's (K, H) grid cannot sit under vmap).
+    themselves (the Pallas kernels' (K, H) grids cannot sit under vmap).
     """
+    def x_spec(a):
+        return P(None) if a.ndim == 1 else P(None, DP_AXIS)
+
     if mesh is not None:
         def wrapped(w, carry, xs, static):
             w = _to_varying(w)
             carry = jax.tree.map(lambda a: a[0], carry)
-            xs = jax.tree.map(lambda a: a[:, 0], xs)        # (C, 1, ...) → (C, ...)
+            # (C, 1, ...) → (C, ...); (C,) scalar leaves pass through
+            xs = jax.tree.map(
+                lambda a: a if a.ndim == 1 else a[:, 0], xs
+            )
             static = jax.tree.map(lambda a: a[0], static)
 
             def body(c, x):
                 w, carry_k = c
                 dw, carry2 = per_round(w, carry_k, x, static)
-                w2 = apply_fn(w, lax.psum(dw, DP_AXIS))
+                w2 = apply_fn(w, lax.psum(dw, DP_AXIS), x)
                 return (w2, carry2), None
 
             (w, carry), _ = lax.scan(body, (w, carry), xs)
@@ -130,7 +143,7 @@ def chunk_fanout(
         in_specs = (
             P(),
             jax.tree.map(lambda _: P(DP_AXIS), carry_sharded),
-            jax.tree.map(lambda _: P(None, DP_AXIS), xs_sharded),
+            jax.tree.map(x_spec, xs_sharded),
             jax.tree.map(lambda _: P(DP_AXIS), static_sharded),
         )
         out_specs = (P(), jax.tree.map(lambda _: P(DP_AXIS), carry_sharded))
@@ -145,11 +158,12 @@ def chunk_fanout(
         if per_round_batched is not None:
             dw_sum, carry2 = per_round_batched(w, carry, x, static_sharded)
         else:
-            dw, carry2 = jax.vmap(per_round, in_axes=(None, 0, 0, 0))(
+            x_axes = jax.tree.map(lambda a: None if a.ndim == 0 else 0, x)
+            dw, carry2 = jax.vmap(per_round, in_axes=(None, 0, x_axes, 0))(
                 w, carry, x, static_sharded
             )
             dw_sum = dw.sum(axis=0)
-        return (apply_fn(w, dw_sum), carry2), None
+        return (apply_fn(w, dw_sum, x), carry2), None
 
     (w, carry), _ = lax.scan(body, (w, carry_sharded), xs_sharded)
     return w, carry

@@ -144,8 +144,10 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
     def run(*args):
         state = args[:n_state]
         idxs_all, shard_arrays, test_arrays = args[n_state:]
-        # static at trace time; a different block length just retraces
-        n_chunks = idxs_all.shape[0]
+        # idxs_all is a pytree (a bare (n_chunks, C, K, H) table, or a dict
+        # also carrying a per-round (n_chunks, C) t leaf for η(t) solvers);
+        # static at trace time — a different block length just retraces
+        n_chunks = jax.tree.leaves(idxs_all)[0].shape[0]
 
         def cond(s):
             i, done, state, traj = s
@@ -153,7 +155,8 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
 
         def body(s):
             i, done, state, traj = s
-            state = chunk_kernel(state, idxs_all[i], shard_arrays)
+            chunk = jax.tree.map(lambda a: a[i], idxs_all)
+            state = chunk_kernel(state, chunk, shard_arrays)
             metrics = eval_kernel(state, shard_arrays, test_arrays)
             traj = lax.dynamic_update_index_in_dim(traj, metrics, i, 0)
             done = metrics[1] <= tgt
@@ -216,7 +219,7 @@ def drive_on_device(
     given, the built jit executable is reused across calls — without it every
     call re-jits (closures have fresh identity) and pays ~1s of recompile.
     """
-    c = int(idxs_all.shape[1])
+    c = int(jax.tree.leaves(idxs_all)[0].shape[1])
     tgt = gap_target
     n_state = len(state)
 
@@ -238,7 +241,10 @@ def drive_on_device(
         end = start_round - 1 + (j + 1) * c
         primal, gap, test_err = (float(v) for v in traj_host[j])
         traj.log_round(
-            end, primal=primal, gap=gap,
+            end, primal=primal,
+            # NaN slots mean "not applicable" (no dual state / no test set)
+            # — decode to None exactly as objectives.evaluate does
+            gap=None if np.isnan(gap) else gap,
             test_error=None if np.isnan(test_err) else test_err,
             # per-round wall-clock is unobservable here: the whole run is one
             # dispatch and one fetch — don't fabricate flat timestamps
@@ -320,7 +326,9 @@ def drive_device_full(
         while done < t - 1 + n_full * c and not hit_target():
             b = min(per_block, (t - 1 + n_full * c - done) // c)
             flat = sampler.chunk_indices(done + 1, b * c)
-            idxs_all = flat.reshape(b, c, *flat.shape[1:])
+            idxs_all = jax.tree.map(
+                lambda a: a.reshape(b, c, *a.shape[1:]), flat
+            )
             state, dev_traj = drive_on_device(
                 name, state, chunk_kernel, eval_kernel, idxs_all,
                 shard_arrays, test_arrays, quiet=quiet, gap_target=gap_target,
@@ -417,3 +425,84 @@ class IndexSampler:
             )
             for key in keys
         ])
+
+
+def drive_device_paths(
+    name: str,
+    params: Params,
+    debug: DebugParams,
+    state: tuple,
+    chunk_kernel: Callable,   # (state, xs, shard_arrays) -> state, traceable
+    chunk_fn: Callable,       # (t0, c, state) -> state, host-stepped (jitted)
+    eval_fn: Callable,
+    sampler,
+    shard_arrays,
+    *,
+    alpha_in_state: bool,
+    mesh=None,
+    test_ds=None,
+    quiet: bool = False,
+    gap_target: Optional[float] = None,
+    start_round: int = 1,
+    scan_chunk: int = 0,
+    device_loop: bool = False,
+    cache_key=None,
+):
+    """The scan_chunk / device_loop dispatch shared by every solver: builds
+    the fused eval kernel (dual state iff ``alpha_in_state``) and routes to
+    :func:`drive_device_full` or :func:`drive_chunked`.  Returns
+    (state, Trajectory)."""
+    from cocoa_tpu.evals import objectives
+
+    if device_loop:
+        test_arrays = test_ds.shard_arrays() if test_ds is not None else None
+        test_n = test_ds.n if test_ds is not None else 0
+
+        def eval_kernel(state, shard_arrays, test_arrays):
+            alpha = state[1] if alpha_in_state else None
+            return objectives.eval_metrics(
+                state[0], alpha, shard_arrays, params.lam, params.n,
+                mesh=mesh, test_shard_arrays=test_arrays, test_n=test_n,
+                loss=params.loss, smoothing=params.smoothing,
+            )
+
+        return drive_device_full(
+            name, params, debug, state, chunk_kernel, eval_kernel, chunk_fn,
+            eval_fn, sampler, shard_arrays, test_arrays, quiet=quiet,
+            gap_target=gap_target, start_round=start_round,
+            cache_key=None if cache_key is None else (*cache_key, test_n),
+            mesh=mesh,
+        )
+    return drive_chunked(
+        name, params, debug, state, chunk_fn, eval_fn, quiet=quiet,
+        gap_target=gap_target, start_round=start_round, chunk=scan_chunk,
+    )
+
+
+class TsSampler:
+    """Sampler adapter whose chunk tables also carry the round number.
+
+    η(t)-scheduled solvers (SGD: η = 1/(λt), SGD.scala:44; DistGD:
+    η = 1/(βt), DistGD.scala:35) need t inside the device-side scan.  The
+    table becomes a dict pytree: ``{"idxs": (C, K, H), "t": (C,)}`` — the
+    (C,) leaf is treated as a replicated per-round scalar by
+    ``chunk_fanout`` and by the pytree-aware device-loop drivers.
+
+    ``sampler=None`` (DistGD — deterministic full passes, no index draws)
+    emits only the ``t`` leaf; ``h``/``counts`` then size the index-table
+    memory cap as zero-ish (h=1).
+    """
+
+    def __init__(self, sampler: "IndexSampler | None", dtype, counts=None):
+        self.sampler = sampler
+        self.dtype = dtype
+        self.h = sampler.h if sampler is not None else 1
+        self.counts = sampler.counts if sampler is not None else np.asarray(counts)
+
+    def chunk_indices(self, t0: int, c: int):
+        import jax.numpy as jnp
+
+        out = {"t": jnp.arange(t0, t0 + c, dtype=self.dtype)}
+        if self.sampler is not None:
+            out["idxs"] = self.sampler.chunk_indices(t0, c)
+        return out

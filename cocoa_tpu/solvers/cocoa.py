@@ -54,19 +54,33 @@ def _pallas_batched(w, alpha, idxs_kh, shards, params, mode, sigma,
     )
 
 
-def _cocoa_round_parts(
+def _alg_config(params: Params, k: int, plus: Optional[bool], mode=None):
+    """(mode, scaling, sigma) for the three SDCA-family algorithms.
+
+    scaling law: γ (CoCoA+, additive) | β/K (CoCoA, averaging) —
+    CoCoA.scala:37, with σ′ = K·γ (CoCoA.scala:45); β/(K·H) for
+    mini-batch CD (MinibatchCD.scala:32, w frozen so σ is unused)."""
+    if mode == "frozen":
+        return "frozen", params.beta / (k * params.local_iters), 1.0
+    if plus:
+        return "plus", params.gamma, k * params.gamma
+    return "cocoa", params.beta / k, k * params.gamma
+
+
+def _sdca_round_parts(
     params: Params,
     k: int,
-    plus: bool,
+    mode: str,
+    scaling: float,
+    sigma: float,
     math: str = "exact",
     pallas: bool = False,
     pallas_interpret: bool = False,
 ):
     """The per-shard local update and driver-side apply shared by the
-    per-round and chunked builders (so the two paths cannot diverge).
-
-    scaling law: γ (CoCoA+, additive) | β/K (CoCoA, averaging) —
-    CoCoA.scala:37; σ′ = K·γ (CoCoA.scala:45).
+    per-round and chunked builders (so the two paths cannot diverge), for
+    all three SDCA-family algorithms (CoCoA, CoCoA+, mini-batch CD — see
+    :func:`_alg_config` for the scaling laws).
 
     ``math="fast"`` uses the margins decomposition (ops/local_sdca.py
     ``mode_factors``): one MXU matvec per round + an incremental Δw dot per
@@ -77,12 +91,10 @@ def _cocoa_round_parts(
     apply_fn)."""
     if math not in ("exact", "fast"):
         raise ValueError(f"math must be 'exact' or 'fast', got {math!r}")
-    scaling = params.gamma if plus else params.beta / k
-    sigma = k * params.gamma
-    mode = "plus" if plus else "cocoa"
 
-    def apply_fn(w, dw_sum):
-        return w + scaling * dw_sum  # CoCoA.scala:47-48
+    def apply_fn(w, dw_sum, x=None):
+        # CoCoA.scala:47-48 / MinibatchCD.scala:42-43 (x unused: no η(t))
+        return w + scaling * dw_sum
 
     if math == "exact":
         if pallas:
@@ -94,7 +106,8 @@ def _cocoa_round_parts(
                 mode=mode, sigma=sigma,
                 loss=params.loss, smoothing=params.smoothing,
             )
-            return dw, alpha_k + scaling * da  # CoCoA.scala:101
+            # CoCoA.scala:101 / MinibatchCD.scala:127-128
+            return dw, alpha_k + scaling * da
 
         return per_shard, None, apply_fn
 
@@ -136,9 +149,10 @@ def _cocoa_round_parts(
     return per_shard, per_round_batched, apply_fn
 
 
-def make_round_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
-    """Build the jitted (w, alpha, idxs, shard_arrays) -> (w', alpha') step."""
-    per_shard, _, apply_fn = _cocoa_round_parts(params, k, plus, **parts_kw)
+def make_round_step(mesh, params: Params, k: int, alg, **parts_kw):
+    """Build the jitted (w, alpha, idxs, shard_arrays) -> (w', alpha') step.
+    ``alg`` = (mode, scaling, sigma), see :func:`_alg_config`."""
+    per_shard, _, apply_fn = _sdca_round_parts(params, k, *alg, **parts_kw)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def round_step(w, alpha, idxs, shard_arrays):
@@ -150,17 +164,17 @@ def make_round_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
     return round_step
 
 
-def _make_chunk_kernel(mesh, params: Params, k: int, plus: bool, **parts_kw):
+def _make_chunk_kernel(mesh, params: Params, k: int, alg, **parts_kw):
     """The un-jitted traceable chunk body shared by :func:`make_chunk_step`
     and the device-resident driver (so the two cannot diverge):
     (w, alpha, idxs_ckh, shard_arrays) -> (w', alpha'), C rounds as one
     ``lax.scan`` (parallel/fanout.py chunk_fanout).  On Pallas configs the
-    caller (run_cocoa) pre-folds ``shard_arrays["X_folded"]`` once per run —
+    caller (_run_sdca) pre-folds ``shard_arrays["X_folded"]`` once per run —
     the kernel itself never folds, so no per-dispatch relayout."""
     from cocoa_tpu.parallel.fanout import chunk_fanout
 
-    per_shard, per_round_batched, apply_fn = _cocoa_round_parts(
-        params, k, plus, **parts_kw
+    per_shard, per_round_batched, apply_fn = _sdca_round_parts(
+        params, k, *alg, **parts_kw
     )
 
     def chunk_kernel(w, alpha, idxs_ckh, shard_arrays):
@@ -178,29 +192,30 @@ def _make_chunk_kernel(mesh, params: Params, k: int, plus: bool, **parts_kw):
 _CHUNK_STEPS: dict = {}
 
 
-def make_chunk_step(mesh, params: Params, k: int, plus: bool, **parts_kw):
+def make_chunk_step(mesh, params: Params, k: int, alg, **parts_kw):
     """Build the jitted chunked step: C rounds as one device-side lax.scan
     (see parallel/fanout.py chunk_fanout) — same math as make_round_step,
     one host dispatch per chunk instead of per round.  Executables are cached
     per configuration so repeated run_* calls don't pay a re-jit."""
     key = (
-        mesh, k, plus, params.lam, params.n, params.local_iters,
+        mesh, k, alg, params.lam, params.n, params.local_iters,
         params.beta, params.gamma, params.loss, params.smoothing,
         tuple(sorted(parts_kw.items())),
     )
     step = _CHUNK_STEPS.get(key)
     if step is None:
-        kernel = _make_chunk_kernel(mesh, params, k, plus, **parts_kw)
+        kernel = _make_chunk_kernel(mesh, params, k, alg, **parts_kw)
         step = jax.jit(kernel, donate_argnums=(0, 1))
         _CHUNK_STEPS[key] = step
     return step
 
 
-def run_cocoa(
+def run_sdca_family(
     ds: ShardedDataset,
     params: Params,
     debug: DebugParams,
-    plus: bool,
+    alg_name: str,
+    alg,   # (mode, scaling, sigma) — _alg_config
     mesh=None,
     test_ds: Optional[ShardedDataset] = None,
     rng: str = "reference",
@@ -214,7 +229,9 @@ def run_cocoa(
     pallas=None,
     device_loop: bool = False,
 ):
-    """Train; returns (w, alpha, Trajectory).
+    """Shared driver for the SDCA-family algorithms (CoCoA, CoCoA+,
+    mini-batch CD — they differ only in their ``alg`` scaling triple, see
+    :func:`_alg_config`).  Train; returns (w, alpha, Trajectory).
 
     Extensions over the reference: ``gap_target`` stops early once the
     duality gap — checked at the ``debugIter`` cadence — falls below the
@@ -242,9 +259,8 @@ def run_cocoa(
     """
     base.check_shards(ds)
     k = ds.k
-    alg = "CoCoA+" if plus else "CoCoA"
     if not quiet:
-        print(f"\nRunning {alg} on {params.n} data examples, "
+        print(f"\nRunning {alg_name} on {params.n} data examples, "
               f"distributed over {k} workers")
 
     dtype = ds.labels.dtype
@@ -334,67 +350,60 @@ def run_cocoa(
         return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds,
                                    loss=params.loss, smoothing=params.smoothing)
 
-    if device_loop:
-        raw_kernel = _make_chunk_kernel(mesh, params, k, plus, **parts_kw)
+    if device_loop or scan_chunk > 0:
+        raw_kernel = _make_chunk_kernel(mesh, params, k, alg, **parts_kw)
 
         def chunk_kernel(state, idxs_ckh, shard_arrays):
             return raw_kernel(state[0], state[1], idxs_ckh, shard_arrays)
 
-        test_arrays = test_ds.shard_arrays() if test_ds is not None else None
-        test_n = test_ds.n if test_ds is not None else 0
-
-        def eval_kernel(state, shard_arrays, test_arrays):
-            w, alpha = state
-            return objectives.eval_metrics(
-                w, alpha, shard_arrays, params.lam, params.n, mesh=mesh,
-                test_shard_arrays=test_arrays, test_n=test_n,
-                loss=params.loss, smoothing=params.smoothing,
-            )
-
-        chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
+        chunk_step = make_chunk_step(mesh, params, k, alg, **parts_kw)
 
         def chunk_fn(t0, c, state):
-            w, alpha = state
-            return chunk_step(w, alpha, sampler.chunk_indices(t0, c),
-                              shard_arrays)
+            return chunk_step(state[0], state[1],
+                              sampler.chunk_indices(t0, c), shard_arrays)
 
         cache_key = (
-            "cocoa", plus, math, pallas, k, mesh,
+            "sdca", alg, math, pallas, k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,
             params.num_rounds, debug.debug_iter, start_round,
-            gap_target, test_n, ds.layout, str(dtype),
+            gap_target, ds.layout, str(dtype),
         )
-        (w, alpha), traj = base.drive_device_full(
-            alg, params, debug, (w, alpha), chunk_kernel, eval_kernel,
-            chunk_fn, eval_fn, sampler, shard_arrays, test_arrays,
-            quiet=quiet, gap_target=gap_target, start_round=start_round,
-            cache_key=cache_key, mesh=mesh,
-        )
-        return w, alpha, traj
-
-    if scan_chunk > 0:
-        chunk_step = make_chunk_step(mesh, params, k, plus, **parts_kw)
-
-        def chunk_fn(t0, c, state):
-            w, alpha = state
-            return chunk_step(w, alpha, sampler.chunk_indices(t0, c), shard_arrays)
-
-        (w, alpha), traj = base.drive_chunked(
-            alg, params, debug, (w, alpha), chunk_fn, eval_fn,
-            quiet=quiet, gap_target=gap_target, start_round=start_round,
-            chunk=scan_chunk,
+        (w, alpha), traj = base.drive_device_paths(
+            alg_name, params, debug, (w, alpha), chunk_kernel, chunk_fn,
+            eval_fn, sampler, shard_arrays, alpha_in_state=True, mesh=mesh,
+            test_ds=test_ds, quiet=quiet, gap_target=gap_target,
+            start_round=start_round, scan_chunk=scan_chunk,
+            device_loop=device_loop, cache_key=cache_key,
         )
         return w, alpha, traj
 
-    step = make_round_step(mesh, params, k, plus, **parts_kw)
+    step = make_round_step(mesh, params, k, alg, **parts_kw)
 
     def round_fn(t, state):
         w, alpha = state
         return step(w, alpha, sampler.round_indices(t), shard_arrays)
 
     (w, alpha), traj = base.drive(
-        alg, params, debug, (w, alpha), round_fn, eval_fn,
+        alg_name, params, debug, (w, alpha), round_fn, eval_fn,
         quiet=quiet, gap_target=gap_target, start_round=start_round,
     )
     return w, alpha, traj
+
+
+def run_cocoa(
+    ds: ShardedDataset,
+    params: Params,
+    debug: DebugParams,
+    plus: bool,
+    **kw,
+):
+    """CoCoA (plus=False, averaging, scaling β/K) / CoCoA+ (plus=True,
+    additive, scaling γ with σ′ = K·γ) — CoCoA.scala:22-66.  Train; returns
+    (w, alpha, Trajectory).  See :func:`run_sdca_family` for the keyword
+    options (mesh, rng, gap_target, scan_chunk, math, pallas, device_loop,
+    checkpoint/resume)."""
+    alg = _alg_config(params, ds.k, plus)
+    return run_sdca_family(
+        ds, params, debug, "CoCoA+" if plus else "CoCoA", alg, **kw
+    )

@@ -3,41 +3,24 @@
 Same skeleton as CoCoA but the local solver runs against a *frozen* w
 (mode="frozen"; MinibatchCD.scala:104) and both the dual and primal updates
 are scaled by β/(K·H) (MinibatchCD.scala:32,43,128).
+
+Implemented as the ``mode="frozen"`` member of the shared SDCA family
+driver (solvers/cocoa.py ``run_sdca_family``), which gives mini-batch CD
+the same execution paths as CoCoA: fast-math margins decomposition, the
+Pallas dense/sparse kernels, device-side chunked rounds (``scan_chunk``),
+the fully device-resident loop (``device_loop``), gap-target early stop,
+and checkpoint/resume.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 from cocoa_tpu.config import DebugParams, Params
 from cocoa_tpu.data.sharding import ShardedDataset
-from cocoa_tpu.evals import objectives
-from cocoa_tpu.ops import local_sdca
-from cocoa_tpu.solvers import base
-
-
-def make_round_step(mesh, params: Params, k: int):
-    scaling = params.beta / (k * params.local_iters)  # MinibatchCD.scala:32
-
-    def per_shard(w, alpha_k, idxs_k, shard_k):
-        da, dw = local_sdca(
-            w, alpha_k, shard_k, idxs_k, params.lam, params.n, mode="frozen",
-            loss=params.loss, smoothing=params.smoothing,
-        )
-        return dw, alpha_k + scaling * da  # MinibatchCD.scala:127-128
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def round_step(w, alpha, idxs, shard_arrays):
-        dw_sum, alpha_new = base.fanout(
-            per_shard, mesh, w, alpha, idxs, shard_arrays
-        )
-        return w + scaling * dw_sum, alpha_new  # MinibatchCD.scala:42-43
-
-    return round_step
+from cocoa_tpu.solvers.cocoa import _alg_config, run_sdca_family
 
 
 def run_minibatch_cd(
@@ -51,42 +34,18 @@ def run_minibatch_cd(
     alpha_init: Optional[jax.Array] = None,
     start_round: int = 1,
     quiet: bool = False,
+    gap_target: Optional[float] = None,
+    scan_chunk: int = 0,
+    math: str = "exact",
+    pallas=None,
+    device_loop: bool = False,
 ):
     """Train; returns (w, alpha, Trajectory)."""
-    base.check_shards(ds)
-    k = ds.k
-    if not quiet:
-        print(f"\nRunning Mini-batch CD on {params.n} data examples, "
-              f"distributed over {k} workers")
-
-    dtype = ds.labels.dtype
-    w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.array(w_init, dtype=dtype, copy=True)
-    alpha = (
-        jnp.zeros((k, ds.n_shard), dtype=dtype)
-        if alpha_init is None
-        else base.align_alpha(alpha_init, ds, dtype)
+    alg = _alg_config(params, ds.k, None, mode="frozen")
+    return run_sdca_family(
+        ds, params, debug, "Mini-batch CD", alg, mesh=mesh, test_ds=test_ds,
+        rng=rng, w_init=w_init, alpha_init=alpha_init,
+        start_round=start_round, quiet=quiet, gap_target=gap_target,
+        scan_chunk=scan_chunk, math=math, pallas=pallas,
+        device_loop=device_loop,
     )
-    if mesh is not None:
-        from cocoa_tpu.parallel.mesh import primal_sharding, sharded_rows
-
-        w = jax.device_put(w, primal_sharding(mesh))
-        alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
-
-    sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
-    step = make_round_step(mesh, params, k)
-    shard_arrays = ds.shard_arrays()
-
-    def round_fn(t, state):
-        w, alpha = state
-        return step(w, alpha, sampler.round_indices(t), shard_arrays)
-
-    def eval_fn(state):
-        w, alpha = state
-        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds,
-                                   loss=params.loss, smoothing=params.smoothing)
-
-    (w, alpha), traj = base.drive(
-        "Mini-batch CD", params, debug, (w, alpha), round_fn, eval_fn,
-        quiet=quiet, start_round=start_round,
-    )
-    return w, alpha, traj

@@ -1,0 +1,125 @@
+"""Device-side execution paths (scan_chunk, device_loop) for the non-CoCoA
+solvers: the chunked lax.scan and the fully device-resident lax.while_loop
+must produce the same state and trajectory as the host-stepped per-round
+driver, on both the single-chip and mesh paths.  (CoCoA's paths are covered
+in test_fast_math.py / test_integration.py; mini-batch CD now shares
+CoCoA's driver and gains the same paths.)"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.parallel import make_mesh
+from cocoa_tpu.solvers import run_dist_gd, run_minibatch_cd, run_sgd
+
+K = 4
+
+
+def _params(tiny_data, **kw):
+    defaults = dict(n=tiny_data.n, num_rounds=12, local_iters=15, lam=0.01,
+                    beta=1.0, gamma=1.0)
+    defaults.update(kw)
+    return Params(**defaults)
+
+
+_DBG = DebugParams(debug_iter=4, seed=0)
+
+
+def _traj_metrics(traj):
+    return [(r.round, r.primal, r.gap) for r in traj.records]
+
+
+@pytest.mark.parametrize("local", [True, False])
+def test_sgd_chunked_matches_per_round(tiny_data, local):
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data)
+    w0, traj0 = run_sgd(ds, p, _DBG, local=local, quiet=True)
+    w1, traj1 = run_sgd(ds, p, _DBG, local=local, quiet=True, scan_chunk=5)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-12)
+    a, b = _traj_metrics(traj0), _traj_metrics(traj1)
+    assert [x[0] for x in a] == [x[0] for x in b]
+    np.testing.assert_allclose([x[1] for x in a], [x[1] for x in b],
+                               atol=1e-12)
+
+
+@pytest.mark.parametrize("local", [True, False])
+def test_sgd_device_loop_matches_per_round(tiny_data, local):
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data)
+    w0, traj0 = run_sgd(ds, p, _DBG, local=local, quiet=True)
+    w1, traj1 = run_sgd(ds, p, _DBG, local=local, quiet=True,
+                        device_loop=True)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-12)
+    a, b = _traj_metrics(traj0), _traj_metrics(traj1)
+    assert [x[0] for x in a] == [x[0] for x in b]
+    np.testing.assert_allclose([x[1] for x in a], [x[1] for x in b],
+                               atol=1e-12)
+
+
+def test_sgd_chunked_on_mesh_matches_local(tiny_data):
+    ds_l = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data)
+    w0, _ = run_sgd(ds_l, p, _DBG, local=True, quiet=True)
+    mesh = make_mesh(K)
+    ds_m = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                         mesh=mesh)
+    w1, _ = run_sgd(ds_m, p, _DBG, local=True, quiet=True, mesh=mesh,
+                    scan_chunk=5)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-12)
+
+
+def test_dist_gd_chunked_and_device_loop_match(tiny_data):
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data)
+    w0, traj0 = run_dist_gd(ds, p, _DBG, quiet=True)
+    w1, traj1 = run_dist_gd(ds, p, _DBG, quiet=True, scan_chunk=5)
+    w2, traj2 = run_dist_gd(ds, p, _DBG, quiet=True, device_loop=True)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(w0), atol=1e-12)
+    for tr in (traj1, traj2):
+        np.testing.assert_allclose(
+            [x[1] for x in _traj_metrics(tr)],
+            [x[1] for x in _traj_metrics(traj0)], atol=1e-12)
+
+
+def test_dist_gd_chunked_on_mesh_matches_local(tiny_data):
+    ds_l = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data)
+    w0, _ = run_dist_gd(ds_l, p, _DBG, quiet=True)
+    mesh = make_mesh(K)
+    ds_m = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                         mesh=mesh)
+    w1, _ = run_dist_gd(ds_m, p, _DBG, quiet=True, mesh=mesh, scan_chunk=4)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-12)
+
+
+@pytest.mark.parametrize("layout", ["dense", "sparse"])
+def test_mbcd_device_paths_match(tiny_data, layout):
+    """Mini-batch CD through the shared SDCA driver: chunked, device-loop,
+    and Pallas (interpret) paths all track the per-round exact path."""
+    ds = shard_dataset(tiny_data, k=K, layout=layout, dtype=jnp.float64)
+    p = _params(tiny_data)
+    w0, a0, _ = run_minibatch_cd(ds, p, _DBG, quiet=True)
+    w1, a1, _ = run_minibatch_cd(ds, p, _DBG, quiet=True, scan_chunk=5)
+    w2, a2, _ = run_minibatch_cd(ds, p, _DBG, quiet=True, device_loop=True)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(w0), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a2), np.asarray(a0), atol=1e-12)
+    # fast-math + Pallas kernel (interpret mode on CPU), frozen mode
+    w3, a3, _ = run_minibatch_cd(ds, p, _DBG, quiet=True, math="fast",
+                                 pallas=True, scan_chunk=5)
+    np.testing.assert_allclose(np.asarray(w3), np.asarray(w0), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(a3), np.asarray(a0), atol=1e-9)
+
+
+def test_mbcd_gap_target_early_stop(tiny_data):
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=400, local_iters=30)
+    dbg = DebugParams(debug_iter=20, seed=0)
+    w, a, traj = run_minibatch_cd(ds, p, dbg, quiet=True, gap_target=0.5,
+                                  scan_chunk=20)
+    assert traj.records[-1].gap <= 0.5
+    assert traj.records[-1].round < 400
