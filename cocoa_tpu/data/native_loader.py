@@ -18,19 +18,43 @@ import numpy as np
 
 from cocoa_tpu.data.libsvm import LibsvmData
 
-_SO_PATH = os.path.join(
+_NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
-    "libsvm_parser.so",
 )
+_SO_PATH = os.path.join(_NATIVE_DIR, "libsvm_parser.so")
 
 _lib = None
+_build_tried = False
+
+
+def _try_build() -> None:
+    """One-shot best-effort ``make -C native`` so fresh checkouts get the
+    native parser without a manual build step (~1 s; silently falls back to
+    the Python parser when no toolchain or the build fails)."""
+    global _build_tried
+    if _build_tried:
+        return
+    _build_tried = True
+    if not os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        return
+    import subprocess
+
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True, capture_output=True, timeout=120,
+        )
+    except Exception:
+        pass
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
+    if not os.path.exists(_SO_PATH):
+        _try_build()
     if not os.path.exists(_SO_PATH):
         return None
     lib = ctypes.CDLL(_SO_PATH)
