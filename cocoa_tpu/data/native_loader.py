@@ -31,7 +31,10 @@ _build_tried = False
 def _try_build() -> None:
     """One-shot best-effort ``make -C native`` so fresh checkouts get the
     native parser without a manual build step (~1 s; silently falls back to
-    the Python parser when no toolchain or the build fails)."""
+    the Python parser when no toolchain or the build fails).  Builds to a
+    pid-suffixed temp name and atomically renames it in, so concurrent
+    processes (a multi-host launch on a shared checkout) never dlopen a
+    half-written .so."""
     global _build_tried
     if _build_tried:
         return
@@ -40,13 +43,18 @@ def _try_build() -> None:
         return
     import subprocess
 
+    tmp = f"{_SO_PATH}.tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["make", "-C", _NATIVE_DIR],
+            ["make", "-C", _NATIVE_DIR, f"OUT={os.path.basename(tmp)}"],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _SO_PATH)
     except Exception:
-        pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -57,23 +65,28 @@ def _load() -> Optional[ctypes.CDLL]:
         _try_build()
     if not os.path.exists(_SO_PATH):
         return None
-    lib = ctypes.CDLL(_SO_PATH)
-    lib.cocoa_parse_libsvm.restype = ctypes.c_void_p
-    lib.cocoa_parse_libsvm.argtypes = [ctypes.c_char_p]
-    lib.cocoa_parsed_n.restype = ctypes.c_int64
-    lib.cocoa_parsed_n.argtypes = [ctypes.c_void_p]
-    lib.cocoa_parsed_nnz.restype = ctypes.c_int64
-    lib.cocoa_parsed_nnz.argtypes = [ctypes.c_void_p]
-    lib.cocoa_parsed_fill.restype = None
-    lib.cocoa_parsed_fill.argtypes = [
-        ctypes.c_void_p,
-        ctypes.POINTER(ctypes.c_double),  # labels (n)
-        ctypes.POINTER(ctypes.c_int64),   # indptr (n+1)
-        ctypes.POINTER(ctypes.c_int32),   # indices (nnz)
-        ctypes.POINTER(ctypes.c_double),  # values (nnz)
-    ]
-    lib.cocoa_parsed_free.restype = None
-    lib.cocoa_parsed_free.argtypes = [ctypes.c_void_p]
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.cocoa_parse_libsvm.restype = ctypes.c_void_p
+        lib.cocoa_parse_libsvm.argtypes = [ctypes.c_char_p]
+        lib.cocoa_parsed_n.restype = ctypes.c_int64
+        lib.cocoa_parsed_n.argtypes = [ctypes.c_void_p]
+        lib.cocoa_parsed_nnz.restype = ctypes.c_int64
+        lib.cocoa_parsed_nnz.argtypes = [ctypes.c_void_p]
+        lib.cocoa_parsed_fill.restype = None
+        lib.cocoa_parsed_fill.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),  # labels (n)
+            ctypes.POINTER(ctypes.c_int64),   # indptr (n+1)
+            ctypes.POINTER(ctypes.c_int32),   # indices (nnz)
+            ctypes.POINTER(ctypes.c_double),  # values (nnz)
+        ]
+        lib.cocoa_parsed_free.restype = None
+        lib.cocoa_parsed_free.argtypes = [ctypes.c_void_p]
+    except (OSError, AttributeError):
+        # corrupt/incompatible .so (e.g. an interrupted foreign build):
+        # honor the fallback contract — the Python parser takes over
+        return None
     _lib = lib
     return lib
 

@@ -1,0 +1,28 @@
+"""The deterministic dataset shared by tests/test_multihost.py's in-process
+comparison and its subprocess workers (both import this module, so the two
+sides can never desynchronize)."""
+
+import numpy as np
+
+N, D = 64, 24
+
+
+def build_data():
+    from cocoa_tpu.data.libsvm import LibsvmData
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(N, D)) * (rng.random(size=(N, D)) < 0.5)
+    y = np.where(X @ rng.normal(size=D) > 0, 1.0, -1.0)
+    indptr, indices, values = [0], [], []
+    for i in range(N):
+        nz = np.nonzero(X[i])[0]
+        indices.append(nz.astype(np.int32))
+        values.append(X[i, nz])
+        indptr.append(indptr[-1] + len(nz))
+    return LibsvmData(
+        labels=y,
+        indptr=np.asarray(indptr, np.int64),
+        indices=np.concatenate(indices),
+        values=np.concatenate(values),
+        num_features=D,
+    )
