@@ -20,6 +20,12 @@ while_loop; incompatible with checkpointing), ``--loss``
 (hinge | smooth_hinge | logistic — all solvers and the duality-gap
 certificate generalize; see ops/losses.py) and ``--smoothing`` (the
 smooth_hinge parameter s).
+
+``--objective=lasso`` switches to the ProxCoCoA+ L1 family
+(solvers/prox_cocoa.py): labels become the regression target b,
+``--lambda`` the L1 weight, ``--l2`` the optional elastic-net weight;
+A's columns are sharded over the workers and the printed certificate is
+the lasso duality gap.
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
               "smoothing")  # same-named RunConfig fields
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
-                "profile")  # run-level
+                "profile", "objective", "l2")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -109,6 +115,12 @@ def main(argv=None) -> int:
         losses_mod.validate(cfg.loss, cfg.smoothing)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
+        return 2
+    if cfg.loss not in losses_mod.LOSSES:
+        # prox rules (lasso) are selected by --objective, never by --loss —
+        # the SVM solvers would run garbage updates and crash at first eval
+        print(f"error: --loss must be one of {losses_mod.LOSSES}; "
+              f"use --objective=lasso for the L1 family", file=sys.stderr)
         return 2
     if cfg.math not in ("exact", "fast"):
         print(f"error: --math must be exact|fast, got {cfg.math!r}",
@@ -191,12 +203,21 @@ def main(argv=None) -> int:
     if mesh_size == k and (k > 1 or fp > 1):
         mesh = make_mesh(k, fp=fp)
 
+    objective = (extras["objective"] or "svm").lower()
+    if objective not in ("svm", "lasso"):
+        print(f"error: --objective must be svm|lasso, got {objective!r}",
+              file=sys.stderr)
+        return 2
+
     try:
-        ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
-        test_ds = None
-        if cfg.test_file:
-            test_data = load_libsvm(cfg.test_file, cfg.num_features)
-            test_ds = shard_dataset(test_data, k=k, layout=cfg.layout, dtype=dtype, mesh=mesh)
+        ds = test_ds = None
+        if objective == "svm":
+            ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype,
+                               mesh=mesh)
+            if cfg.test_file:
+                test_data = load_libsvm(cfg.test_file, cfg.num_features)
+                test_ds = shard_dataset(test_data, k=k, layout=cfg.layout,
+                                        dtype=dtype, mesh=mesh)
     except (OSError, ValueError) as e:  # e.g. --layout=sparse with --fp>1
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -224,6 +245,54 @@ def main(argv=None) -> int:
     if resume and not cfg.chkpt_dir:
         print("error: --resume requires --chkptDir", file=sys.stderr)
         return 2
+
+    if objective == "lasso":
+        # --objective=lasso: ProxCoCoA+ on 0.5||Ax-b||^2 + lambda||x||_1
+        # (+ l2/2 ||x||^2), labels as the regression target; A's columns
+        # sharded over the workers (data/columns.py)
+        if fp > 1:
+            print("error: --objective=lasso already shards the feature "
+                  "axis over workers; --fp does not apply", file=sys.stderr)
+            return 2
+        if resume or (cfg.chkpt_dir and cfg.chkpt_iter > 0):
+            print("error: checkpoint/resume is not implemented for "
+                  "--objective=lasso yet", file=sys.stderr)
+            return 2
+        if cfg.test_file:
+            print("error: --testFile does not apply to --objective=lasso "
+                  "(no classification error to report)", file=sys.stderr)
+            return 2
+        try:
+            l2 = float(extras["l2"]) if extras["l2"] else 0.0
+        except ValueError:
+            print(f"error: --l2 must be a float, got {extras['l2']!r}",
+                  file=sys.stderr)
+            return 2
+        from cocoa_tpu.config import Params
+        from cocoa_tpu.data.columns import shard_columns
+        from cocoa_tpu.solvers import run_prox_cocoa
+
+        ds_c, b = shard_columns(data, k, dtype=dtype, mesh=mesh)
+        d = data.num_features
+        h = max(1, int(cfg.local_iter_frac * d / k))  # H over coordinates
+        lasso_params = Params(
+            n=d, num_rounds=cfg.num_rounds, local_iters=h, lam=cfg.lam,
+            beta=cfg.beta, gamma=cfg.gamma, loss="lasso", smoothing=l2,
+        )
+        x, r, traj = run_prox_cocoa(
+            ds_c, b, lasso_params, cfg.to_debug(), mesh=mesh, rng=cfg.rng,
+            gap_target=gap_target, scan_chunk=cfg.scan_chunk,
+            math=cfg.math, device_loop=cfg.device_loop,
+        )
+        from cocoa_tpu.solvers.prox_cocoa import _metrics_fn
+
+        final = [float(v) for v in
+                 _metrics_fn(mesh, cfg.lam, l2)(r, x, ds_c.shard_arrays(), b)]
+        traj.summary(final[0],
+                     gap=None if l2 != 0.0 else final[1], test_error=None)
+        if extras["trajOut"]:
+            traj.dump_jsonl(f"{extras['trajOut']}.ProxCoCoA+.jsonl")
+        return 0
 
     def restore(algorithm):
         """(w_init, alpha_init, start_round) from the latest checkpoint."""

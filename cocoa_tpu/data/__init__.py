@@ -1,5 +1,6 @@
 from cocoa_tpu.data.libsvm import load_libsvm, LibsvmData  # noqa: F401
 from cocoa_tpu.data.sharding import ShardedDataset, shard_dataset  # noqa: F401
+from cocoa_tpu.data.columns import shard_columns  # noqa: F401
 from cocoa_tpu.data.synth import (  # noqa: F401
     synth_dense,
     synth_dense_sharded,

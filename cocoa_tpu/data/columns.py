@@ -1,0 +1,96 @@
+"""Column (feature) shards for the primal prox solvers (ProxCoCoA+).
+
+The L1 framework partitions the design matrix A (n × d) by **columns**:
+worker k owns a coordinate block x_[k] and its columns A_[k], and the
+shared n-vector v = A·x is the replicated state (the exact mirror of the
+dual solvers, where examples are sharded and the d-vector w is shared).
+
+This builder reuses :class:`~cocoa_tpu.data.sharding.ShardedDataset` with
+the roles transposed: the shard's "rows" are columns a_j (shape (n,)),
+``labels`` is all-ones (the prox rules have no y factor), ``sq_norms`` are
+column norms ‖a_j‖², ``counts`` the per-shard column counts, and
+``num_features`` is n (padded) — the length of the replicated residual
+vector r = A·x − b.  Every downstream consumer — the fan-out machinery,
+the fori_loop inner solvers, both Pallas kernels — works unchanged on
+this transposed layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cocoa_tpu.data.libsvm import LibsvmData
+from cocoa_tpu.data.sharding import ShardedDataset, split_sizes
+from cocoa_tpu.parallel import mesh as mesh_lib
+
+
+def shard_columns(
+    data: LibsvmData,
+    k: int,
+    dtype=jnp.float32,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Tuple[ShardedDataset, jax.Array]:
+    """Partition A's d columns into K balanced contiguous blocks.
+
+    Returns ``(ds, b)``: ``ds`` is the transposed-role ShardedDataset
+    (``ds.X[k, j]`` = column ``offs[k]+j`` of A as a dense (n_pad,)
+    vector), ``b`` the (n_pad,) regression target (``data.labels``,
+    zero-padded — padding rows of A are zero so they touch nothing).
+
+    Dense layout only (a sparse padded-CSC variant would mirror the CSR
+    one); intended for lasso-scale d where columns fit per-device HBM.
+    """
+    n, d = data.n, data.num_features
+    np_dtype = np.dtype(dtype)
+    sizes = split_sizes(d, k)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    # pad the column count per shard to a sublane multiple (row axis of the
+    # shard) and n to a sublane multiple (the kernels' "feature" axis)
+    d_shard = -(-int(sizes.max()) // 16) * 16
+    n_pad = mesh_lib.pad_features(n, mesh)
+
+    # dense columns: build A^T once (n×d dense), slice per shard
+    AT = np.zeros((d, n_pad), dtype=np_dtype)
+    row_nnz = np.diff(data.indptr)
+    rows = np.repeat(np.arange(n), row_nnz)
+    AT[data.indices, rows] = data.values
+
+    X = np.zeros((k, d_shard, n_pad), dtype=np_dtype)
+    labels = np.zeros((k, d_shard), dtype=np_dtype)
+    mask = np.zeros((k, d_shard), dtype=np_dtype)
+    sq_norms = np.zeros((k, d_shard), dtype=np_dtype)
+    col_sq = (AT.astype(np.float64) ** 2).sum(axis=1)
+    for s in range(k):
+        lo, hi = offsets[s], offsets[s + 1]
+        m = hi - lo
+        X[s, :m] = AT[lo:hi]
+        labels[s, :m] = 1.0   # prox rules have no y factor
+        mask[s, :m] = 1.0
+        sq_norms[s, :m] = col_sq[lo:hi]
+
+    def put(arr, fp_last=False):
+        if mesh is not None:
+            if fp_last:
+                return jax.device_put(arr, mesh_lib.x_sharding(mesh))
+            return jax.device_put(
+                arr, mesh_lib.sharded_rows(mesh, extra_dims=arr.ndim - 1)
+            )
+        return jnp.asarray(arr)
+
+    b = np.zeros(n_pad, dtype=np_dtype)
+    b[:n] = data.labels
+    ds = ShardedDataset(
+        layout="dense",
+        n=d,                      # "examples" of this transposed view
+        num_features=n_pad,       # the replicated vector length
+        counts=sizes.astype(np.int64),
+        labels=put(labels),
+        mask=put(mask),
+        sq_norms=put(sq_norms),
+        X=put(X, fp_last=True),
+    )
+    return ds, jnp.asarray(b)

@@ -15,6 +15,14 @@ Three statically-selected gradient modes cover the three algorithms:
   qii = ‖x‖²·σ′ (:174)
 - ``"frozen"`` — mini-batch CD: w frozen, plain grad (MinibatchCD.scala:104),
   qii = ‖x‖² (:114); α still advances within the batch (:123)
+- ``"prox"``   — ProxCoCoA+ primal coordinate descent (no reference
+  analogue; arXiv:1512.04011 structure): the roles of examples and
+  features swap — the shard's "rows" are columns a_j of the design
+  matrix, ``w`` is the replicated residual r₀ = Ax − b, ``alpha`` the
+  shard's coordinate block of x, and the margin a_jᵀ(r₀ + σ′Δv) feeds a
+  prox rule (losses.PROX_RULES) instead of a dual-ascent rule.  Same
+  σ′-scaled read structure as "plus"; the Δw axpy coefficient is the raw
+  coordinate delta (``coef_divisor`` == 1) rather than y·Δα/(λn)
 
 Sampled indices arrive precomputed as ``idxs`` (H,) — index draws are
 data-independent, so hoisting RNG off the device hot path changes nothing
@@ -34,7 +42,14 @@ from jax import lax
 from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.rows import get_row, row_axpy, row_dot
 
-MODES = ("cocoa", "plus", "frozen")
+MODES = ("cocoa", "plus", "frozen", "prox")
+
+
+def coef_divisor(mode: str, lam_n: float) -> float:
+    """The Δw axpy coefficient is y·(α_new − α)/(λn) for the dual-ascent
+    modes (CoCoA.scala:181) but the raw coordinate delta for the primal
+    prox mode (Δv += a_j·δ)."""
+    return 1.0 if mode == "prox" else lam_n
 
 
 def local_sdca(
@@ -62,6 +77,7 @@ def local_sdca(
     sq_norms = shard["sq_norms"]
     dtype = w_init.dtype
     lam_n = jnp.asarray(lam * n, dtype)
+    coef_div = jnp.asarray(coef_divisor(mode, lam * n), dtype)
     sigma_c = jnp.asarray(sigma, dtype)
     one = jnp.asarray(1.0, dtype)
 
@@ -72,16 +88,16 @@ def local_sdca(
         y = labels[idx]
         a = a_vec[idx]
 
-        if mode == "plus":
+        if mode in ("plus", "prox"):
             margin = row_dot(row, w) + sigma_c * row_dot(row, dw)
         else:
             margin = row_dot(row, w)
 
-        qii = sq_norms[idx] * (sigma_c if mode == "plus" else one)
+        qii = sq_norms[idx] * (sigma_c if mode in ("plus", "prox") else one)
         new_a = losses.alpha_step(loss, a, y * margin, qii, lam_n,
                                   smoothing=smoothing)
 
-        coef = y * (new_a - a) / lam_n
+        coef = y * (new_a - a) / coef_div
         dw = row_axpy(row, coef, dw)
         if mode == "cocoa":
             w = row_axpy(row, coef, w)  # local view advances (CoCoA.scala:182-184)
@@ -107,10 +123,12 @@ def mode_factors(mode: str, sigma: float):
       ⇒ sig_eff = σ′, qii = ‖x‖²·σ′.
     - frozen: w frozen, no Δw term (MinibatchCD.scala:104)
       ⇒ sig_eff = 0, qii = ‖x‖².
+    - prox:   same read structure as plus (r₀ frozen, σ′-scaled Δv reads)
+      ⇒ sig_eff = σ′, qii = ‖a_j‖²·σ′.
     """
     if mode == "cocoa":
         return 1.0, 1.0
-    if mode == "plus":
+    if mode in ("plus", "prox"):
         return sigma, sigma
     if mode == "frozen":
         return 0.0, 1.0
@@ -146,6 +164,7 @@ def local_sdca_fast(
     sq_norms = shard["sq_norms"]
     dtype = margins0.dtype
     lam_n = jnp.asarray(lam * n, dtype)
+    coef_div = jnp.asarray(coef_divisor(mode, lam * n), dtype)
     sig_c = jnp.asarray(sig_eff, dtype)
     qf = jnp.asarray(qii_factor, dtype)
 
@@ -163,7 +182,7 @@ def local_sdca_fast(
         new_a = losses.alpha_step(loss, a, y * margin, qii, lam_n,
                                   smoothing=smoothing)
 
-        coef = y * (new_a - a) / lam_n
+        coef = y * (new_a - a) / coef_div
         dw = row_axpy(row, coef, dw)
         a_vec = a_vec.at[idx].set(new_a)
         return dw, a_vec

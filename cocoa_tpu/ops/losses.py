@@ -36,6 +36,9 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 LOSSES = ("hinge", "smooth_hinge", "logistic")
+# scalar prox rules for the primal (ProxCoCoA+) solvers — valid for
+# alpha_step but NOT classification losses (no primal/dual_term/grad_factor)
+PROX_RULES = ("lasso",)
 
 # α clamp for logistic: the entropy dual needs α ∈ (0,1) strictly
 _EPS = 1e-12
@@ -44,12 +47,19 @@ _NEWTON_ITERS = 10
 
 
 def validate(loss: str, smoothing=None) -> str:
-    if loss not in LOSSES:
-        raise ValueError(f"loss must be one of {LOSSES}, got {loss!r}")
+    if loss not in LOSSES + PROX_RULES:
+        raise ValueError(
+            f"loss must be one of {LOSSES + PROX_RULES}, got {loss!r}"
+        )
     if loss == "smooth_hinge" and smoothing is not None and smoothing <= 0.0:
         # s ≤ 0 flips the ascent denominator's sign / divides by zero
         raise ValueError(
             f"smooth_hinge needs smoothing > 0, got {smoothing}"
+        )
+    if loss == "lasso" and smoothing is not None and smoothing < 0.0:
+        raise ValueError(
+            f"lasso's smoothing is the elastic-net l2 weight, needs >= 0, "
+            f"got {smoothing}"
         )
     return loss
 
@@ -106,7 +116,9 @@ def grad_factor(loss: str, z, smoothing: float = 1.0):
 
 
 def alpha_step(loss: str, a, z, qii, lam_n, smoothing: float = 1.0):
-    """SDCA single-coordinate dual-ascent update → new α ∈ [0,1].
+    """Single-coordinate update: SDCA dual ascent → new α ∈ [0,1] for the
+    classification losses; prox-CD → new (unbounded) coordinate value for
+    the ``PROX_RULES``.
 
     ``z`` is the margin the subproblem sees (mode-dependent: w, w+Δw, or
     w+σ′Δw — the caller computes it); ``qii`` is the σ′-scaled ‖x‖².
@@ -151,4 +163,19 @@ def alpha_step(loss: str, a, z, qii, lam_n, smoothing: float = 1.0):
             gp = 1.0 + q * sig * (1.0 - sig)
             u = jnp.clip(u - g / gp, -_U_MAX, _U_MAX)
         return 1.0 / (1.0 + jnp.exp(-u))
+    if loss == "lasso":
+        # ProxCoCoA+ primal coordinate step (mode="prox"): ``a`` is the
+        # working coordinate value x_j + Δx_j, ``z`` the σ′-corrected
+        # gradient a_jᵀ(r₀ + σ′Δv), ``qii`` = σ′·‖a_j‖², ``lam_n`` the L1
+        # weight λ, ``smoothing`` the elastic-net l2 weight s (0 = lasso).
+        # Exact minimizer over t = a + δ of
+        #   (z − qii·a)·t + (qii + s)/2·t² + λ|t|
+        # is the soft-threshold t* = S_{λ/(qii+s)}((qii·a − z)/(qii + s));
+        # a zero column with s=0 (qii==0) is a no-op.
+        denom = qii + smoothing
+        safe = jnp.where(denom > 0.0, denom, 1.0)
+        u = (qii * a - z) / safe
+        thr = lam_n / safe
+        t = jnp.sign(u) * jnp.maximum(jnp.abs(u) - thr, 0.0)
+        return jnp.where(denom > 0.0, t, a)
     raise ValueError(f"unknown loss {loss!r}")

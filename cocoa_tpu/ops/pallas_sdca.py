@@ -62,7 +62,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cocoa_tpu.ops import losses
-from cocoa_tpu.ops.local_sdca import mode_factors
+from cocoa_tpu.ops.local_sdca import coef_divisor, mode_factors
 
 LANES = 128
 SUBLANES = 8  # f32 sublane count: rows fold to (8, d/8)
@@ -120,6 +120,7 @@ def _kernel(
     idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
     *refs,           # S row blocks, 4 shard vecs, 2 outs, 2 scratch (below)
     lam_n: float,
+    coef_div: float,
     sig_eff: float,
     qii_factor: float,
     frozen: bool,
@@ -185,7 +186,7 @@ def _kernel(
         new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
                                   smoothing=smoothing)
 
-        coef = jnp.where(live, y * (new_a - a) / lam_n, 0.0)
+        coef = jnp.where(live, y * (new_a - a) / coef_div, 0.0)
         dw_acc[...] = dw_acc[...] + coef * x
         alpha_sc[pl.ds(blk, 1), :] = jnp.where(
             sel & live, new_a, alpha_sc[pl.ds(blk, 1), :]
@@ -265,6 +266,7 @@ def pallas_sdca_round(
     kernel = functools.partial(
         _kernel,
         lam_n=float(lam * n),
+        coef_div=float(coef_divisor(mode, lam * n)),
         sig_eff=float(sig_eff),
         qii_factor=float(qii_factor),
         frozen=(mode == "frozen"),

@@ -47,7 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cocoa_tpu.ops import losses
-from cocoa_tpu.ops.local_sdca import mode_factors
+from cocoa_tpu.ops.local_sdca import coef_divisor, mode_factors
 from cocoa_tpu.ops.pallas_sdca import LANES, check_dtype
 
 ROW_BLOCK = 8          # aligned sublane block for the per-step value row
@@ -98,6 +98,7 @@ def _kernel(
     alpha_sc,        # scratch (n_blocks, LANES)
     *,
     lam_n: float,
+    coef_div: float,
     sig_eff: float,
     qii_factor: float,
     frozen: bool,
@@ -156,7 +157,7 @@ def _kernel(
 
     new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
                               smoothing=smoothing)
-    coef = y * (new_a - a) / lam_n
+    coef = y * (new_a - a) / coef_div
 
     # scatter-add coef·x into Δw: one masked (1, 128) row update per nonzero
     for j in range(w_nnz):
@@ -262,6 +263,7 @@ def pallas_sparse_sdca_round(
         kernel = functools.partial(
             _kernel,
             lam_n=float(lam * n),
+            coef_div=float(coef_divisor(mode, lam * n)),
             sig_eff=float(sig_eff),
             qii_factor=float(qii_factor),
             frozen=(mode == "frozen"),

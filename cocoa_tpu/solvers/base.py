@@ -447,9 +447,11 @@ def drive_device_paths(
     scan_chunk: int = 0,
     device_loop: bool = False,
     cache_key=None,
+    eval_kernel=None,
 ):
     """The scan_chunk / device_loop dispatch shared by every solver: builds
-    the fused eval kernel (dual state iff ``alpha_in_state``) and routes to
+    the fused eval kernel (dual state iff ``alpha_in_state``; overridable
+    for non-classification objectives) and routes to
     :func:`drive_device_full` or :func:`drive_chunked`.  Returns
     (state, Trajectory)."""
     from cocoa_tpu.evals import objectives
@@ -458,13 +460,14 @@ def drive_device_paths(
         test_arrays = test_ds.shard_arrays() if test_ds is not None else None
         test_n = test_ds.n if test_ds is not None else 0
 
-        def eval_kernel(state, shard_arrays, test_arrays):
-            alpha = state[1] if alpha_in_state else None
-            return objectives.eval_metrics(
-                state[0], alpha, shard_arrays, params.lam, params.n,
-                mesh=mesh, test_shard_arrays=test_arrays, test_n=test_n,
-                loss=params.loss, smoothing=params.smoothing,
-            )
+        if eval_kernel is None:
+            def eval_kernel(state, shard_arrays, test_arrays):
+                alpha = state[1] if alpha_in_state else None
+                return objectives.eval_metrics(
+                    state[0], alpha, shard_arrays, params.lam, params.n,
+                    mesh=mesh, test_shard_arrays=test_arrays, test_n=test_n,
+                    loss=params.loss, smoothing=params.smoothing,
+                )
 
         return drive_device_full(
             name, params, debug, state, chunk_kernel, eval_kernel, chunk_fn,

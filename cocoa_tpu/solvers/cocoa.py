@@ -228,10 +228,18 @@ def run_sdca_family(
     math: str = "exact",
     pallas=None,
     device_loop: bool = False,
+    eval_fn=None,
+    eval_kernel=None,
 ):
     """Shared driver for the SDCA-family algorithms (CoCoA, CoCoA+,
     mini-batch CD — they differ only in their ``alg`` scaling triple, see
-    :func:`_alg_config`).  Train; returns (w, alpha, Trajectory).
+    :func:`_alg_config`) and, with eval overrides, the primal prox family
+    (solvers/prox_cocoa.py).  Train; returns (w, alpha, Trajectory).
+
+    ``eval_fn(state) -> (primal, gap|None, test_err|None)`` and
+    ``eval_kernel(state, shard_arrays, test_arrays) -> (3,) metrics``
+    override the classification objectives (needed when the state has
+    different semantics — e.g. ProxCoCoA+'s residual/coordinates).
 
     Extensions over the reference: ``gap_target`` stops early once the
     duality gap — checked at the ``debugIter`` cadence — falls below the
@@ -260,7 +268,9 @@ def run_sdca_family(
     base.check_shards(ds)
     k = ds.k
     if not quiet:
-        print(f"\nRunning {alg_name} on {params.n} data examples, "
+        # ds.n, not params.n: the prox family clones params with n=1 (its
+        # update has no λn factor) while ds.n stays the coordinate count
+        print(f"\nRunning {alg_name} on {ds.n} data examples, "
               f"distributed over {k} workers")
 
     dtype = ds.labels.dtype
@@ -345,10 +355,12 @@ def run_sdca_family(
 
         shard_arrays = {**shard_arrays, "X_folded": fold_rows(shard_arrays["X"])}
 
-    def eval_fn(state):
-        w, alpha = state
-        return objectives.evaluate(ds, w, alpha, params.lam, test_ds=test_ds,
-                                   loss=params.loss, smoothing=params.smoothing)
+    if eval_fn is None:
+        def eval_fn(state):
+            w, alpha = state
+            return objectives.evaluate(
+                ds, w, alpha, params.lam, test_ds=test_ds,
+                loss=params.loss, smoothing=params.smoothing)
 
     if device_loop or scan_chunk > 0:
         raw_kernel = _make_chunk_kernel(mesh, params, k, alg, **parts_kw)
@@ -363,7 +375,7 @@ def run_sdca_family(
                               sampler.chunk_indices(t0, c), shard_arrays)
 
         cache_key = (
-            "sdca", alg, math, pallas, k, mesh,
+            "sdca", alg_name, alg, math, pallas, k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,
             params.num_rounds, debug.debug_iter, start_round,
@@ -375,6 +387,7 @@ def run_sdca_family(
             test_ds=test_ds, quiet=quiet, gap_target=gap_target,
             start_round=start_round, scan_chunk=scan_chunk,
             device_loop=device_loop, cache_key=cache_key,
+            eval_kernel=eval_kernel,
         )
         return w, alpha, traj
 

@@ -1,0 +1,158 @@
+"""ProxCoCoA+ — communication-efficient L1-regularized regression (lasso /
+elastic net).
+
+No reference analogue (the reference is hinge-SVM only) — this is the
+framework's follow-up-paper extension (arXiv:1512.04011 structure),
+included because the reference is explicitly designed for swappable local
+solvers/objectives (README.md:14, CoCoA.scala:13-14) and the L1 primal
+family is the canonical "swap".
+
+Problem:  min_x  0.5·‖A·x − b‖² + λ·‖x‖₁ (+ η/2·‖x‖²  elastic net)
+
+Structure — the exact mirror of the dual solvers with examples↔features
+swapped:
+
+- A's **columns** are sharded (data/columns.py); worker k owns coordinate
+  block x_[k] and columns A_[k].
+- The replicated state is the residual r = A·x − b (an n-vector — the
+  analogue of w); the shard-local state is x_[k] (the analogue of α).
+- One round: each worker runs H prox coordinate-descent steps against the
+  frozen r₀ with σ′-scaled reads of its accumulated Δv = A_[k]·Δx_[k]
+  (exactly CoCoA+'s subproblem structure, mode="prox"), then ONE psum of
+  Δv per round: r += γ·ΣΔv.  The per-step soft-threshold rule lives in
+  ops/losses.py ("lasso").
+
+Because the structure is identical, the entire SDCA-family machinery —
+fast-math margins decomposition, both Pallas kernels, device-side chunked
+rounds and the device-resident loop, gap-target early stop — is reused
+verbatim via run_sdca_family with mode="prox" and a lasso-specific
+duality-gap certificate: gap = P(x) − D(s·r) with the dual-feasible
+scaling s = min(1, λ/‖Aᵀr‖∞), D(u) = −½‖u‖² − uᵀb (pure lasso only;
+the elastic-net gap is reported as None).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.ops.rows import shard_margins
+from cocoa_tpu.parallel.fanout import fanout
+from cocoa_tpu.solvers.cocoa import run_sdca_family
+
+
+def lasso_metrics(r, x, shard_arrays, b, l1: float, l2: float, mesh=None):
+    """(primal, gap|NaN, NaN) for the elastic-net objective, as one stacked
+    device array — one fan-out over the column shards (Σ|x|, Σx², and the
+    per-shard max |a_jᵀr| for the dual-feasible scaling), zero host syncs.
+    The gap certificate is exact for pure lasso (l2 == 0) and NaN
+    otherwise."""
+    def per_shard(rw, x_k, shard):
+        m = shard["mask"]
+        sums = jnp.stack([
+            jnp.sum(jnp.abs(x_k) * m),
+            jnp.sum(x_k * x_k * m),
+        ])
+        corr_max = jnp.max(jnp.abs(shard_margins(rw, shard)) * m)
+        return sums, corr_max
+
+    sums, corr_max_k = fanout(per_shard, mesh, r, x, shard_arrays)
+    rr = r @ r
+    primal = 0.5 * rr + l1 * sums[0] + 0.5 * l2 * sums[1]
+    if l2 == 0.0:
+        inf_norm = jnp.max(corr_max_k)
+        s = jnp.minimum(1.0, l1 / jnp.maximum(inf_norm, 1e-30))
+        u = s * r
+        dual = -0.5 * (u @ u) - u @ b
+        gap = primal - dual
+    else:
+        gap = jnp.asarray(jnp.nan, primal.dtype)
+    return jnp.stack([primal, gap, jnp.asarray(jnp.nan, primal.dtype)])
+
+
+@functools.lru_cache(maxsize=None)
+def _metrics_fn(mesh, l1: float, l2: float):
+    @jax.jit
+    def f(r, x, shard_arrays, b):
+        return lasso_metrics(r, x, shard_arrays, b, l1, l2, mesh=mesh)
+
+    return f
+
+
+def run_prox_cocoa(
+    ds: ShardedDataset,
+    b: jax.Array,
+    params: Params,
+    debug: DebugParams,
+    mesh=None,
+    rng: str = "reference",
+    x_init: Optional[jax.Array] = None,
+    r_init: Optional[jax.Array] = None,
+    start_round: int = 1,
+    quiet: bool = False,
+    gap_target: Optional[float] = None,
+    scan_chunk: int = 0,
+    math: str = "fast",
+    pallas=None,
+    device_loop: bool = False,
+):
+    """Train; returns (x, r, Trajectory) with x (K, d_shard) the sharded
+    coordinates and r = A·x − b the replicated residual (v = r + b).
+
+    ``ds``/``b`` come from :func:`cocoa_tpu.data.columns.shard_columns`.
+    ``params.lam`` is the L1 weight λ, ``params.smoothing`` the elastic-net
+    l2 weight η (0 = pure lasso), ``params.gamma`` the aggregation γ
+    (γ=1 additive, σ′ = K·γ — the CoCoA+ safe default), ``params.local_iters``
+    the per-round coordinate steps H.  ``gap_target`` stops at the lasso
+    duality gap (pure lasso only).  Execution options (``scan_chunk``,
+    ``math``, ``pallas``, ``device_loop``) as in run_sdca_family — all
+    paths incl. both Pallas kernels work on the transposed layout."""
+    l1, l2 = float(params.lam), float(params.smoothing)
+    # mode="prox" has no λn factor: clone with n=1 so the shared parts'
+    # lam_n == λ exactly, and select the lasso prox rule
+    parts_params = dataclasses.replace(params, n=1, loss="lasso")
+    alg = ("prox", params.gamma, ds.k * params.gamma)
+    dtype = ds.labels.dtype
+    b = jnp.asarray(b, dtype)
+    metrics = _metrics_fn(mesh, l1, l2)
+
+    def eval_fn(state):
+        r, x = state
+        out = np.asarray(metrics(r, x, ds.shard_arrays(), b))
+        primal, gap, _ = (float(v) for v in out)
+        return primal, (None if np.isnan(gap) else gap), None
+
+    def eval_kernel(state, shard_arrays, test_arrays):
+        # b arrives as the (otherwise unused) test_arrays ARGUMENT, not a
+        # closure constant: device-loop executables are cached per config
+        # (base._DEVICE_RUNS), and a baked-in b would make a cached
+        # executable evaluate against the wrong dataset
+        r, x = state
+        return lasso_metrics(r, x, shard_arrays, test_arrays, l1, l2,
+                             mesh=mesh)
+
+    class _BCarrier:
+        """Quacks like a test dataset so drive_device_paths ships b as the
+        eval kernel's test_arrays argument."""
+        n = 0
+
+        def shard_arrays(self):
+            return b
+
+    w_init = -b if r_init is None else jnp.asarray(r_init, dtype)
+    r, x, traj = run_sdca_family(
+        ds, parts_params, debug, "ProxCoCoA+", alg, mesh=mesh,
+        test_ds=_BCarrier(),
+        rng=rng, w_init=w_init, alpha_init=x_init, start_round=start_round,
+        quiet=quiet, gap_target=gap_target, scan_chunk=scan_chunk,
+        math=math, pallas=pallas, device_loop=device_loop,
+        eval_fn=eval_fn, eval_kernel=eval_kernel,
+    )
+    return x, r, traj

@@ -82,10 +82,17 @@ def test_two_process_run_matches_single_process(tmp_path):
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=220)
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=220)
+            assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+            outs.append(out)
+    finally:
+        # a hung rendezvous must not orphan the sibling worker (it would
+        # pin the Gloo port and poison later runs)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     results = []
     for out in outs:
