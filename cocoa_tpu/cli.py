@@ -254,13 +254,14 @@ def main(argv=None) -> int:
             print("error: --objective=lasso already shards the feature "
                   "axis over workers; --fp does not apply", file=sys.stderr)
             return 2
-        if resume or (cfg.chkpt_dir and cfg.chkpt_iter > 0):
-            print("error: checkpoint/resume is not implemented for "
-                  "--objective=lasso yet", file=sys.stderr)
-            return 2
         if cfg.test_file:
             print("error: --testFile does not apply to --objective=lasso "
                   "(no classification error to report)", file=sys.stderr)
+            return 2
+        if cfg.layout == "sparse":
+            print("error: --objective=lasso supports the dense column "
+                  "layout only (a padded-CSC column builder does not exist "
+                  "yet); drop --layout=sparse", file=sys.stderr)
             return 2
         try:
             l2 = float(extras["l2"]) if extras["l2"] else 0.0
@@ -268,21 +269,34 @@ def main(argv=None) -> int:
             print(f"error: --l2 must be a float, got {extras['l2']!r}",
                   file=sys.stderr)
             return 2
-        from cocoa_tpu.config import Params
+        if l2 < 0.0:
+            print(f"error: --l2 is the elastic-net weight, needs >= 0, "
+                  f"got {l2}", file=sys.stderr)
+            return 2
         from cocoa_tpu.data.columns import shard_columns
         from cocoa_tpu.solvers import run_prox_cocoa
 
         ds_c, b = shard_columns(data, k, dtype=dtype, mesh=mesh)
         d = data.num_features
-        h = max(1, int(cfg.local_iter_frac * d / k))  # H over coordinates
-        lasso_params = Params(
-            n=d, num_rounds=cfg.num_rounds, local_iters=h, lam=cfg.lam,
-            beta=cfg.beta, gamma=cfg.gamma, loss="lasso", smoothing=l2,
+        # same H = max(1, localIterFrac·n/K) law, over coordinates
+        lasso_params = dataclasses.replace(
+            cfg.to_params(d, k), loss="lasso", smoothing=l2,
         )
+        resume_kw = {}
+        if resume:
+            from cocoa_tpu import checkpoint as ckpt_lib
+
+            path = ckpt_lib.latest(cfg.chkpt_dir, "ProxCoCoA+")
+            if path is not None:
+                meta, r0, x0 = ckpt_lib.load(path)
+                print(f"resuming ProxCoCoA+ from round {meta['round']} "
+                      f"({path})")
+                resume_kw = dict(r_init=r0, x_init=x0,
+                                 start_round=meta["round"] + 1)
         x, r, traj = run_prox_cocoa(
             ds_c, b, lasso_params, cfg.to_debug(), mesh=mesh, rng=cfg.rng,
             gap_target=gap_target, scan_chunk=cfg.scan_chunk,
-            math=cfg.math, device_loop=cfg.device_loop,
+            math=cfg.math, device_loop=cfg.device_loop, **resume_kw,
         )
         from cocoa_tpu.solvers.prox_cocoa import _metrics_fn
 

@@ -63,7 +63,8 @@ def shard_columns(
     labels = np.zeros((k, d_shard), dtype=np_dtype)
     mask = np.zeros((k, d_shard), dtype=np_dtype)
     sq_norms = np.zeros((k, d_shard), dtype=np_dtype)
-    col_sq = (AT.astype(np.float64) ** 2).sum(axis=1)
+    # f64 accumulation without a full-matrix f64 temporary (AT can be GBs)
+    col_sq = np.einsum("ij,ij->i", AT, AT, dtype=np.float64)
     for s in range(k):
         lo, hi = offsets[s], offsets[s + 1]
         m = hi - lo

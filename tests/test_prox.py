@@ -156,6 +156,35 @@ def test_prox_elastic_net_reports_no_gap():
     assert primals[-1] <= primals[0]
 
 
+def test_prox_resume_equals_uninterrupted(tmp_path):
+    """Checkpoint the (r, x) state at round 6, resume to 12 → identical to
+    a straight 12-round run (round-indexed RNG makes this exact)."""
+    A, b, _, data = _problem(seed=6)
+    d = data.num_features
+    ds, b_dev = shard_columns(data, K, dtype=jnp.float64)
+    lam = 0.1 * np.max(np.abs(A.T @ b))
+    dbg_save = DebugParams(debug_iter=6, seed=0, chkpt_iter=6,
+                           chkpt_dir=str(tmp_path))
+    p_half = _params(d, float(lam), num_rounds=6)
+    run_prox_cocoa(ds, b_dev, p_half, dbg_save, quiet=True, math="exact")
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+
+    path = ckpt_lib.latest(str(tmp_path), "ProxCoCoA+")
+    assert path is not None
+    meta, r0, x0 = ckpt_lib.load(path)
+    assert meta["round"] == 6
+
+    p_full = _params(d, float(lam), num_rounds=12)
+    x_a, r_a, _ = run_prox_cocoa(ds, b_dev, p_full, _DBG, quiet=True,
+                                 math="exact")
+    x_b, r_b, _ = run_prox_cocoa(ds, b_dev, p_full, _DBG, quiet=True,
+                                 math="exact", r_init=r0, x_init=x0,
+                                 start_round=meta["round"] + 1)
+    np.testing.assert_array_equal(np.asarray(x_b), np.asarray(x_a))
+    np.testing.assert_array_equal(np.asarray(r_b), np.asarray(r_a))
+
+
 def test_prox_recovers_sparse_support():
     A, b, x_true, data = _problem(seed=5, noise=0.001)
     ds, b_dev = shard_columns(data, K, dtype=jnp.float64)
