@@ -218,6 +218,51 @@ def bench_rcv1(results, quick):
     ))
 
 
+def bench_lasso(results, quick):
+    """ProxCoCoA+ lasso (the L1 extension, no reference analogue): dense
+    Gaussian design with a planted 64-sparse x*, λ = 0.3·λ_max, to a
+    RELATIVE duality gap of 1e-3 (gap ≤ 1e-3 · ½‖b‖² — lasso objectives
+    are scale-dependent, so an absolute target would be meaningless)."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data.columns import shard_columns
+    from cocoa_tpu.data.libsvm import LibsvmData
+    from cocoa_tpu.solvers import run_prox_cocoa
+
+    n, d, k = (2048, 8192, 8) if quick else (8192, 32768, 8)
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((n, d)).astype(np.float32) / np.sqrt(n)
+    x_true = np.zeros(d, np.float32)
+    x_true[rng.choice(d, 64, replace=False)] = \
+        rng.standard_normal(64).astype(np.float32) * 3
+    bvec = A @ x_true + 0.01 * rng.standard_normal(n).astype(np.float32)
+    indptr = np.arange(0, (n + 1) * d, d, dtype=np.int64)
+    data = LibsvmData(labels=bvec.astype(np.float64), indptr=indptr,
+                      indices=np.tile(np.arange(d, dtype=np.int32), n),
+                      values=A.reshape(-1).astype(np.float64),
+                      num_features=d)
+    ds, b = shard_columns(data, k, dtype=jnp.float32)
+    lam = 0.3 * float(np.max(np.abs(A.T @ bvec)))
+    p0 = 0.5 * float(bvec @ bvec)
+    h = d // k // 10
+    params = Params(n=d, num_rounds=3000, local_iters=h, lam=lam,
+                    loss="lasso", smoothing=0.0)
+    debug = DebugParams(debug_iter=50, seed=0)
+
+    def go():
+        return run_prox_cocoa(ds, b, params, debug, quiet=True, math="fast",
+                              device_loop=True, gap_target=1e-3 * p0)
+
+    secs, (x, r, traj) = _time_warm(go)
+    rec = traj.records[-1]
+    results.append(dict(
+        config="lasso-proxcocoa+", n=n, d=d, k=k, h=h,
+        lam=round(lam, 5), gap_target=f"1e-3 relative", rounds=rec.round,
+        gap=float(rec.gap), wallclock_s=round(secs, 3),
+    ))
+
+
 def write_results(results, out_dir, partial=False):
     """Full runs own results.jsonl / RESULTS.md (the artifacts BASELINE.md
     cites); --quick / --only runs write to *.partial.* so they can never
@@ -251,7 +296,7 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="~10x smaller synthetic sizes (smoke test)")
     ap.add_argument("--only", default="",
-                    help="comma-separated subset: demo,epsilon,rcv1")
+                    help="comma-separated subset: demo,epsilon,rcv1,lasso")
     args = ap.parse_args()
     only = set(args.only.split(",")) if args.only else None
 
@@ -267,6 +312,9 @@ def main():
         bench_rcv1(results, args.quick)
         for r in results[-3:]:
             print(json.dumps(r))
+    if only is None or "lasso" in only:
+        bench_lasso(results, args.quick)
+        print(json.dumps(results[-1]))
     write_results(results, os.path.dirname(os.path.abspath(__file__)),
                   partial=args.quick or only is not None)
     return 0
