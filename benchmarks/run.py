@@ -238,10 +238,11 @@ def bench_lasso(results, quick):
         rng.standard_normal(64).astype(np.float32) * 3
     bvec = A @ x_true + 0.01 * rng.standard_normal(n).astype(np.float32)
     indptr = np.arange(0, (n + 1) * d, d, dtype=np.int64)
+    # values stay f32: shard_columns casts to the compute dtype anyway, and
+    # an f64 copy of the dense design would be a ~2 GB host transient
     data = LibsvmData(labels=bvec.astype(np.float64), indptr=indptr,
                       indices=np.tile(np.arange(d, dtype=np.int32), n),
-                      values=A.reshape(-1).astype(np.float64),
-                      num_features=d)
+                      values=A.reshape(-1), num_features=d)
     ds, b = shard_columns(data, k, dtype=jnp.float32)
     lam = 0.3 * float(np.max(np.abs(A.T @ bvec)))
     p0 = 0.5 * float(bvec @ bvec)

@@ -83,12 +83,13 @@ def check_dtype(dtype) -> None:
 
 
 def vmem_estimate(n_shard: int, d: int, itemsize: int, unroll: int) -> int:
-    """Rough VMEM working set of the kernel: the 4 lane-blocked per-shard
-    input vectors + α output (double-buffered across the k advance) + the α
-    scratch (11 n_pad-vectors total), the Δw scratch/output plus temporaries
-    (~4 d-vectors), and ``unroll`` double-buffered folded row blocks."""
+    """Rough VMEM working set of the kernel: the lane-concatenated stacked
+    state (4·n_pad input, double-buffered across the k advance, + 4·n_pad
+    scratch) + the α output (double-buffered) — 14 n_pad-vectors total —
+    the Δw scratch/output plus temporaries (~4 d-vectors), and ``unroll``
+    double-buffered folded row blocks."""
     n_pad = -(-n_shard // LANES) * LANES
-    return itemsize * (11 * n_pad + (2 * unroll + 4) * d)
+    return itemsize * (14 * n_pad + (2 * unroll + 4) * d)
 
 
 def pick_unroll(n_shard: int, d: int, itemsize: int, h: int) -> int:
@@ -97,6 +98,33 @@ def pick_unroll(n_shard: int, d: int, itemsize: int, h: int) -> int:
     path)."""
     for s in UNROLL_CANDIDATES:
         if s <= max(1, h) and vmem_estimate(n_shard, d, itemsize, s) <= VMEM_BUDGET:
+            return s
+    return 0
+
+
+INTERLEAVE_BUDGET = 14 << 20  # measured headroom: flush-only outputs and the
+                              # constant-block stacked input are not all
+                              # double-buffered, so this can run closer to
+                              # the 16 MB physical VMEM than VMEM_BUDGET
+
+
+def interleave_vmem_estimate(k: int, n_shard: int, d: int, itemsize: int,
+                             unroll: int) -> int:
+    """Working set of the shard-interleaved kernel: ALL K shards' stacked
+    state resident at once (4·n_pad input + 4·n_pad scratch each), the Δw
+    accumulators/outputs, and K·unroll double-buffered row blocks."""
+    n_pad = -(-n_shard // LANES) * LANES
+    return itemsize * (8 * k * n_pad + 3 * k * d + 2 * k * unroll * d)
+
+
+def pick_interleave(k: int, n_shard: int, d: int, itemsize: int, h: int) -> int:
+    """Step-group size for the interleaved kernel (0 = does not fit or
+    nothing to interleave; use the shard-major kernel)."""
+    if k <= 1:
+        return 0
+    for s in (2, 1):
+        if s <= max(1, h) and interleave_vmem_estimate(
+                k, n_shard, d, itemsize, s) <= INTERLEAVE_BUDGET:
             return s
     return 0
 
@@ -116,9 +144,46 @@ def fold_rows(X: jax.Array) -> jax.Array:
     return X.reshape(k, n_shard, SUBLANES, d // SUBLANES)
 
 
+STACK = 4  # lane-concatenated per-shard rows: [margins0, labels, sqn, alpha]
+
+
+def _step_body(srow, sub_lane, live, x, dw_k, *, frozen, sig_eff,
+               qii_factor, lam_n, coef_div, loss, smoothing):
+    """One coordinate step given the (1, 4·LANES) lane-concatenated state
+    row (margins0 in lanes [0,128), labels [128,256), ‖x‖² [256,384),
+    α [384,512)).  Returns (new row, Δw contribution).
+
+    The concatenated layout is the kernel's key scalar-unit optimization:
+    all four per-step values arrive from ONE dynamic slice, and the α
+    write goes back through the same row — 2 dynamically-addressed VMEM
+    accesses per step instead of 6.  Address generation on the scalar core
+    is the per-step bottleneck, not the O(d) vector work (measured: the
+    frozen mode, which skips the Δw dot entirely, costs the same)."""
+    lane4 = jax.lax.broadcasted_iota(jnp.int32, (1, STACK * LANES), 1)
+    m0 = jnp.sum(jnp.where(lane4 == sub_lane, srow, 0.0))
+    y = jnp.sum(jnp.where(lane4 == sub_lane + LANES, srow, 0.0))
+    sq = jnp.sum(jnp.where(lane4 == sub_lane + 2 * LANES, srow, 0.0))
+    a = jnp.sum(jnp.where(lane4 == sub_lane + 3 * LANES, srow, 0.0))
+
+    if frozen:
+        margin = m0
+    else:
+        margin = m0 + sig_eff * jnp.sum(x * dw_k)
+    # the dual coordinate update is pure scalar jnp — shared with the
+    # fori_loop kernels via ops/losses.py (hinge = CoCoA.scala:166-178)
+    new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
+                              smoothing=smoothing)
+    coef = y * (new_a - a) / coef_div
+    wmask = lane4 == sub_lane + 3 * LANES
+    if live is not None:   # tail group past H (only when unroll ∤ H): inert
+        coef = jnp.where(live, coef, 0.0)
+        wmask = wmask & live
+    return jnp.where(wmask, new_a, srow), coef * x
+
+
 def _kernel(
     idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
-    *refs,           # S row blocks, 4 shard vecs, 2 outs, 2 scratch (below)
+    *refs,           # S row blocks, stacked vecs, 2 outs, 2 scratch (below)
     lam_n: float,
     coef_div: float,
     sig_eff: float,
@@ -132,76 +197,114 @@ def _kernel(
 ):
     # refs layout:
     #   x_refs[j]      (1, 1, 8, d8) VMEM: folded row of sample j
-    #   margins0_ref   (1, n_blocks, LANES) VMEM: shard k's lane-blocked X·w₀
-    #   labels_ref     (1, n_blocks, LANES) VMEM
-    #   sqn_ref        (1, n_blocks, LANES) VMEM
-    #   alpha_in_ref   (1, n_blocks, LANES) VMEM
+    #   stacked_in     (1, n_blocks, 4·LANES) VMEM: shard k's lane-blocked
+    #                  [margins0 | labels | sq_norms | alpha] concatenation
     #   dw_ref         out (1, 8, d8) VMEM: shard k's Δw (flushed on k advance)
     #   alpha_ref      out (1, n_blocks, LANES) VMEM (flushed on k advance)
     #   dw_acc         scratch (8, d8) VMEM: this shard's Δw accumulator
-    #   alpha_sc       scratch (n_blocks, LANES) VMEM: the advancing α
+    #   stacked_sc     scratch (n_blocks, 4·LANES): the advancing state
     x_refs = refs[:unroll]
-    (margins0_ref, labels_ref, sqn_ref, alpha_in_ref,
-     dw_ref, alpha_ref, dw_acc, alpha_sc) = refs[unroll:]
+    stacked_in, dw_ref, alpha_ref, dw_acc, stacked_sc = refs[unroll:]
     k_ = pl.program_id(0)
     i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _init_shard():
         dw_acc[...] = jnp.zeros_like(dw_acc)
-        alpha_sc[...] = alpha_in_ref[0]
-
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        stacked_sc[...] = stacked_in[0]
 
     # S sequential coordinate steps per grid iteration, each against its own
-    # prefetched row block; step j reads the dw_acc/alpha_sc written by j-1
+    # prefetched row block; step j reads the dw_acc/stacked_sc written by j-1
+    exact = h % unroll == 0
     for j in range(unroll):
         step = i * unroll + j
         # groups past H clamp their index (the row spec's index map does the
-        # same clamp, so the DMA'd block matches) and zero their update
-        idx = idxs_ref[k_, jnp.minimum(step, h - 1)]
-        live = step < h
+        # same clamp, so the DMA'd block matches) and zero their update;
+        # when unroll | H there is no tail and the masking drops out
+        idx = idxs_ref[k_, step if exact else jnp.minimum(step, h - 1)]
+        live = None if exact else step < h
         blk = idx // LANES
-        sub_lane = idx - blk * LANES
-        sel = lane == sub_lane
-
-        def pick(ref, blk=blk, sel=sel):
-            """Scalar ref[idx]: dynamic sublane slice + 128-wide mask reduce."""
-            return jnp.sum(jnp.where(sel, ref[0, pl.ds(blk, 1), :], 0.0))
-
-        y = pick(labels_ref)
-        sq = pick(sqn_ref)
-        m0 = pick(margins0_ref)
-        a = jnp.sum(jnp.where(sel, alpha_sc[pl.ds(blk, 1), :], 0.0))
-
-        x = x_refs[j][0, 0]  # (8, d8): the folded sampled row
-
-        if frozen:
-            margin = m0
-        else:
-            xdw = jnp.sum(x * dw_acc[...])
-            margin = m0 + sig_eff * xdw
-        # the dual coordinate update is pure scalar jnp — shared with the
-        # fori_loop kernels via ops/losses.py (hinge = CoCoA.scala:166-178)
-        new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
-                                  smoothing=smoothing)
-
-        coef = jnp.where(live, y * (new_a - a) / coef_div, 0.0)
-        dw_acc[...] = dw_acc[...] + coef * x
-        alpha_sc[pl.ds(blk, 1), :] = jnp.where(
-            sel & live, new_a, alpha_sc[pl.ds(blk, 1), :]
+        srow = stacked_sc[pl.ds(blk, 1)]      # (1, 4·LANES): one dyn read
+        x = x_refs[j][0, 0]                   # (8, d8): the folded row
+        new_row, dws = _step_body(
+            srow, idx - blk * LANES, live, x, dw_acc[...], frozen=frozen,
+            sig_eff=sig_eff, qii_factor=qii_factor, lam_n=lam_n,
+            coef_div=coef_div, loss=loss, smoothing=smoothing,
         )
+        dw_acc[...] = dw_acc[...] + dws
+        stacked_sc[pl.ds(blk, 1)] = new_row   # one dyn write
 
     @pl.when(i == n_groups - 1)
     def _flush_shard():
         dw_ref[0] = dw_acc[...]
-        alpha_ref[0] = alpha_sc[...]
+        alpha_ref[0] = stacked_sc[:, 3 * LANES:]
+
+
+def _kernel_interleaved(
+    idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
+    *refs,           # K*S row blocks, stacked_in, 2 outs, 2K scratch
+    lam_n: float,
+    coef_div: float,
+    sig_eff: float,
+    qii_factor: float,
+    frozen: bool,
+    h: int,
+    loss: str,
+    smoothing: float,
+    unroll: int,
+    n_groups: int,
+    k: int,
+):
+    """Shard-interleaved variant: 1-D grid over step groups; each iteration
+    advances EVERY shard's chain by S steps.  The K chains are independent
+    and — crucially — keep their state in SEPARATE scratch refs, so Mosaic
+    does not serialize them on ref aliasing and their per-step dependency
+    chains overlap (measured ~1.6x over the shard-major kernel at epsilon
+    scale, where the chain latency, not bandwidth, is the bound).  Needs
+    all K shards' stacked state VMEM-resident (interleave_vmem_estimate)."""
+    x_refs = refs[:k * unroll]           # x_refs[j*k + kk]
+    stacked_in = refs[k * unroll]
+    dw_ref, alpha_ref = refs[k * unroll + 1:k * unroll + 3]
+    dw_accs = refs[k * unroll + 3:k * unroll + 3 + k]
+    st_scs = refs[k * unroll + 3 + k:]
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        for kk in range(k):
+            dw_accs[kk][...] = jnp.zeros_like(dw_accs[kk])
+            st_scs[kk][...] = stacked_in[kk]
+
+    exact = h % unroll == 0
+    for j in range(unroll):
+        step = i * unroll + j
+        live = None if exact else step < h
+        step_c = step if exact else jnp.minimum(step, h - 1)
+        for kk in range(k):
+            idx = idxs_ref[kk, step_c]
+            blk = idx // LANES
+            srow = st_scs[kk][pl.ds(blk, 1)]
+            x = x_refs[j * k + kk][0, 0]
+            new_row, dws = _step_body(
+                srow, idx - blk * LANES, live, x, dw_accs[kk][...],
+                frozen=frozen, sig_eff=sig_eff, qii_factor=qii_factor,
+                lam_n=lam_n, coef_div=coef_div, loss=loss,
+                smoothing=smoothing,
+            )
+            dw_accs[kk][...] = dw_accs[kk][...] + dws
+            st_scs[kk][pl.ds(blk, 1)] = new_row
+
+    @pl.when(i == n_groups - 1)
+    def _flush():
+        for kk in range(k):
+            dw_ref[kk] = dw_accs[kk][...]
+            alpha_ref[kk] = st_scs[kk][:, 3 * LANES:]
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("lam", "n", "mode", "sigma", "interpret", "loss",
-                     "smoothing", "unroll"),
+                     "smoothing", "unroll", "interleave"),
 )
 def pallas_sdca_round(
     w_margins0: jax.Array,   # (K, n_shard) precomputed X·w₀ per shard
@@ -218,6 +321,7 @@ def pallas_sdca_round(
     loss: str = "hinge",
     smoothing: float = 1.0,
     unroll: int = 0,
+    interleave=None,
 ):
     """One SDCA round for K shards on this chip.  Returns (dw, alpha_inner):
     dw (K, d) unreduced per-shard updates; alpha_inner (K, n_shard) the
@@ -226,6 +330,11 @@ def pallas_sdca_round(
     ``unroll`` = coordinate steps per grid iteration (0 = auto: the largest
     of 16/8/4/2/1 whose row blocks fit the VMEM budget).  Any value yields
     the same math — it only changes DMA batching.
+
+    ``interleave`` (None = auto: K > 1 and all shards' state fits VMEM)
+    advances the K independent chains in lockstep with separate scratch
+    refs, overlapping their per-step dependency chains — same math, ~1.6x
+    at epsilon scale.
 
     Inside ``shard_map`` this must run under ``check_vma=False`` (the
     chunked driver does; pallas_call's internal slices confuse the VMA
@@ -250,21 +359,55 @@ def pallas_sdca_round(
     h = idxs.shape[1]
     dtype = X.dtype
     check_dtype(dtype)
+    itemsize = jnp.dtype(dtype).itemsize
+    if interleave is None:
+        # auto: the fit check must use the unroll that will actually run
+        # (an explicit large unroll can blow the all-shards VMEM budget)
+        fit = pick_interleave(k, n_shard, d, itemsize, h)
+        interleave = fit > 0 and (
+            not unroll
+            or interleave_vmem_estimate(k, n_shard, d, itemsize, unroll)
+            <= INTERLEAVE_BUDGET
+        )
+        if interleave and not unroll:
+            unroll = fit
     if not unroll:
-        unroll = pick_unroll(n_shard, d, jnp.dtype(dtype).itemsize, h) or 1
+        unroll = pick_unroll(n_shard, d, itemsize, h) or 1
     n_groups = -(-h // unroll)
     sig_eff, qii_factor = mode_factors(mode, sigma)
 
-    # lane-block the per-shard vectors: (K, n_shard) -> (K, n_blocks, 128).
-    # Sampled indices never exceed the shard's true row count, so zero
-    # padding is inert.
+    # lane-block the per-shard vectors and lane-concatenate them into the
+    # (K, n_blocks, 4·128) stacked state the kernel reads with ONE dynamic
+    # slice per step (see _step_body).  Sampled indices never exceed the
+    # shard's true row count, so zero padding is inert.
     n_pad = -(-n_shard // LANES) * LANES
     pad = [(0, 0), (0, n_pad - n_shard)]
     blocked = lambda v: jnp.pad(v, pad).reshape(k, n_pad // LANES, LANES)  # noqa: E731
     n_blocks = n_pad // LANES
+    stacked = jnp.concatenate(
+        [blocked(w_margins0), blocked(labels), blocked(sq_norms),
+         blocked(alpha)], axis=-1,
+    )  # (K, n_blocks, STACK*LANES)
 
-    kernel = functools.partial(
-        _kernel,
+    def row_spec(j, kk=None):
+        # sample j of group i: the folded row at [shard, idx, :, :].  Groups
+        # past H (only when unroll does not divide H) clamp to the last
+        # sample — the kernels compute the same clamped index, so the DMA'd
+        # block always matches.  ``kk`` fixes the shard (interleaved 1-D
+        # grid); kk=None reads it from the grid (shard-major 2-D grid).
+        exact = h % unroll == 0
+
+        def step_of(i_):
+            step = i_ * unroll + j if unroll > 1 else i_
+            return step if exact else jnp.minimum(step, h - 1)
+
+        if kk is None:
+            index_map = lambda k_, i_, idxs_: (k_, idxs_[k_, step_of(i_)], 0, 0)
+        else:
+            index_map = lambda i_, idxs_: (kk, idxs_[kk, step_of(i_)], 0, 0)
+        return pl.BlockSpec((1, 1, SUBLANES, d8), index_map)
+
+    common = dict(
         lam_n=float(lam * n),
         coef_div=float(coef_divisor(mode, lam * n)),
         sig_eff=float(sig_eff),
@@ -277,37 +420,56 @@ def pallas_sdca_round(
         n_groups=n_groups,
     )
 
-    def row_spec(j):
-        # sample j of group i: the folded row at [k, idx, :, :]; groups past
-        # H clamp to the last sample (matching the kernel)
-        def index_map(k_, i_, idxs_):
-            step = jnp.minimum(i_ * unroll + j, h - 1)
-            return (k_, idxs_[k_, step], 0, 0)
+    if interleave:
+        kernel = functools.partial(_kernel_interleaved, k=k, **common)
 
-        return pl.BlockSpec((1, 1, SUBLANES, d8), index_map)
 
-    shard_vec = pl.BlockSpec(
-        (1, n_blocks, LANES), lambda k_, i_, idxs_: (k_, 0, 0)
-    )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(k, n_groups),
-        in_specs=[
-            *[row_spec(j) for j in range(unroll)],
-            shard_vec,  # margins0
-            shard_vec,  # labels
-            shard_vec,  # sq_norms
-            shard_vec,  # alpha_in
-        ],
-        out_specs=[
-            pl.BlockSpec((1, SUBLANES, d8), lambda k_, i_, idxs_: (k_, 0, 0)),
-            shard_vec,
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((SUBLANES, d8), dtype),
-            pltpu.VMEM((n_blocks, LANES), dtype),
-        ],
-    )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_groups,),
+            in_specs=[
+                *[row_spec(j, kk)
+                  for j in range(unroll) for kk in range(k)],
+                pl.BlockSpec((k, n_blocks, STACK * LANES),
+                             lambda i_, idxs_: (0, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((k, SUBLANES, d8), lambda i_, idxs_: (0, 0, 0)),
+                pl.BlockSpec((k, n_blocks, LANES),
+                             lambda i_, idxs_: (0, 0, 0)),
+            ],
+            scratch_shapes=(
+                [pltpu.VMEM((SUBLANES, d8), dtype)] * k
+                + [pltpu.VMEM((n_blocks, STACK * LANES), dtype)] * k
+            ),
+        )
+        n_row_ops = k * unroll
+        semantics = ("arbitrary",)
+    else:
+        kernel = functools.partial(_kernel, **common)
+
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(k, n_groups),
+            in_specs=[
+                *[row_spec(j) for j in range(unroll)],
+                pl.BlockSpec((1, n_blocks, STACK * LANES),
+                             lambda k_, i_, idxs_: (k_, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, SUBLANES, d8),
+                             lambda k_, i_, idxs_: (k_, 0, 0)),
+                pl.BlockSpec((1, n_blocks, LANES),
+                             lambda k_, i_, idxs_: (k_, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((SUBLANES, d8), dtype),
+                pltpu.VMEM((n_blocks, STACK * LANES), dtype),
+            ],
+        )
+        n_row_ops = unroll
+        semantics = ("arbitrary", "arbitrary")
 
     dw, alpha_blocked = pl.pallas_call(
         kernel,
@@ -317,10 +479,9 @@ def pallas_sdca_round(
             jax.ShapeDtypeStruct((k, n_blocks, LANES), dtype),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary"),
+            dimension_semantics=semantics,
         ),
         interpret=interpret,
-    )(idxs, *([X_folded] * unroll), blocked(w_margins0), blocked(labels),
-      blocked(sq_norms), blocked(alpha))
+    )(idxs, *([X_folded] * n_row_ops), stacked)
     alpha_inner = alpha_blocked.reshape(k, n_pad)[:, :n_shard]
     return dw.reshape(k, d)[:, :d_orig], alpha_inner

@@ -130,6 +130,38 @@ _DEVICE_RUNS: dict = {}
 # dispatch; runs needing more split into super-blocks (tests shrink this)
 MAX_IDX_TABLE_BYTES = 256 << 20
 
+# index-table size (ints) below which host-side sampling is cheap enough
+# (~tens of ms) to do eagerly in one block — the geometric early-stop
+# schedule only pays off above this
+SMALL_TABLE_INTS = 4_000_000
+
+
+class _Prefetch:
+    """Run fn(*args) on a daemon thread; .result() joins and returns (or
+    re-raises).  Used to overlap index sampling with device execution —
+    daemon so an abandoned speculative block can never delay process
+    exit."""
+
+    def __init__(self, fn, *args):
+        import threading
+
+        self._out = self._err = None
+        self._t = threading.Thread(target=self._run, args=(fn, args),
+                                   daemon=True)
+        self._t.start()
+
+    def _run(self, fn, args):
+        try:
+            self._out = fn(*args)
+        except BaseException as e:  # re-raised on the consumer side
+            self._err = e
+
+    def result(self):
+        self._t.join()
+        if self._err is not None:
+            raise self._err
+        return self._out
+
 
 def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                       mesh=None):
@@ -315,27 +347,63 @@ def drive_device_full(
         # bound the resident index table: one (n_chunks, C, K, H) int32 array
         # per dispatch.  With localIterFrac=1, H = n/K, so a whole-run table
         # is num_rounds × n ints — a memory cliff the chunked driver doesn't
-        # have.  Split into equal super-blocks of at most ~256 MB of indices;
+        # have.  Split into super-blocks of at most ~256 MB of indices;
         # the early-stop test between blocks costs one host sync per block.
         k = int(np.atleast_1d(sampler.counts).shape[0])
         chunk_ints = c * k * sampler.h
         max_block = max(1, MAX_IDX_TABLE_BYTES // (4 * chunk_ints))
-        n_blocks = -(-n_full // max_block)
-        per_block = -(-n_full // n_blocks)  # equal sizes → one executable
+        if gap_target is None or n_full * chunk_ints <= SMALL_TABLE_INTS:
+            # no early stop possible (or the whole table is cheap anyway):
+            # equal blocks → one executable, one host sync per ~256 MB
+            n_blocks = -(-n_full // max_block)
+            per_block = -(-n_full // n_blocks)
+            g = per_block
+        else:
+            # a gap-targeted run may stop at a small fraction of num_rounds,
+            # and host-side index sampling for rounds never executed is pure
+            # waste (the whole-run table can cost seconds at epsilon scale).
+            # Grow blocks geometrically in powers of two from a sampling-
+            # cost-sized start — bounded distinct shapes, so the handful of
+            # while-loop executables is reused across runs, and each block
+            # costs one extra host sync (the early-stop check).
+            per_block = None
+            g = max(1, SMALL_TABLE_INTS // chunk_ints)
+        sizes = []
+        remaining = n_full
+        while remaining > 0:
+            b = min(per_block or g, max_block, remaining)
+            g = min(g * 2, max_block)
+            sizes.append(b)
+            remaining -= b
+
         done = t - 1
-        while done < t - 1 + n_full * c and not hit_target():
-            b = min(per_block, (t - 1 + n_full * c - done) // c)
-            flat = sampler.chunk_indices(done + 1, b * c)
+        # one-ahead sampling: block i+1's index tables are generated on a
+        # daemon host thread while the device executes block i, hiding the
+        # numpy LCG cost behind device time (at epsilon scale both are
+        # ~ms/round).  On early stop the in-flight speculative block is
+        # abandoned — bounded waste, overlapped with the final device block
+        # either way, and the daemon thread cannot delay interpreter exit.
+        start = done + 1
+        fut = _Prefetch(sampler.chunk_indices, start, sizes[0] * c)
+        for bi, b in enumerate(sizes):
+            flat = fut.result()
+            if bi + 1 < len(sizes):
+                fut = _Prefetch(sampler.chunk_indices, start + b * c,
+                                sizes[bi + 1] * c)
             idxs_all = jax.tree.map(
                 lambda a: a.reshape(b, c, *a.shape[1:]), flat
             )
             state, dev_traj = drive_on_device(
                 name, state, chunk_kernel, eval_kernel, idxs_all,
-                shard_arrays, test_arrays, quiet=quiet, gap_target=gap_target,
-                start_round=done + 1, cache_key=cache_key, mesh=mesh,
+                shard_arrays, test_arrays, quiet=quiet,
+                gap_target=gap_target, start_round=start,
+                cache_key=cache_key, mesh=mesh,
             )
             traj.records.extend(dev_traj.records)
-            done += b * c
+            done = start - 1 + b * c
+            start += b * c
+            if hit_target():
+                break
         t = done + 1
 
     rem = params.num_rounds - (t - 1)
