@@ -369,8 +369,10 @@ def pallas_sdca_round(
             or interleave_vmem_estimate(k, n_shard, d, itemsize, unroll)
             <= INTERLEAVE_BUDGET
         )
-        if interleave and not unroll:
-            unroll = fit
+    if interleave and not unroll:
+        # the interleaved budget governs the group size (pick_unroll's
+        # single-shard budget would overshoot the all-shards working set)
+        unroll = pick_interleave(k, n_shard, d, itemsize, h) or 1
     if not unroll:
         unroll = pick_unroll(n_shard, d, itemsize, h) or 1
     n_groups = -(-h // unroll)

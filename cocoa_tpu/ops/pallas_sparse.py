@@ -6,35 +6,46 @@ gather into ``Δw``/``w`` and the scatter-add back — measured ~44 µs per
 coordinate step at rcv1 scale, plus a ~13 ms/round batched gather to
 precompute the round's margins.  This kernel removes both:
 
-- ``w`` and the Δw accumulator live **lane-blocked** in VMEM as
-  (ceil(d/128), 128) tiles (d=47K ⇒ ~185 KB each), so a nonzero's
-  coordinate read is a dynamic *sublane* slice (legal and cheap) of one
-  (1, 128) row + a 128-wide mask pick, and the scatter is a masked (1, 128)
-  row update — per nonzero O(128) VPU work regardless of d.
+- ``w`` and the Δw accumulator live **lane-blocked AND lane-concatenated**
+  in VMEM as one (ceil(d/128), 2·128) array per shard (w in lanes [0,128),
+  Δw in [128,256)), so a nonzero's margin contribution — which needs BOTH
+  w[f] and Δw[f] — is ONE dynamic sublane slice + two 256-wide mask picks,
+  and the scatter is a masked row update through the same slice.  Per
+  nonzero: 2 dynamically-addressed VMEM accesses.  (Scalar-core address
+  generation is the per-step bottleneck — same finding as the dense
+  kernel, see pallas_sdca._step_body.)
 - margins are computed **in-kernel** from the VMEM-resident ``w``
   (``margin = x·w + sig_eff·(x·Δw)``, the same decomposition as
   ops/local_sdca.py ``mode_factors`` with margins0 evaluated on the fly),
   so the per-round whole-shard margins gather disappears.
+- the per-shard scalars (y, ‖x‖², α) are lane-concatenated the same way —
+  one (n/128, 3·128) array per shard, one dynamic read + one write per
+  step.
+
+**Shard interleaving.**  The grid is 1-D over steps; each iteration
+advances EVERY shard's chain by one step, with SEPARATE scratch refs per
+shard (shared refs make Mosaic serialize on aliasing) — the K independent
+per-nonzero dependency chains overlap.  k=1 (the shard_map per-device
+case) degenerates to the plain sequential kernel.
 
 Addressing constraint: Mosaic has no vector→scalar extraction, so every
-dynamic address must come from SMEM.  The sampled rows' **feature indices**
-are therefore gathered host^W device-side outside the kernel into a
-(K, H, max_nnz) int32 table and scalar-prefetched (SMEM); the row
+dynamic address must come from SMEM.  The sampled rows' **feature
+indices** are gathered device-side outside the kernel into a
+(K, H_seg, max_nnz) int32 table and scalar-prefetched (SMEM); the row
 **values** stay in VMEM — the value of nonzero j is picked vectorially
 with a static lane-j mask (j is a Python unroll index), never needed as a
 scalar address.
 
-Grid is (K, H): shard-major, steps inner (sequential, the dependency
-order).  Padded nonzero slots carry index 0 / value 0 and contribute
-exactly 0 to every pick and scatter — no masking needed (same inertness
-trick as the XLA path, ops/rows.py:10-11).
+Padded nonzero slots carry index 0 / value 0 and contribute exactly 0 to
+every pick and scatter — no masking needed (same inertness trick as the
+XLA path, ops/rows.py:10-11).
 
 Size guards: the SMEM index table is K·H_seg·max_nnz ints and must stay
 under ``SMEM_IDX_BUDGET`` (512 KB — the 712 KB full-round rcv1 table
 fails Mosaic compilation, so rounds split into SMEM-sized segments with
-the lane-blocked Δw/α carried between them); ``sparse_kernel_fits``
-checks the VMEM working set (lane-blocked d-vectors + per-shard
-vectors).  Oversized configs keep the XLA fori_loop path.
+the concatenated state carried between them); ``sparse_kernel_fits``
+checks the VMEM working set.  Oversized configs keep the XLA fori_loop
+path.
 """
 
 from __future__ import annotations
@@ -55,15 +66,17 @@ SMEM_IDX_BUDGET = 512 << 10
 VMEM_BUDGET = 12 << 20
 
 
-def sparse_vmem_estimate(n_shard: int, d: int, max_nnz: int, itemsize: int) -> int:
-    """Lane-blocked d-vectors — w (x1), Δw carried input (double-buffered,
-    x2), Δw output (double-buffered, x2), Δw scratch (x1), plus slack for
-    temporaries (x1) — the per-shard vectors (4 inputs + α output
-    double-buffered + α scratch), and the double-buffered (8, max_nnz)
-    value block."""
+def sparse_vmem_estimate(n_shard: int, d: int, max_nnz: int, itemsize: int,
+                         k: int = 1) -> int:
+    """All K shards resident (the interleaved grid): per shard the
+    (n_dblk, 2·128) w|Δw array ×3 (input, scratch, output with
+    double-buffer slack) + the (n_blocks, 3·128) scalar stack ×3, plus the
+    double-buffered (8, max_nnz) value blocks."""
     n_pad = -(-n_shard // LANES) * LANES
     d_pad = -(-d // LANES) * LANES
-    return itemsize * (11 * n_pad + 7 * d_pad + 2 * ROW_BLOCK * max_nnz)
+    return itemsize * k * (
+        6 * d_pad + 9 * n_pad + 2 * ROW_BLOCK * max_nnz
+    )
 
 
 def sparse_kernel_fits(k: int, n_shard: int, d: int, max_nnz: int, h: int,
@@ -73,7 +86,8 @@ def sparse_kernel_fits(k: int, n_shard: int, d: int, max_nnz: int, h: int,
     del h
     return (
         segment_len(k, max_nnz) >= 1
-        and sparse_vmem_estimate(n_shard, d, max_nnz, itemsize) <= VMEM_BUDGET
+        and sparse_vmem_estimate(n_shard, d, max_nnz, itemsize, k)
+        <= VMEM_BUDGET
     )
 
 
@@ -84,19 +98,9 @@ def segment_len(k: int, max_nnz: int) -> int:
 
 
 def _kernel(
-    idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
-    gidx_ref,        # scalar-prefetch: (K, H, W) int32 feature indices
-    val_ref,         # (1, ROW_BLOCK, W) VMEM: aligned block holding the row
-    w_ref,           # (1, n_dblk, LANES) VMEM: lane-blocked w (replicated)
-    labels_ref,      # (1, n_blocks, LANES) VMEM
-    sqn_ref,         # (1, n_blocks, LANES) VMEM
-    alpha_in_ref,    # (1, n_blocks, LANES) VMEM
-    dw_in_ref,       # (1, n_dblk, LANES) VMEM: Δw carried from prior segment
-    dw_ref,          # out (1, n_dblk, LANES): shard k's lane-blocked Δw
-    alpha_ref,       # out (1, n_blocks, LANES)
-    dw_acc,          # scratch (n_dblk, LANES)
-    alpha_sc,        # scratch (n_blocks, LANES)
-    *,
+    idxs_ref,        # scalar-prefetch: (K, H_seg) int32 sampled rows
+    gidx_ref,        # scalar-prefetch: (K, H_seg, W) int32 feature indices
+    *refs,           # K val blocks, wd_in, st_in, 2 outs, 2K scratch
     lam_n: float,
     coef_div: float,
     sig_eff: float,
@@ -106,74 +110,88 @@ def _kernel(
     w_nnz: int,
     loss: str,
     smoothing: float,
+    k: int,
 ):
-    k_ = pl.program_id(0)
-    i = pl.program_id(1)
-    idx = idxs_ref[k_, i]
+    # refs layout (see module docstring for the concatenated layouts):
+    #   val_refs[kk]  (1, ROW_BLOCK, W) VMEM: aligned block holding the row
+    #   wd_in         (K, n_dblk, 2·LANES): [w | Δw_carried] per shard
+    #   st_in         (K, n_blocks, 3·LANES): [labels | ‖x‖² | α] per shard
+    #   wd_out, st_out — same shapes (flushed at segment end; Δw and α
+    #                    carry to the next segment through them)
+    #   wd_scs[kk], st_scs[kk] — per-shard scratch (separate refs: chains
+    #                    must not alias)
+    val_refs = refs[:k]
+    wd_in, st_in, wd_out, st_out = refs[k:k + 4]
+    wd_scs = refs[k + 4:k + 4 + k]
+    st_scs = refs[k + 4 + k:]
+    i = pl.program_id(0)
 
     @pl.when(i == 0)
-    def _init_shard():
-        dw_acc[...] = dw_in_ref[0]
-        alpha_sc[...] = alpha_in_ref[0]
+    def _init():
+        for kk in range(k):
+            wd_scs[kk][...] = wd_in[kk]
+            st_scs[kk][...] = st_in[kk]
 
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-    blk = idx // LANES
-    sub_lane = idx - blk * LANES
-    sel = lane == sub_lane
+    lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * LANES), 1)
+    lane3 = jax.lax.broadcasted_iota(jnp.int32, (1, 3 * LANES), 1)
 
-    def pick(ref):
-        return jnp.sum(jnp.where(sel, ref[0, pl.ds(blk, 1), :], 0.0))
+    for kk in range(k):
+        idx = idxs_ref[kk, i]
+        blk = idx // LANES
+        sub_lane = idx - blk * LANES
+        srow = st_scs[kk][pl.ds(blk, 1)]          # (1, 3·LANES)
+        y = jnp.sum(jnp.where(lane3 == sub_lane, srow, 0.0))
+        sq = jnp.sum(jnp.where(lane3 == sub_lane + LANES, srow, 0.0))
+        a = jnp.sum(jnp.where(lane3 == sub_lane + 2 * LANES, srow, 0.0))
 
-    y = pick(labels_ref)
-    sq = pick(sqn_ref)
-    a = jnp.sum(jnp.where(sel, alpha_sc[pl.ds(blk, 1), :], 0.0))
+        # the sampled row's values: sublane idx % 8 of the aligned block
+        sub = idx - (idx // ROW_BLOCK) * ROW_BLOCK
+        val_row = val_refs[kk][0, pl.ds(sub, 1), :]          # (1, W)
+        vlane = jax.lax.broadcasted_iota(jnp.int32, val_row.shape, 1)
 
-    # the sampled row's values: sublane idx % 8 of the aligned value block
-    sub = idx - (idx // ROW_BLOCK) * ROW_BLOCK
-    val_row = val_ref[0, pl.ds(sub, 1), :]          # (1, W)
-    vlane = jax.lax.broadcasted_iota(jnp.int32, val_row.shape, 1)
+        # margin = x·w + sig_eff·(x·Δw) in one pass over the nonzeros: ONE
+        # dynamic slice per nonzero serves both the w and Δw picks (they
+        # share the concatenated row).  Padded slots contribute exactly 0.
+        margin = jnp.asarray(0.0, val_row.dtype)
+        fblk = []
+        fl = []
+        vals = []
+        for j in range(w_nnz):
+            f = gidx_ref[kk, i, j]
+            fb = f // LANES
+            fls = f - fb * LANES
+            vj = jnp.sum(jnp.where(vlane == j, val_row, 0.0))
+            fblk.append(fb)
+            fl.append(fls)
+            vals.append(vj)
+            wrow = wd_scs[kk][pl.ds(fb, 1)]       # (1, 2·LANES)
+            coord = jnp.sum(jnp.where(lane2 == fls, wrow, 0.0))
+            if not frozen:
+                coord = coord + sig_eff * jnp.sum(
+                    jnp.where(lane2 == fls + LANES, wrow, 0.0)
+                )
+            margin = margin + vj * coord
 
-    # margin = x·w + sig_eff·(x·Δw), one pass over the row's nonzeros; the
-    # feature addresses come from SMEM, the values from lane-j masks (j is
-    # a Python index).  Padded slots (idx 0, val 0) contribute exactly 0.
-    margin = jnp.asarray(0.0, val_row.dtype)
-    fblk = []
-    fsel = []
-    vals = []
-    for j in range(w_nnz):
-        f = gidx_ref[k_, i, j]
-        fb = f // LANES
-        fs = lane == (f - fb * LANES)
-        vj = jnp.sum(jnp.where(vlane == j, val_row, 0.0))
-        fblk.append(fb)
-        fsel.append(fs)
-        vals.append(vj)
-        coord = jnp.sum(jnp.where(fs, w_ref[0, pl.ds(fb, 1), :], 0.0))
-        if not frozen:
-            coord = coord + sig_eff * jnp.sum(
-                jnp.where(fs, dw_acc[pl.ds(fb, 1), :], 0.0)
+        new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor,
+                                  lam_n, smoothing=smoothing)
+        coef = y * (new_a - a) / coef_div
+
+        # scatter-add coef·x into the Δw lanes: one masked row update per
+        # nonzero (fresh read — nonzeros may share a 128-lane block)
+        for j in range(w_nnz):
+            wrow = wd_scs[kk][pl.ds(fblk[j], 1)]
+            wd_scs[kk][pl.ds(fblk[j], 1)] = jnp.where(
+                lane2 == fl[j] + LANES, wrow + coef * vals[j], wrow
             )
-        margin = margin + vj * coord
-
-    new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
-                              smoothing=smoothing)
-    coef = y * (new_a - a) / coef_div
-
-    # scatter-add coef·x into Δw: one masked (1, 128) row update per nonzero
-    for j in range(w_nnz):
-        dw_acc[pl.ds(fblk[j], 1), :] = jnp.where(
-            fsel[j],
-            dw_acc[pl.ds(fblk[j], 1), :] + coef * vals[j],
-            dw_acc[pl.ds(fblk[j], 1), :],
+        st_scs[kk][pl.ds(blk, 1)] = jnp.where(
+            lane3 == sub_lane + 2 * LANES, new_a, srow
         )
-    alpha_sc[pl.ds(blk, 1), :] = jnp.where(
-        sel, new_a, alpha_sc[pl.ds(blk, 1), :]
-    )
 
     @pl.when(i == h - 1)
-    def _flush_shard():
-        dw_ref[0] = dw_acc[...]
-        alpha_ref[0] = alpha_sc[...]
+    def _flush():
+        for kk in range(k):
+            wd_out[kk] = wd_scs[kk][...]
+            st_out[kk] = st_scs[kk][...]
 
 
 @functools.partial(
@@ -205,9 +223,10 @@ def pallas_sparse_sdca_round(
 
     When H exceeds the SMEM index-table budget the round is split into
     segments of :func:`segment_len` steps, each one ``pallas_call``; the
-    lane-blocked (Δw, α) carry between segments (a few MB of HBM traffic —
-    the table cannot be blocked, scalar-prefetch operands live whole in
-    SMEM).  Same math regardless of segmentation.
+    concatenated (w|Δw, labels|‖x‖²|α) state carries between segments (a
+    few MB of HBM traffic — the index table cannot be blocked,
+    scalar-prefetch operands live whole in SMEM).  Same math regardless of
+    segmentation.
 
     Requires n_shard % 8 == 0 (shard_dataset pads to 16).  Inside
     ``shard_map`` run with ``check_vma=False`` (as the chunked driver does).
@@ -225,29 +244,39 @@ def pallas_sparse_sdca_round(
     sig_eff, qii_factor = mode_factors(mode, sigma)
     h_seg = max(1, segment_len(k, w_nnz))
 
-    # lane-block the per-shard vectors and the d-vectors
+    # lane-block and lane-concatenate the state (module docstring layouts)
     n_pad = -(-n_shard // LANES) * LANES
     pad = [(0, 0), (0, n_pad - n_shard)]
     blocked = lambda v: jnp.pad(v, pad).reshape(k, n_pad // LANES, LANES)  # noqa: E731
     n_blocks = n_pad // LANES
     d_pad = -(-d // LANES) * LANES
     n_dblk = d_pad // LANES
-    w_blocked = jnp.pad(w, (0, d_pad - d)).reshape(1, n_dblk, LANES)
-
-    labels_b = blocked(labels)
-    sqn_b = blocked(sq_norms)
-    alpha_b = blocked(alpha)
-    dw_b = jnp.zeros((k, n_dblk, LANES), dtype)
+    w_blocked = jnp.broadcast_to(
+        jnp.pad(w, (0, d_pad - d)).reshape(1, n_dblk, LANES),
+        (k, n_dblk, LANES),
+    )
+    wd = jnp.concatenate(
+        [w_blocked, jnp.zeros((k, n_dblk, LANES), dtype)], axis=-1
+    )
+    st = jnp.concatenate(
+        [blocked(labels), blocked(sq_norms), blocked(alpha)], axis=-1
+    )
     idxs = idxs.astype(jnp.int32)
 
-    shard_vec = pl.BlockSpec(
-        (1, n_blocks, LANES), lambda k_, i_, idxs_, gidx_: (k_, 0, 0)
+    def val_spec(kk):
+        # the sampled row's values: 8-row aligned block at idx//8*8
+        return pl.BlockSpec(
+            (1, ROW_BLOCK, w_nnz),
+            lambda i_, idxs_, gidx_, kk=kk: (
+                kk, idxs_[kk, i_] // ROW_BLOCK, 0
+            ),
+        )
+
+    full_wd = pl.BlockSpec(
+        (k, n_dblk, 2 * LANES), lambda i_, idxs_, gidx_: (0, 0, 0)
     )
-    dvec_in = pl.BlockSpec(
-        (1, n_dblk, LANES), lambda k_, i_, idxs_, gidx_: (0, 0, 0)
-    )
-    dvec_k = pl.BlockSpec(
-        (1, n_dblk, LANES), lambda k_, i_, idxs_, gidx_: (k_, 0, 0)
+    full_st = pl.BlockSpec(
+        (k, n_blocks, 3 * LANES), lambda i_, idxs_, gidx_: (0, 0, 0)
     )
 
     for lo in range(0, h, h_seg):
@@ -271,42 +300,35 @@ def pallas_sparse_sdca_round(
             w_nnz=w_nnz,
             loss=losses.validate(loss, smoothing),
             smoothing=float(smoothing),
+            k=k,
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(k, h_this),
+            grid=(h_this,),
             in_specs=[
-                # the sampled row's values: 8-row aligned block at idx//8*8
-                pl.BlockSpec(
-                    (1, ROW_BLOCK, w_nnz),
-                    lambda k_, i_, idxs_, gidx_: (
-                        k_, idxs_[k_, i_] // ROW_BLOCK, 0
-                    ),
-                ),
-                dvec_in,    # w (replicated across shards)
-                shard_vec,  # labels
-                shard_vec,  # sq_norms
-                shard_vec,  # alpha_in
-                dvec_k,     # dw_in (carried between segments)
+                *[val_spec(kk) for kk in range(k)],
+                full_wd,   # [w | Δw] (Δw carried between segments)
+                full_st,   # [labels | ‖x‖² | α]
             ],
-            out_specs=[dvec_k, shard_vec],
-            scratch_shapes=[
-                pltpu.VMEM((n_dblk, LANES), dtype),
-                pltpu.VMEM((n_blocks, LANES), dtype),
-            ],
+            out_specs=[full_wd, full_st],
+            scratch_shapes=(
+                [pltpu.VMEM((n_dblk, 2 * LANES), dtype)] * k
+                + [pltpu.VMEM((n_blocks, 3 * LANES), dtype)] * k
+            ),
         )
-        dw_b, alpha_b = pl.pallas_call(
+        wd, st = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
             out_shape=[
-                jax.ShapeDtypeStruct((k, n_dblk, LANES), dtype),
-                jax.ShapeDtypeStruct((k, n_blocks, LANES), dtype),
+                jax.ShapeDtypeStruct((k, n_dblk, 2 * LANES), dtype),
+                jax.ShapeDtypeStruct((k, n_blocks, 3 * LANES), dtype),
             ],
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("arbitrary", "arbitrary"),
+                dimension_semantics=("arbitrary",),
             ),
             interpret=interpret,
-        )(seg, gidx, sp_values, w_blocked, labels_b, sqn_b, alpha_b, dw_b)
+        )(seg, gidx, *([sp_values] * k), wd, st)
 
-    alpha_inner = alpha_b.reshape(k, n_pad)[:, :n_shard]
-    return dw_b.reshape(k, d_pad)[:, :d], alpha_inner
+    dw = wd[:, :, LANES:].reshape(k, d_pad)[:, :d]
+    alpha_inner = st[:, :, 2 * LANES:].reshape(k, n_pad)[:, :n_shard]
+    return dw, alpha_inner
