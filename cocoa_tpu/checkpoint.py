@@ -28,17 +28,28 @@ def save(
     alpha: Optional[jax.Array] = None,
     seed: int = 0,
 ) -> str:
-    """Write checkpoint for ``round_t``; returns the file path."""
+    """Write checkpoint for ``round_t``; returns the file path.
+
+    Crash-safe: both files are written to temp names and renamed in, the
+    ``.npz`` LAST — :func:`latest` discovers checkpoints by the ``.npz``,
+    so a process killed mid-save (the exact scenario checkpoints exist
+    for) can never leave a discoverable-but-corrupt checkpoint: either
+    the rename happened and both files are complete, or the checkpoint
+    does not exist."""
     os.makedirs(directory, exist_ok=True)
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
     arrays = {"w": np.asarray(w)}
     if alpha is not None:
         arrays["alpha"] = np.asarray(alpha)
-    np.savez(path, **arrays)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:  # explicit handle: savez must not append .npz
+        np.savez(f, **arrays)
     meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
-    with open(path + ".json", "w") as f:
+    with open(path + ".json.tmp", "w") as f:
         json.dump(meta, f)
+    os.replace(path + ".json.tmp", path + ".json")
+    os.replace(tmp, path)
     return path
 
 

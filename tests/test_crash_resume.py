@@ -1,0 +1,86 @@
+"""Failure recovery: the reference leans on Spark lineage recomputation
+(SURVEY.md §5); the rebuild's answer is round-stamped resumable
+checkpoints.  This test exercises the full story the way a preempted job
+would: a CLI training process is SIGKILLed mid-run, relaunched with
+``--resume``, and must finish with EXACTLY the summary of an uninterrupted
+run (round-indexed RNG makes the resumed trajectory bit-identical)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = "/root/reference/data/small_train.dat"
+
+# localIterFrac=1 makes CPU rounds slow enough (H=500 exact-math steps)
+# that the SIGKILL reliably lands mid-run, after the first checkpoint but
+# well before the final round — the point of the test
+BASE = [
+    sys.executable, "-m", "cocoa_tpu.cli",
+    f"--trainFile={TRAIN}", "--numFeatures=9947", "--numRounds=24",
+    "--localIterFrac=1", "--numSplits=4", "--lambda=.001",
+    "--justCoCoA=true", "--debugIter=4", "--chkptIter=4",
+]
+
+
+def _run(args, timeout=200):
+    return subprocess.run(
+        args, cwd=ROOT, env={**os.environ, "PYTHONPATH": ROOT},
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _summary(out: str):
+    """The two end-of-run summary blocks (CoCoA+ and CoCoA objective/gap)."""
+    return [ln.strip() for ln in out.splitlines()
+            if "Total Objective" in ln or "Duality Gap" in ln]
+
+
+def test_sigkill_then_resume_matches_uninterrupted(tmp_path):
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+
+    # uninterrupted reference run
+    ref = _run(BASE + [f"--chkptDir={ck}-ref"])
+    assert ref.returncode == 0, ref.stdout[-2000:] + ref.stderr[-2000:]
+    want = _summary(ref.stdout)
+    assert want, ref.stdout[-2000:]
+
+    # start the same run, kill it once the first checkpoint exists
+    proc = subprocess.Popen(
+        BASE + [f"--chkptDir={ck}"], cwd=ROOT,
+        env={**os.environ, "PYTHONPATH": ROOT},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 200
+        while time.time() < deadline:
+            if any(f.endswith(".npz") for f in os.listdir(ck)):
+                break
+            if proc.poll() is not None:
+                out = proc.stdout.read()
+                raise AssertionError(
+                    f"run finished before any checkpoint appeared:\n{out[-2000:]}"
+                )
+            time.sleep(0.2)
+        else:
+            raise AssertionError("no checkpoint appeared within the deadline")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # relaunch with --resume: must pick up a MID-RUN checkpoint (not the
+    # final one — otherwise the test proves nothing) and match exactly
+    res = _run(BASE + [f"--chkptDir={ck}", "--resume"])
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    resumed = [ln for ln in res.stdout.splitlines() if "resuming" in ln]
+    assert resumed, res.stdout[-2000:]
+    import re
+
+    m = re.search(r"from round (\d+)", resumed[0])
+    assert m and int(m.group(1)) < 24, resumed[0]
+    assert _summary(res.stdout) == want
