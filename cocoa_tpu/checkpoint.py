@@ -39,17 +39,36 @@ def save(
     os.makedirs(directory, exist_ok=True)
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
-    arrays = {"w": np.asarray(w)}
+    meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
+    # meta travels INSIDE the .npz (a unicode array — no pickling), so the
+    # archive is self-describing and a stale same-named .npz from an
+    # earlier run in a reused directory can never be paired with a fresh
+    # sidecar; the sidecar is written too, but only for human inspection
+    # and as a fallback for pre-meta checkpoints.
+    arrays = {"w": np.asarray(w), "_meta": np.array(json.dumps(meta))}
     if alpha is not None:
         arrays["alpha"] = np.asarray(alpha)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    pid = os.getpid()
+    tmp = f"{path}.tmp.{pid}"
     with open(tmp, "wb") as f:  # explicit handle: savez must not append .npz
         np.savez(f, **arrays)
-    meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
-    with open(path + ".json.tmp", "w") as f:
+    with open(f"{path}.json.tmp.{pid}", "w") as f:
         json.dump(meta, f)
-    os.replace(path + ".json.tmp", path + ".json")
+    os.replace(f"{path}.json.tmp.{pid}", path + ".json")
     os.replace(tmp, path)
+    # sweep temp litter from earlier interrupted saves of this algorithm
+    # (preempted jobs otherwise accumulate *.tmp.<pid> files forever).
+    # Current-round temps are left alone: in a multi-host run every process
+    # saves the same round concurrently (the per-round collectives keep
+    # them in lockstep), and unlinking a peer's in-flight temp would make
+    # its os.replace fail.
+    for name in os.listdir(directory):
+        if (name.startswith(f"{algorithm}-") and ".tmp." in name
+                and f"r{round_t:06d}" not in name):
+            try:
+                os.unlink(os.path.join(directory, name))
+            except OSError:
+                pass
     return path
 
 
@@ -66,8 +85,13 @@ def latest(directory: str, algorithm: str) -> Optional[str]:
 
 
 def load(path: str):
-    """Returns (meta dict, w, alpha-or-None) as host numpy arrays."""
-    with open(path + ".json") as f:
-        meta = json.load(f)
+    """Returns (meta dict, w, alpha-or-None) as host numpy arrays.  Meta
+    comes from inside the archive (self-describing — see :func:`save`);
+    the sidecar is only a fallback for pre-meta checkpoints."""
     data = np.load(path)
+    if "_meta" in data.files:
+        meta = json.loads(str(data["_meta"]))
+    else:
+        with open(path + ".json") as f:
+            meta = json.load(f)
     return meta, data["w"], (data["alpha"] if "alpha" in data.files else None)

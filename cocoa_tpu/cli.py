@@ -225,11 +225,27 @@ def main(argv=None) -> int:
     params = cfg.to_params(n, k)
     debug = cfg.to_debug()
     gap_target = float(extras["gapTarget"]) if extras["gapTarget"] else None
-    cfg.scan_chunk = int(extras["scanChunk"]) if extras["scanChunk"] else cfg.scan_chunk
     cfg.device_loop = (
         extras["deviceLoop"] is not None
         and str(extras["deviceLoop"]).lower() != "false"
     )
+    if extras["scanChunk"]:
+        try:
+            cfg.scan_chunk = int(extras["scanChunk"])
+        except ValueError:
+            print(f"error: --scanChunk must be an integer, got "
+                  f"{extras['scanChunk']!r}", file=sys.stderr)
+            return 2
+    elif not cfg.device_loop and cfg.scan_chunk <= 0:
+        # default to device-side blocks at the eval cadence: the math and
+        # the observable trajectory are identical to per-round stepping
+        # (pinned by tests), but a tunneled device pays ~10 ms of dispatch
+        # latency PER ROUND on the host-stepped path.  Capped so one
+        # chunk's (C, K, H) int32 index table stays modest even when
+        # debugIter is huge (--scanChunk=1 restores per-round dispatch).
+        cap = max(1, 32_000_000 // max(1, k * params.local_iters))
+        cfg.scan_chunk = min(cfg.debug_iter if cfg.debug_iter > 0 else 50,
+                             cap)
     if cfg.device_loop and cfg.debug_iter <= 0:
         print("error: --deviceLoop requires --debugIter > 0 (the eval "
               "cadence is the device loop's chunk axis)", file=sys.stderr)

@@ -48,30 +48,37 @@ def test_sigkill_then_resume_matches_uninterrupted(tmp_path):
     want = _summary(ref.stdout)
     assert want, ref.stdout[-2000:]
 
-    # start the same run, kill it once the first checkpoint exists
-    proc = subprocess.Popen(
-        BASE + [f"--chkptDir={ck}"], cwd=ROOT,
-        env={**os.environ, "PYTHONPATH": ROOT},
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    try:
-        deadline = time.time() + 200
-        while time.time() < deadline:
-            if any(f.endswith(".npz") for f in os.listdir(ck)):
-                break
-            if proc.poll() is not None:
-                out = proc.stdout.read()
+    # start the same run, kill it once the first checkpoint exists.
+    # stdout goes to a file, not a PIPE: an undrained pipe could block the
+    # child before it ever checkpoints if its output outgrew the OS buffer
+    log_path = tmp_path / "killed.log"
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            BASE + [f"--chkptDir={ck}"], cwd=ROOT,
+            env={**os.environ, "PYTHONPATH": ROOT},
+            stdout=log, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            deadline = time.time() + 200
+            while time.time() < deadline:
+                if any(f.endswith(".npz") for f in os.listdir(ck)):
+                    break
+                if proc.poll() is not None:
+                    out = log_path.read_text()
+                    raise AssertionError(
+                        f"run finished before any checkpoint appeared:\n"
+                        f"{out[-2000:]}"
+                    )
+                time.sleep(0.1)
+            else:
                 raise AssertionError(
-                    f"run finished before any checkpoint appeared:\n{out[-2000:]}"
+                    "no checkpoint appeared within the deadline"
                 )
-            time.sleep(0.2)
-        else:
-            raise AssertionError("no checkpoint appeared within the deadline")
-        proc.send_signal(signal.SIGKILL)
-        proc.wait(timeout=30)
-    finally:
-        if proc.poll() is None:
-            proc.kill()
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
 
     # relaunch with --resume: must pick up a MID-RUN checkpoint (not the
     # final one — otherwise the test proves nothing) and match exactly
