@@ -274,11 +274,6 @@ def main(argv=None) -> int:
             print("error: --testFile does not apply to --objective=lasso "
                   "(no classification error to report)", file=sys.stderr)
             return 2
-        if cfg.layout == "sparse":
-            print("error: --objective=lasso supports the dense column "
-                  "layout only (a padded-CSC column builder does not exist "
-                  "yet); drop --layout=sparse", file=sys.stderr)
-            return 2
         try:
             l2 = float(extras["l2"]) if extras["l2"] else 0.0
         except ValueError:
@@ -292,7 +287,12 @@ def main(argv=None) -> int:
         from cocoa_tpu.data.columns import shard_columns
         from cocoa_tpu.solvers import run_prox_cocoa
 
-        ds_c, b = shard_columns(data, k, dtype=dtype, mesh=mesh)
+        try:
+            ds_c, b = shard_columns(data, k, dtype=dtype, mesh=mesh,
+                                    layout=cfg.layout)
+        except ValueError as e:  # e.g. sparse columns + fp mesh
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         d = data.num_features
         # same H = max(1, localIterFrac·n/K) law, over coordinates
         lasso_params = dataclasses.replace(

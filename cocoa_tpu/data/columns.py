@@ -33,17 +33,29 @@ def shard_columns(
     k: int,
     dtype=jnp.float32,
     mesh: Optional[jax.sharding.Mesh] = None,
+    layout: str = "auto",
+    max_col_nnz: Optional[int] = None,
 ) -> Tuple[ShardedDataset, jax.Array]:
     """Partition A's d columns into K balanced contiguous blocks.
 
     Returns ``(ds, b)``: ``ds`` is the transposed-role ShardedDataset
-    (``ds.X[k, j]`` = column ``offs[k]+j`` of A as a dense (n_pad,)
-    vector), ``b`` the (n_pad,) regression target (``data.labels``,
-    zero-padded — padding rows of A are zero so they touch nothing).
+    (shard "row" j = column ``offs[k]+j`` of A), ``b`` the (n_pad,)
+    regression target (``data.labels``, zero-padded — padding rows of A
+    are zero so they touch nothing).
 
-    Dense layout only (a sparse padded-CSC variant would mirror the CSR
-    one); intended for lasso-scale d where columns fit per-device HBM.
+    Layouts mirror :func:`~cocoa_tpu.data.sharding.shard_dataset`:
+
+    - ``dense``  — each column a dense (n_pad,) vector.
+    - ``sparse`` — padded-CSC: per-column (row-index, value) arrays padded
+      to the widest column.  Column nnz is often far more skewed than row
+      nnz (hot features touch most examples), so the padded width can
+      approach n — ``max_col_nnz`` guards against silent blow-up.
+    - ``auto``   — sparse below 10% density (matching shard_dataset), but
+      only when the widest column keeps the padded encoding smaller than
+      dense.
     """
+    if layout not in ("auto", "dense", "sparse"):
+        raise ValueError(f"layout must be auto|dense|sparse, got {layout!r}")
     n, d = data.n, data.num_features
     np_dtype = np.dtype(dtype)
     sizes = split_sizes(d, k)
@@ -53,25 +65,80 @@ def shard_columns(
     d_shard = -(-int(sizes.max()) // 16) * 16
     n_pad = mesh_lib.pad_features(n, mesh)
 
-    # dense columns: build A^T once (n×d dense), slice per shard
-    AT = np.zeros((d, n_pad), dtype=np_dtype)
-    row_nnz = np.diff(data.indptr)
-    rows = np.repeat(np.arange(n), row_nnz)
-    AT[data.indices, rows] = data.values
+    # CSR -> CSC once (also yields per-column nnz for the layout choice)
+    row_ids = np.repeat(np.arange(n, dtype=np.int32), np.diff(data.indptr))
+    order = np.argsort(data.indices, kind="stable")
+    csc_rows = row_ids[order]
+    csc_vals = np.asarray(data.values)[order]
+    col_nnz = np.bincount(data.indices, minlength=d)
+    col_ptr = np.concatenate([[0], np.cumsum(col_nnz)])
+    widest = int(col_nnz.max(initial=1))
 
-    X = np.zeros((k, d_shard, n_pad), dtype=np_dtype)
+    if layout == "auto":
+        nnz = int(data.indptr[-1])
+        density = nnz / max(1, n * d)
+        layout = ("sparse" if density < 0.10 and widest * 2 < n_pad
+                  and (max_col_nnz is None or widest <= max_col_nnz)
+                  else "dense")   # auto's job is to pick a VIABLE layout
+        if mesh_lib.has_fp(mesh):
+            layout = "dense"
+    if layout == "sparse":
+        if mesh_lib.has_fp(mesh):
+            raise ValueError(
+                "sparse column shards cannot combine with an fp mesh"
+            )
+        if max_col_nnz is not None and widest > max_col_nnz:
+            raise ValueError(
+                f"widest column has {widest} nonzeros > max_col_nnz="
+                f"{max_col_nnz}; hot features make padded-CSC degenerate — "
+                f"use layout='dense'"
+            )
+
     labels = np.zeros((k, d_shard), dtype=np_dtype)
     mask = np.zeros((k, d_shard), dtype=np_dtype)
     sq_norms = np.zeros((k, d_shard), dtype=np_dtype)
-    # f64 accumulation without a full-matrix f64 temporary (AT can be GBs)
-    col_sq = np.einsum("ij,ij->i", AT, AT, dtype=np.float64)
+    # exact per-column f64 accumulation (a global prefix-sum difference can
+    # absorb a tiny column's squares to exactly 0, and a zero sq_norm
+    # permanently freezes that coordinate in the lasso prox rule).
+    # reduceat quirk: an empty segment yields the element AT its start
+    # index, so empty columns are zeroed explicitly.
+    sq = csc_vals.astype(np.float64) ** 2
+    if sq.size:
+        col_sq = np.add.reduceat(sq, np.minimum(col_ptr[:-1], sq.size - 1))
+        col_sq[col_nnz == 0] = 0.0
+    else:
+        col_sq = np.zeros(d)
     for s in range(k):
         lo, hi = offsets[s], offsets[s + 1]
         m = hi - lo
-        X[s, :m] = AT[lo:hi]
         labels[s, :m] = 1.0   # prox rules have no y factor
         mask[s, :m] = 1.0
         sq_norms[s, :m] = col_sq[lo:hi]
+
+    kwargs: dict = {}
+    if layout == "dense":
+        X = np.zeros((k, d_shard, n_pad), dtype=np_dtype)
+        for s in range(k):
+            lo, hi = offsets[s], offsets[s + 1]
+            a, bnd = col_ptr[lo], col_ptr[hi]
+            cols = np.repeat(np.arange(hi - lo),
+                             col_nnz[lo:hi].astype(np.int64))
+            X[s][cols, csc_rows[a:bnd]] = csc_vals[a:bnd]
+        kwargs["X"] = X
+    else:
+        sp_idx = np.zeros((k, d_shard, widest), dtype=np.int32)
+        sp_val = np.zeros((k, d_shard, widest), dtype=np_dtype)
+        for s in range(k):
+            lo, hi = offsets[s], offsets[s + 1]
+            a, bnd = col_ptr[lo], col_ptr[hi]
+            cols = np.repeat(np.arange(hi - lo),
+                             col_nnz[lo:hi].astype(np.int64))
+            slots = (np.arange(a, bnd)
+                     - np.repeat(col_ptr[lo:hi], col_nnz[lo:hi].astype(np.int64)))
+            sp_idx[s][cols, slots] = csc_rows[a:bnd]
+            sp_val[s][cols, slots] = csc_vals[a:bnd]
+        kwargs["sp_indices"] = sp_idx
+        kwargs["sp_values"] = sp_val
 
     def put(arr, fp_last=False):
         if mesh is not None:
@@ -85,13 +152,15 @@ def shard_columns(
     b = np.zeros(n_pad, dtype=np_dtype)
     b[:n] = data.labels
     ds = ShardedDataset(
-        layout="dense",
+        layout=layout,
         n=d,                      # "examples" of this transposed view
         num_features=n_pad,       # the replicated vector length
         counts=sizes.astype(np.int64),
         labels=put(labels),
         mask=put(mask),
         sq_norms=put(sq_norms),
-        X=put(X, fp_last=True),
+        X=put(kwargs["X"], fp_last=True) if "X" in kwargs else None,
+        sp_indices=put(kwargs["sp_indices"]) if "sp_indices" in kwargs else None,
+        sp_values=put(kwargs["sp_values"]) if "sp_values" in kwargs else None,
     )
     return ds, jnp.asarray(b)

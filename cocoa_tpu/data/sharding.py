@@ -176,10 +176,16 @@ def shard_dataset(
     sq_norms = np.zeros((k, n_shard), dtype=np_dtype)
 
     row_nnz = np.diff(data.indptr)
-    # per-row ||x||^2 as exclusive-cumsum differences (exact for empty rows,
-    # computed in f64 before the dtype cast)
-    csum = np.concatenate([[0.0], np.cumsum(data.values.astype(np.float64) ** 2)])
-    row_sq = csum[data.indptr[1:]] - csum[data.indptr[:-1]]
+    # per-row ||x||^2 by per-segment f64 reduceat (exact per row — a global
+    # prefix-sum difference can absorb a tiny row's squares below the
+    # running sum's ulp).  reduceat quirk: an empty segment yields the
+    # element AT its start index, so empty rows are zeroed explicitly.
+    sq = np.asarray(data.values, np.float64) ** 2
+    if sq.size:
+        row_sq = np.add.reduceat(sq, np.minimum(data.indptr[:-1], sq.size - 1))
+        row_sq[row_nnz == 0] = 0.0
+    else:
+        row_sq = np.zeros(n)
     for s in range(k):
         lo, hi = offsets[s], offsets[s + 1]
         m = hi - lo

@@ -109,6 +109,34 @@ def test_prox_fast_and_paths_match_exact(l2):
                                    err_msg=str(kw))
 
 
+def test_prox_sparse_columns_match_dense():
+    """The padded-CSC column layout must produce exactly the dense column
+    layout's trajectory, on both the fori paths and the sparse Pallas
+    kernel (interpret)."""
+    A, b, _, data = _problem(seed=7)
+    d = data.num_features
+    lam = 0.1 * np.max(np.abs(A.T @ b))
+    p = _params(d, float(lam))
+    ds_d, b_d = shard_columns(data, K, dtype=jnp.float64, layout="dense")
+    ds_s, b_s = shard_columns(data, K, dtype=jnp.float64, layout="sparse")
+    assert ds_s.layout == "sparse"
+    x0, r0, _ = run_prox_cocoa(ds_d, b_d, p, _DBG, quiet=True, math="exact")
+    for kw in (dict(math="exact"),
+               dict(math="fast", pallas=False),
+               dict(math="fast", pallas=True, scan_chunk=5)):
+        x1, r1, _ = run_prox_cocoa(ds_s, b_s, p, _DBG, quiet=True, **kw)
+        np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
+                                   atol=1e-9, err_msg=str(kw))
+        np.testing.assert_allclose(np.asarray(r1), np.asarray(r0),
+                                   atol=1e-9, err_msg=str(kw))
+
+
+def test_shard_columns_rejects_degenerate_csc():
+    _, _, _, data = _problem(seed=8)
+    with np.testing.assert_raises(ValueError):
+        shard_columns(data, K, layout="sparse", max_col_nnz=2)
+
+
 def test_prox_mesh_matches_local():
     A, b, _, data = _problem(seed=2)
     d = data.num_features
