@@ -24,7 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from cocoa_tpu.data.libsvm import LibsvmData
-from cocoa_tpu.data.sharding import ShardedDataset, split_sizes
+from cocoa_tpu.data.sharding import (
+    ShardedDataset,
+    segment_sq_norms,
+    split_sizes,
+)
 from cocoa_tpu.parallel import mesh as mesh_lib
 
 
@@ -97,17 +101,7 @@ def shard_columns(
     labels = np.zeros((k, d_shard), dtype=np_dtype)
     mask = np.zeros((k, d_shard), dtype=np_dtype)
     sq_norms = np.zeros((k, d_shard), dtype=np_dtype)
-    # exact per-column f64 accumulation (a global prefix-sum difference can
-    # absorb a tiny column's squares to exactly 0, and a zero sq_norm
-    # permanently freezes that coordinate in the lasso prox rule).
-    # reduceat quirk: an empty segment yields the element AT its start
-    # index, so empty columns are zeroed explicitly.
-    sq = csc_vals.astype(np.float64) ** 2
-    if sq.size:
-        col_sq = np.add.reduceat(sq, np.minimum(col_ptr[:-1], sq.size - 1))
-        col_sq[col_nnz == 0] = 0.0
-    else:
-        col_sq = np.zeros(d)
+    col_sq = segment_sq_norms(csc_vals, col_ptr)
     for s in range(k):
         lo, hi = offsets[s], offsets[s + 1]
         m = hi - lo

@@ -39,6 +39,28 @@ def pad_rows(n_rows: int) -> int:
     return -(-n_rows // 16) * 16
 
 
+def segment_sq_norms(values, ptr) -> np.ndarray:
+    """Exact per-segment f64 Σv² for CSR/CSC-style ``(values, ptr)``.
+
+    Per-segment accumulation (not a global prefix-sum difference, which can
+    absorb a tiny segment's squares below the running sum's ulp — a
+    vanished sq_norm freezes that coordinate in the lasso prox rule).
+    ``np.add.reduceat`` quirks handled here so callers don't copy them:
+    a trailing 0.0 sentinel makes start indices equal to nnz (trailing
+    empty segments) valid without clamping — clamping would steal the last
+    nonzero from the final non-empty segment — and empty segments, which
+    reduceat maps to the element AT their start, are zeroed explicitly."""
+    nseg = len(ptr) - 1
+    if nseg <= 0:
+        return np.zeros(0)
+    sq = np.empty(len(values) + 1)
+    np.square(np.asarray(values, np.float64), out=sq[:-1])
+    sq[-1] = 0.0
+    out = np.add.reduceat(sq, np.asarray(ptr[:-1], dtype=np.intp))
+    out[np.diff(ptr) == 0] = 0.0
+    return out
+
+
 def split_sizes(n: int, k: int) -> np.ndarray:
     """Balanced contiguous split: first n % k shards get one extra row.
 
@@ -176,16 +198,7 @@ def shard_dataset(
     sq_norms = np.zeros((k, n_shard), dtype=np_dtype)
 
     row_nnz = np.diff(data.indptr)
-    # per-row ||x||^2 by per-segment f64 reduceat (exact per row — a global
-    # prefix-sum difference can absorb a tiny row's squares below the
-    # running sum's ulp).  reduceat quirk: an empty segment yields the
-    # element AT its start index, so empty rows are zeroed explicitly.
-    sq = np.asarray(data.values, np.float64) ** 2
-    if sq.size:
-        row_sq = np.add.reduceat(sq, np.minimum(data.indptr[:-1], sq.size - 1))
-        row_sq[row_nnz == 0] = 0.0
-    else:
-        row_sq = np.zeros(n)
+    row_sq = segment_sq_norms(data.values, data.indptr)
     for s in range(k):
         lo, hi = offsets[s], offsets[s + 1]
         m = hi - lo

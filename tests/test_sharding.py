@@ -63,6 +63,27 @@ def test_padding_and_sq_norms(tiny_data):
     )
 
 
+def test_segment_sq_norms_edge_cases():
+    """Trailing empty segments must not steal the last nonzero (the naive
+    clamped-reduceat idiom did exactly that), interior empties must be 0,
+    and tiny segments must not be absorbed by a global running sum."""
+    from cocoa_tpu.data.sharding import segment_sq_norms
+
+    np.testing.assert_array_equal(
+        segment_sq_norms(np.array([1., 2., 3.]), np.array([0, 3, 3])),
+        [14., 0.])
+    np.testing.assert_array_equal(
+        segment_sq_norms(np.array([1., 2., 3.]), np.array([0, 1, 3, 3])),
+        [1., 13., 0.])
+    np.testing.assert_array_equal(
+        segment_sq_norms(np.array([1., 2.]), np.array([0, 0, 2])), [0., 5.])
+    np.testing.assert_array_equal(
+        segment_sq_norms(np.zeros(0), np.array([0, 0])), [0.])
+    # exactness: a 1e-9 value after a huge segment must not vanish
+    out = segment_sq_norms(np.array([1e5, 1e-9]), np.array([0, 1, 2]))
+    np.testing.assert_array_equal(out, [1e10, 1e-18])
+
+
 def test_auto_layout_picks_sparse_for_sparse_data(small_train):
     ds = shard_dataset(small_train, k=4, layout="auto")
     assert ds.layout == "sparse"  # density ~0.2% on small_train
