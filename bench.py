@@ -18,12 +18,32 @@ from __future__ import annotations
 
 import json
 import os
+import platform
 import sys
 import time
 
 # Pinned oracle wall-clock for this config (median of repeated runs on this
 # machine; see module docstring).  Re-measure with COCOA_BENCH_BASELINE=measure.
+# The pin is only trusted when the machine fingerprint below still matches —
+# on any other machine class the oracle is re-measured live instead of
+# silently comparing against a stale constant.
 ORACLE_BASELINE_S = 2.11
+ORACLE_FINGERPRINT = "Intel(R) Xeon(R) Processor @ 2.10GHz|x86_64|1"
+
+
+def machine_fingerprint() -> str:
+    """cpu model | arch | core count — enough to detect a machine-class
+    change that would invalidate the pinned oracle time."""
+    model = platform.processor() or ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return f"{model}|{platform.machine()}|{os.cpu_count()}"
 
 GAP_TARGET = 1e-4
 MAX_ROUNDS = 600  # the demo config crosses 1e-4 around round ~440
@@ -114,16 +134,39 @@ def run_oracle_baseline() -> float:
 def main() -> int:
     mode = os.environ.get("COCOA_BENCH_BASELINE", "")
     elapsed, rounds = run_tpu()
-    if ORACLE_BASELINE_S is not None and mode != "measure":
-        baseline = ORACLE_BASELINE_S
+    fpr = machine_fingerprint()
+    if mode == "measure":
+        baseline, baseline_mode = run_oracle_baseline(), "measured"
+        print(f"bench: pinned oracle {ORACLE_BASELINE_S}s, live-measured "
+              f"{baseline:.3f}s ({fpr})", file=sys.stderr)
+    elif ORACLE_BASELINE_S is not None and fpr == ORACLE_FINGERPRINT:
+        baseline, baseline_mode = ORACLE_BASELINE_S, "pinned"
     else:
-        baseline = run_oracle_baseline()
+        # no pin, or the machine class changed since the pin was taken —
+        # either way re-measure rather than report a fiction
+        baseline, baseline_mode = run_oracle_baseline(), "measured"
+        why = ("no pinned oracle time" if ORACLE_BASELINE_S is None else
+               f"machine fingerprint {fpr!r} != pinned {ORACLE_FINGERPRINT!r}")
+        print(f"bench: {why}; oracle re-measured live ({baseline:.3f}s)",
+              file=sys.stderr)
+    # the north-star target (BASELINE.json) is argued against an 8-executor
+    # Spark cluster.  The demo config has K=4 partitions, so even 8 executors
+    # can use at most 4-way parallelism; vs_baseline_parallel_oracle divides
+    # the oracle by that ideal speedup — the honest denominator (real Spark
+    # adds JVM/scheduling overhead on top, so the true ratio sits between
+    # the two numbers).
+    ideal_workers = min(8, K)
     print(json.dumps({
         "metric": "wallclock_to_1e-4_duality_gap (CoCoA+ demo config, "
                   f"{rounds} comm-rounds)",
         "value": round(elapsed, 3),
         "unit": "s",
         "vs_baseline": round(baseline / elapsed, 2),
+        "vs_baseline_parallel_oracle": round(
+            baseline / ideal_workers / elapsed, 2),
+        "baseline_s": round(baseline, 3),
+        "baseline_mode": baseline_mode,
+        "baseline_fingerprint_match": fpr == ORACLE_FINGERPRINT,
     }))
     return 0
 

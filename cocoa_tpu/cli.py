@@ -382,15 +382,16 @@ def main(argv=None) -> int:
             finish(traj, w, alpha)
 
             w, traj = run_sgd(ds, params, debug, local=False, **loop_kw,
-                              **common)
+                              **restore("Mini-batch SGD"), **common)
             finish(traj, w)
 
             w, traj = run_sgd(ds, params, debug, local=True, **loop_kw,
-                              **common)
+                              **restore("Local SGD"), **common)
             finish(traj, w)
 
             w, traj = run_dist_gd(ds, params, debug, mesh=mesh,
-                                  test_ds=test_ds, **loop_kw)
+                                  test_ds=test_ds, **loop_kw,
+                                  **restore("Dist SGD"))
             finish(traj, w)
 
     if extras["profile"]:

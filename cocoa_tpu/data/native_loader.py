@@ -50,7 +50,19 @@ def _try_build() -> None:
             check=True, capture_output=True, timeout=120,
         )
         os.replace(tmp, _SO_PATH)
-    except Exception:
+    except Exception as e:
+        # fall back to the Python parser, but say so — a silent fallback
+        # reads as "parsing is mysteriously slow" at multi-GB scale
+        import warnings
+
+        detail = ""
+        if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+            detail = f": {e.stderr.decode(errors='replace').strip()[-200:]}"
+        warnings.warn(
+            f"native LIBSVM parser build failed ({type(e).__name__}{detail}); "
+            f"falling back to the pure-Python parser",
+            RuntimeWarning,
+        )
         try:
             os.unlink(tmp)
         except OSError:

@@ -400,6 +400,14 @@ def drive_device_full(
                 cache_key=cache_key, mesh=mesh,
             )
             traj.records.extend(dev_traj.records)
+            if dev_traj.records:
+                # the block's single host sync just happened — stamp it on
+                # the block's final record.  Rounds inside the block keep
+                # wall_time=None (genuinely unobservable: one dispatch, one
+                # fetch); these block-boundary stamps give the benchmark
+                # JSONL its monotone (round, time) pairs without fabricating
+                # flat per-round times.
+                traj.records[-1].wall_time = traj.elapsed()
             done = start - 1 + b * c
             start += b * c
             if hit_target():

@@ -356,6 +356,47 @@ def test_resume_equals_uninterrupted(tiny_data, tmp_path):
     np.testing.assert_allclose(np.asarray(a_res), np.asarray(a_full), atol=0)
 
 
+def test_sgd_resume_equals_uninterrupted(tiny_data, tmp_path):
+    """Local SGD: checkpoint at round 5, resume to 10 → bit-identical to a
+    straight 10-round run (VERDICT r1 item 3: the reference checkpoints
+    beyond CoCoA — MinibatchCD.scala:54-57 — so the rebuild's resume must
+    hold for the whole menu, not just the dual-state family)."""
+    from cocoa_tpu import checkpoint as ck
+
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=10)
+    w_full, _ = run_sgd(ds, p, _debug(), local=True, quiet=True)
+
+    d = _debug(chkpt_iter=5, chkpt_dir=str(tmp_path))
+    p5 = _params(tiny_data, num_rounds=5)
+    run_sgd(ds, p5, d, local=True, quiet=True)
+    meta, w0, a0 = ck.load(ck.latest(str(tmp_path), "Local SGD"))
+    assert meta["round"] == 5
+    assert a0 is None  # SGD has no dual state
+    w_res, _ = run_sgd(ds, p, _debug(), local=True, quiet=True,
+                       w_init=w0, start_round=6)
+    np.testing.assert_allclose(np.asarray(w_res), np.asarray(w_full), atol=0)
+
+
+def test_dist_gd_resume_equals_uninterrupted(tiny_data, tmp_path):
+    """DistGD: same resume contract (deterministic passes — only w and the
+    round counter matter)."""
+    from cocoa_tpu import checkpoint as ck
+
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=10)
+    w_full, _ = run_dist_gd(ds, p, _debug(), quiet=True)
+
+    d = _debug(chkpt_iter=5, chkpt_dir=str(tmp_path))
+    p5 = _params(tiny_data, num_rounds=5)
+    run_dist_gd(ds, p5, d, quiet=True)
+    meta, w0, _ = ck.load(ck.latest(str(tmp_path), "Dist SGD"))
+    assert meta["round"] == 5
+    w_res, _ = run_dist_gd(ds, p, _debug(), quiet=True,
+                           w_init=w0, start_round=6)
+    np.testing.assert_allclose(np.asarray(w_res), np.asarray(w_full), atol=0)
+
+
 def test_empty_shard_rejected(tiny_data):
     ds = shard_dataset(tiny_data, k=97, layout="dense", dtype=jnp.float64)
     with pytest.raises(ValueError, match="lower numSplits"):
