@@ -18,8 +18,10 @@ trajectory dump), ``--gapTarget`` (early stop on duality gap), ``--math``
 CoCoA/CoCoA+ only), ``--deviceLoop`` (whole train loop as one on-device
 while_loop; incompatible with checkpointing), ``--loss``
 (hinge | smooth_hinge | logistic — all solvers and the duality-gap
-certificate generalize; see ops/losses.py) and ``--smoothing`` (the
-smooth_hinge parameter s).
+certificate generalize; see ops/losses.py), ``--smoothing`` (the
+smooth_hinge parameter s), and ``--blockSize`` (block-coordinate MXU inner
+loop for the SDCA family — same index stream and math as --math=fast via
+cached block Gram matrices; see ops/local_sdca.local_sdca_block).
 
 ``--objective=lasso`` switches to the ProxCoCoA+ L1 family
 (solvers/prox_cocoa.py): labels become the regression target b,
@@ -46,7 +48,7 @@ _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
               "smoothing")  # same-named RunConfig fields
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
-                "profile", "objective", "l2")  # run-level
+                "profile", "objective", "l2", "blockSize")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -261,6 +263,20 @@ def main(argv=None) -> int:
     if resume and not cfg.chkpt_dir:
         print("error: --resume requires --chkptDir", file=sys.stderr)
         return 2
+    try:
+        block_size = int(extras["blockSize"]) if extras["blockSize"] else 0
+    except ValueError:
+        print(f"error: --blockSize must be an integer, got "
+              f"{extras['blockSize']!r}", file=sys.stderr)
+        return 2
+    if block_size < 0:
+        print(f"error: --blockSize must be >= 0, got {block_size}",
+              file=sys.stderr)
+        return 2
+    if block_size and cfg.math != "fast":
+        print("error: --blockSize requires --math=fast (the block kernel is "
+              "a margins-decomposition variant)", file=sys.stderr)
+        return 2
 
     if objective == "lasso":
         # --objective=lasso: ProxCoCoA+ on 0.5||Ax-b||^2 + lambda||x||_1
@@ -312,7 +328,8 @@ def main(argv=None) -> int:
         x, r, traj = run_prox_cocoa(
             ds_c, b, lasso_params, cfg.to_debug(), mesh=mesh, rng=cfg.rng,
             gap_target=gap_target, scan_chunk=cfg.scan_chunk,
-            math=cfg.math, device_loop=cfg.device_loop, **resume_kw,
+            math=cfg.math, device_loop=cfg.device_loop,
+            block_size=block_size, **resume_kw,
         )
         from cocoa_tpu.solvers.prox_cocoa import _metrics_fn
 
@@ -362,7 +379,8 @@ def main(argv=None) -> int:
     common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng)
 
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
-                    math=cfg.math, device_loop=cfg.device_loop)
+                    math=cfg.math, device_loop=cfg.device_loop,
+                    block_size=block_size)
 
     def run_all():
         w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
@@ -377,8 +395,8 @@ def main(argv=None) -> int:
             loop_kw = dict(scan_chunk=cfg.scan_chunk,
                            device_loop=cfg.device_loop)
             w, alpha, traj = run_minibatch_cd(
-                ds, params, debug, math=cfg.math, **loop_kw,
-                **restore("Mini-batch CD"), **common)
+                ds, params, debug, math=cfg.math, block_size=block_size,
+                **loop_kw, **restore("Mini-batch CD"), **common)
             finish(traj, w, alpha)
 
             w, traj = run_sgd(ds, params, debug, local=False, **loop_kw,

@@ -1,0 +1,192 @@
+"""Pallas TPU kernel for the block-coordinate scalar recurrence.
+
+The block-coordinate inner solver (ops/local_sdca.local_sdca_block) reduces
+each coordinate step's O(d) sequential work to O(B) — margins read cached
+block Gram entries instead of re-dotting Δw (the hot-loop contract is
+CoCoA.scala:148-188; the restructuring is exact, see that docstring).  But
+under plain XLA each of the B chained steps still costs ~µs of loop
+overhead, which is the same price the O(d) sequential kernels pay — the
+blocking buys nothing (measured: 31 ms/round vs the sequential Pallas
+kernel's ~9 ms at epsilon scale).
+
+This kernel runs the whole B-step recurrence inside one ``pallas_call``
+with every operand VMEM-resident and ZERO dynamic HBM traffic in the chain,
+and — the part that actually wins — advances ALL K logical shards' chains
+in lockstep inside one kernel instance:
+
+- the per-step scalars (margins0, y, ‖x‖²·qf, α₀, X_B·Δw, live-mask) of
+  every shard arrive lane-blocked as one (6K, B) tile; a single masked
+  reduce yields the step-j column for all shards at once;
+- the Gram row for step j arrives for all shards from ONE dynamic sublane
+  slice of a precomputed (B, 2K, B) operand (gram is symmetric, so row j ==
+  column j), concatenated with the equality rows (below);
+- within-block duplicate draws are exact through the equality tiles
+  ``eq_k[i, j] = (idx_i == idx_j)``: the live α for step j is
+  ``α₀[j] + Σ_i δ_i·eq[i, j]`` — δ_i is zero for i ≥ j, so the sum ranges
+  over earlier same-index steps only, exactly the sequential recurrence
+  (cross-block duplicates are the caller's additive α scatter);
+- the running (2K, B) coef/δ rows live in loop-carried vector registers;
+- the coordinate update itself is elementwise on (K, 1) columns — one
+  evaluation serves every shard.
+
+Per step that is ~a dozen small VPU ops and one sublane slice FOR ALL K
+CHAINS — hundreds of ns where the sequential kernels pay ~1.7 µs per
+lockstep — while the O(B·d) tile work (row gathers, Gram matrices, Δw
+apply) stays outside in XLA where it lands on the MXU
+(local_sdca_block_batched).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from cocoa_tpu.ops import losses
+
+LANES = 128
+SCAL_ROWS = 6  # [margins0 | labels | qii | alpha0 | mb | live-mask]
+CHAIN_VMEM_BUDGET = 12 << 20  # leave ~4 MB of the ~16 MB VMEM for Mosaic
+
+
+def chain_vmem_estimate(k: int, b: int, itemsize: int) -> int:
+    """Rough VMEM working set of one chain_block_batched instance: the
+    (B, 2K, B) gq operand, the (6K, B) scal input + prologue copy, the
+    (2K, B) carry + outputs."""
+    return itemsize * (2 * k * b * b + 12 * k * b + 6 * k * b)
+
+
+def chain_fits(k: int, b: int, itemsize: int) -> bool:
+    return chain_vmem_estimate(k, b, itemsize) <= CHAIN_VMEM_BUDGET
+
+
+def _chain_kernel_batched(scal_ref, gq_ref, delta_ref, coef_ref, *,
+                          k, b, lam_n, coef_div, sig_eff, frozen, loss,
+                          smoothing):
+    """All K shards' B-step chains advance in lockstep: one masked reduce
+    yields every shard's step scalars as a (·K, 1) column, one dynamic
+    sublane slice of the (B, 2K, B) gq operand yields every shard's
+    Gram AND duplicate-equality rows at once, one fused (2K, B)
+    multiply-reduce forms both chain dots, and the coordinate update
+    itself is elementwise on (K, 1) columns — the per-step latency is that
+    of ONE chain."""
+    gw = k if frozen else 2 * k   # frozen gq carries only the eq rows
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, b), 1)
+    dtype = scal_ref.dtype
+    scal = scal_ref[...]            # (6K, b)
+    zero = jnp.zeros((2 * k, b), dtype)
+    one = jnp.asarray(1.0, dtype)
+
+    if loss == "hinge":
+        # Hinge collapses algebraically: for qii > 0 the reference's
+        # projected-gradient + vanishing-gradient branches are reproduced
+        # exactly by the plain clip (at a boundary the clip re-pins α
+        # wherever the projection would have zeroed the step), and for
+        # qii == 0 the rule is the constant 1 (z = 0 ⇒ grad = −λn ≠ 0).
+        # That lets every per-step constant hoist into a vectorized
+        # prologue — the chained work per step is the two dots, one clip,
+        # and one masked write:
+        #     u_j  = a_j − (base_j + S_j·(c·G row j)),  a_j = a0_j + δ·eq row j
+        #     α'_j = qii>0 ? clip(u_j, 0, 1) : 1
+        m0, y, qii, a0, mb, live = (scal[i * k:(i + 1) * k]
+                                    for i in range(6))
+        q_safe = jnp.where(qii != 0.0, qii, one)
+        base = (y * (m0 + sig_eff * mb) - 1.0) * lam_n / q_safe
+        s_row = y * (sig_eff * lam_n) / q_safe
+        fac = jnp.concatenate([y * (live / coef_div), live], axis=0)
+        pre = jnp.concatenate(
+            [base, s_row, a0, jnp.where(qii != 0.0, one, 0.0), fac], axis=0
+        )  # (6K, b): [base | S | a0 | qflag | Yl | Ll]
+
+        def step(j, cd):            # cd rows: [coefs_0..K-1 | delta_0..K-1]
+            mask = lane == j
+            sv = jnp.sum(jnp.where(mask, pre, 0.0), axis=1, keepdims=True)
+            gq = gq_ref[pl.ds(j, 1)].reshape(gw, b)
+            dots = jnp.sum(cd[2 * k - gw:] * gq, axis=1, keepdims=True)
+            a = sv[2 * k:3 * k] + dots[gw - k:]
+            u = a - sv[:k]
+            if not frozen:
+                u = u - sv[k:2 * k] * dots[:k]
+            new_a = jnp.where(sv[3 * k:4 * k] > 0.0,
+                              jnp.clip(u, 0.0, 1.0), one)
+            dm = new_a - a
+            upd = sv[4 * k:] * jnp.concatenate([dm, dm], axis=0)
+            return jnp.where(mask, upd, cd)
+
+        cd = jax.lax.fori_loop(0, b, step, zero)
+        coef_ref[...] = cd[:k]
+        delta_ref[...] = cd[k:]
+        return
+
+    def step(j, cd):                # cd rows: [coefs_0..K-1 | delta_0..K-1]
+        mask = lane == j
+        sv = jnp.sum(jnp.where(mask, scal, 0.0), axis=1, keepdims=True)
+        m0, y, qii, a0, mb, live = (sv[i * k:(i + 1) * k] for i in range(6))
+        gq = gq_ref[pl.ds(j, 1)].reshape(gw, b)
+        dots = jnp.sum(cd[2 * k - gw:] * gq, axis=1, keepdims=True)
+        if frozen:
+            margin = m0
+        else:
+            margin = m0 + sig_eff * (mb + dots[:k])
+        a = a0 + dots[gw - k:]
+        new_a = losses.alpha_step(loss, a, y * margin, qii, lam_n,
+                                  smoothing=smoothing)
+        d_j = (new_a - a) * live
+        c_j = y * d_j / coef_div
+        return jnp.where(mask, jnp.concatenate([c_j, d_j], axis=0), cd)
+
+    cd = jax.lax.fori_loop(0, b, step, zero)
+    coef_ref[...] = cd[:k]
+    delta_ref[...] = cd[k:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lam_n", "coef_div", "sig_eff", "frozen", "loss",
+                     "smoothing", "interpret"),
+)
+def chain_block_batched(
+    scal: jax.Array,   # (K, 6, B): [m0 | y | qii | alpha0 | mb | mask]
+    gq: jax.Array,     # (B, 2K, B) fused Gram+equality operand:
+                       # gq[j, k, i] = x_i·x_j of shard k (transposed Gram,
+                       # einsum("kjd,kid->jki")), gq[j, K+k, i] =
+                       # (idx_i == idx_j); frozen mode passes (B, K, B)
+                       # with only the equality rows
+    lam_n: float,
+    coef_div: float,
+    sig_eff: float,
+    frozen: bool,
+    loss: str,
+    smoothing: float,
+    interpret: bool = False,
+):
+    """Run one block's B-step recurrence for K shards in lockstep.
+    Returns ``(delta, coefs)``, both (K, B): per-step α deltas (for the
+    caller's additive scatter — duplicate-safe by construction) and Δw
+    coefficients (for the caller's ``coefs·X_B`` apply).  B must be a
+    multiple of 128 (whole lane tiles)."""
+    k, _, b = scal.shape
+    if b % LANES:
+        raise ValueError(f"chain_block_batched needs B % {LANES} == 0, "
+                         f"got {b}")
+    if gq.shape != (b, (k if frozen else 2 * k), b):
+        raise ValueError(f"gq shape {gq.shape} does not match frozen={frozen}")
+    # (K, 6, B) -> (6K, B) grouped by metric so the kernel's static column
+    # slices are [m0_0..m0_K-1 | y_0.. | ...]
+    scal_rows = scal.transpose(1, 0, 2).reshape(6 * k, b)
+    kernel = functools.partial(
+        _chain_kernel_batched, k=k, b=b, lam_n=lam_n, coef_div=coef_div,
+        sig_eff=sig_eff, frozen=frozen,
+        loss=losses.validate(loss, smoothing), smoothing=smoothing,
+    )
+    delta, coefs = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((k, b), scal.dtype),
+            jax.ShapeDtypeStruct((k, b), scal.dtype),
+        ],
+        interpret=interpret,
+    )(scal_rows, gq)
+    return delta, coefs

@@ -76,6 +76,8 @@ def _sdca_round_parts(
     math: str = "exact",
     pallas: bool = False,
     pallas_interpret: bool = False,
+    block: int = 0,
+    block_chain: str = "xla",
 ):
     """The per-shard local update and driver-side apply shared by the
     per-round and chunked builders (so the two paths cannot diverge), for
@@ -87,10 +89,17 @@ def _sdca_round_parts(
     step — equal in real arithmetic, rounds differently than the reference
     order.  ``pallas=True`` further runs the inner loop as a Pallas TPU
     kernel — ops/pallas_sdca.py for the dense layout, ops/pallas_sparse.py
-    for padded-CSR.  Returns (per_shard, per_round_batched | None,
-    apply_fn)."""
+    for padded-CSR.  ``block > 0`` runs the fast inner loop as the
+    block-coordinate MXU kernel (ops/local_sdca.local_sdca_block) with that
+    block size.  Returns (per_shard, per_round_batched | None, apply_fn)."""
     if math not in ("exact", "fast"):
         raise ValueError(f"math must be 'exact' or 'fast', got {math!r}")
+    if block and pallas:
+        raise ValueError("block-coordinate mode replaces the Pallas kernel; "
+                         "pass pallas=False with block > 0")
+    if block and math == "exact":
+        raise ValueError("block > 0 requires math='fast' (the block kernel "
+                         "is a margins-decomposition variant)")
 
     def apply_fn(w, dw_sum, x=None):
         # CoCoA.scala:47-48 / MinibatchCD.scala:42-43 (x unused: no η(t))
@@ -111,7 +120,9 @@ def _sdca_round_parts(
 
         return per_shard, None, apply_fn
 
-    from cocoa_tpu.ops.local_sdca import local_sdca_fast
+    from cocoa_tpu.ops.local_sdca import (
+        local_sdca_block, local_sdca_block_batched, local_sdca_fast,
+    )
     from cocoa_tpu.ops.rows import shard_margins
 
     def per_shard(w, alpha_k, idxs_k, shard_k):
@@ -126,8 +137,21 @@ def _sdca_round_parts(
             )
             da = a_inner[0] - alpha_k
             return dw[0], alpha_k + scaling * da
+        if block and block_chain != "xla":
+            # single-shard view of the batched block kernel (the mesh path:
+            # one shard per device under shard_map, check_vma=False)
+            da, dw = local_sdca_block_batched(
+                w, alpha_k[None], jax.tree.map(lambda a: a[None], shard_k),
+                idxs_k[None], params.lam, params.n, mode=mode, sigma=sigma,
+                loss=params.loss, smoothing=params.smoothing, block=block,
+                interpret=(block_chain == "pallas_interpret"),
+            )
+            return dw[0], alpha_k + scaling * da[0]
         m0 = shard_margins(w, shard_k)
-        da, dw = local_sdca_fast(
+        inner = local_sdca_fast if not block else functools.partial(
+            local_sdca_block, block=block
+        )
+        da, dw = inner(
             m0, alpha_k, shard_k, idxs_k, params.lam, params.n,
             jnp.zeros_like(w), mode=mode, sigma=sigma,
             loss=params.loss, smoothing=params.smoothing,
@@ -145,6 +169,18 @@ def _sdca_round_parts(
             )
             alpha_new = alpha + scaling * (a_inner - alpha)
             return dw.sum(axis=0), alpha_new
+    elif block and block_chain != "xla":
+        # the batched block kernel advances every shard's chain inside one
+        # Pallas instance — vmap(per_shard) would serialize K kernel
+        # instances through the grid instead
+        def per_round_batched(w, alpha, idxs_kh, shards):
+            da, dw = local_sdca_block_batched(
+                w, alpha, shards, idxs_kh, params.lam, params.n,
+                mode=mode, sigma=sigma, loss=params.loss,
+                smoothing=params.smoothing, block=block,
+                interpret=(block_chain == "pallas_interpret"),
+            )
+            return dw.sum(axis=0), alpha + scaling * da
 
     return per_shard, per_round_batched, apply_fn
 
@@ -183,7 +219,8 @@ def _make_chunk_kernel(mesh, params: Params, k: int, alg, **parts_kw):
             per_round_batched=per_round_batched,
             # pallas_call's internal slices confuse shard_map's VMA type
             # checker; the manual pvary/psum handling makes it safe to skip
-            check_vma=not parts_kw.get("pallas", False),
+            check_vma=not (parts_kw.get("pallas", False)
+                           or parts_kw.get("block_chain", "xla") != "xla"),
         )
 
     return chunk_kernel
@@ -227,6 +264,8 @@ def run_sdca_family(
     scan_chunk: int = 0,
     math: str = "exact",
     pallas=None,
+    block_size: int = 0,
+    block_chain=None,
     device_loop: bool = False,
     eval_fn=None,
     eval_kernel=None,
@@ -257,6 +296,14 @@ def run_sdca_family(
     runs the inner loop as a Pallas TPU kernel — the folded-row dense
     kernel or the lane-blocked sparse (padded-CSR) kernel, by layout;
     requires ``math="fast"``.
+
+    ``block_size > 0`` (flag ``--blockSize``) runs the fast inner loop as
+    the block-coordinate MXU kernel (ops/local_sdca.local_sdca_block):
+    same sampled index stream, margins via cached block Gram matrices —
+    identical in real arithmetic to the sequential fast path, restructured
+    so the per-coordinate critical path is O(B) scalar work instead of an
+    O(d) dot.  Requires ``math="fast"``; mutually exclusive with the
+    Pallas sequential kernels.
 
     ``device_loop=True`` runs the ENTIRE training loop — all rounds, the
     ``debugIter``-cadence evaluations, and the gap-target early-stop — as
@@ -289,6 +336,10 @@ def run_sdca_family(
     from cocoa_tpu.parallel.mesh import has_fp
 
     platform = jax.devices()[0].platform
+    if pallas is None and block_size > 0:
+        # the block-coordinate kernel is an alternative inner loop — it and
+        # the Pallas sequential kernels are mutually exclusive by design
+        pallas = False
     if pallas is None:
         # auto: the Pallas kernels need fast math + f32 + a real TPU
         # backend (measured vs the fori_loop path: ~4x faster rounds at
@@ -336,14 +387,44 @@ def run_sdca_family(
             f"the Pallas SDCA kernel needs a TPU backend (or CPU interpret "
             f"mode); current platform is {platform!r}"
         )
+    # the block recurrence rides its own Pallas kernel when it can (TPU,
+    # f32, whole lane tiles, no feature-parallel axis, fits VMEM —
+    # ops/pallas_chain.py); otherwise the portable XLA fori_loop chain
+    # (also what the x64 CPU validation tests compare).  ``block_chain``
+    # overrides the auto choice (tests use "pallas_interpret" to exercise
+    # the driver-integrated kernel path on CPU).
+    if block_chain is not None:
+        if block_chain not in ("xla", "pallas", "pallas_interpret"):
+            raise ValueError(f"block_chain must be xla|pallas|"
+                             f"pallas_interpret, got {block_chain!r}")
+        if block_chain != "xla" and has_fp(mesh):
+            raise ValueError("the Pallas block-chain kernel does not "
+                             "support feature-parallel (fp) meshes")
+    else:
+        from cocoa_tpu.ops.pallas_chain import chain_fits
+
+        block_chain = "xla"
+        if (
+            block_size > 0
+            and block_size % 128 == 0
+            and jnp.dtype(dtype).itemsize == 4
+            and platform in ("tpu", "axon")
+            # the kernel assumes the full d per device
+            and not has_fp(mesh)
+            # VMEM working set: one shard per device on the mesh path,
+            # all K logical shards in one instance on the single-chip path
+            and chain_fits(1 if mesh is not None else k, block_size, 4)
+        ):
+            block_chain = "pallas"
     parts_kw = dict(
         math=math, pallas=pallas,
         pallas_interpret=(pallas and platform == "cpu"),
+        block=block_size, block_chain=block_chain,
     )
-    # the Pallas kernel owns the shard axis itself, which neither the
-    # per-round driver's vmap path nor its plain fanout shard_map can
-    # express — always route it through the chunked driver
-    if pallas and scan_chunk <= 0:
+    # the Pallas kernels (sequential and block-chain) own the shard axis
+    # themselves, which neither the per-round driver's vmap path nor its
+    # plain fanout shard_map can express — route through the chunked driver
+    if (pallas or block_chain != "xla") and scan_chunk <= 0:
         scan_chunk = 1
 
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
@@ -375,7 +456,7 @@ def run_sdca_family(
                               sampler.chunk_indices(t0, c), shard_arrays)
 
         cache_key = (
-            "sdca", alg_name, alg, math, pallas, k, mesh,
+            "sdca", alg_name, alg, math, pallas, block_size, k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,
             params.num_rounds, debug.debug_iter, start_round,

@@ -38,6 +38,8 @@ def run_minibatch_cd(
     scan_chunk: int = 0,
     math: str = "exact",
     pallas=None,
+    block_size: int = 0,
+    block_chain=None,
     device_loop: bool = False,
 ):
     """Train; returns (w, alpha, Trajectory)."""
@@ -47,5 +49,6 @@ def run_minibatch_cd(
         rng=rng, w_init=w_init, alpha_init=alpha_init,
         start_round=start_round, quiet=quiet, gap_target=gap_target,
         scan_chunk=scan_chunk, math=math, pallas=pallas,
+        block_size=block_size, block_chain=block_chain,
         device_loop=device_loop,
     )

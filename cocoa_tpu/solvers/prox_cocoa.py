@@ -101,6 +101,8 @@ def run_prox_cocoa(
     scan_chunk: int = 0,
     math: str = "fast",
     pallas=None,
+    block_size: int = 0,
+    block_chain=None,
     device_loop: bool = False,
 ):
     """Train; returns (x, r, Trajectory) with x (K, d_shard) the sharded
@@ -152,7 +154,8 @@ def run_prox_cocoa(
         test_ds=_BCarrier(),
         rng=rng, w_init=w_init, alpha_init=x_init, start_round=start_round,
         quiet=quiet, gap_target=gap_target, scan_chunk=scan_chunk,
-        math=math, pallas=pallas, device_loop=device_loop,
+        math=math, pallas=pallas, block_size=block_size,
+        block_chain=block_chain, device_loop=device_loop,
         eval_fn=eval_fn, eval_kernel=eval_kernel,
     )
     return x, r, traj
