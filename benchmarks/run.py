@@ -49,11 +49,92 @@ DEMO_TEST = "/root/reference/data/small_test.dat"
 DEMO_D = 9947
 
 
-def _time_warm(fn):
+def _time_warm(fn, reps=2):
+    """Warm (compiled) best-of-``reps`` timing: the tunneled device's
+    dispatch+fetch latency varies by whole seconds run-to-run, so a single
+    sample badly overstates small configs."""
     fn()  # compile
+    best, out = None, None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    return best, out
+
+
+def _perf(tag, secs, rounds, *, n, d, k, h, layout="dense", nnz=None,
+          path="fast", block=0, debug_iter=10, test_n=0):
+    """Fold a measured run into the perf-accounting columns (benchmarks/
+    perf.py): FLOP model, achieved FLOP/s, MFU, µs per coordinate step,
+    HBM floor, and the roofline bound classification."""
+    import perf
+
+    model = perf.sdca_round_model(n, d, k, h, layout=layout, nnz=nnz,
+                                  path=path, block=block)
+    return perf.account(
+        tag, secs / max(1, rounds), model, steps=k * h,
+        evals_per_round=1.0 / debug_iter,
+        eval_fl=perf.eval_flops(n, d, nnz=nnz, test_n=test_n),
+    )
+
+
+def _oracle_rounds_per_s_csr(data, lam, h, k, n, rounds=2, mode="plus"):
+    """Single-thread oracle round rate on a SPARSE problem, from the raw
+    CSR arrays — the literal per-step math (sparse dot, box projection,
+    sparse axpy) without ever densifying X.  Fills the vs_oracle cells the
+    r1 benchmarks left empty (dense oracle needs n×d memory)."""
+    from cocoa_tpu.data.sharding import split_sizes
+    from cocoa_tpu.utils.prng import sample_indices
+
+    sizes = split_sizes(n, k)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    indptr, indices, values, y = (data.indptr, data.indices, data.values,
+                                  data.labels)
+    d = data.num_features
+    w = np.zeros(d)
+    alphas = [np.zeros(sizes[s]) for s in range(k)]
+    sigma = float(k)
+    plus = mode == "plus"
+    lam_n = lam * n
     t0 = time.perf_counter()
-    out = fn()
-    return time.perf_counter() - t0, out
+    for t in range(1, rounds + 1):
+        dw_sum = np.zeros(d)
+        for s in range(k):
+            idxs = sample_indices(0, range(t, t + 1), h, sizes[s])[0]
+            a = alphas[s]
+            dw = np.zeros(d)
+            # "cocoa": each worker advances a PRIVATE copy of w (the
+            # reference ships w in the task closure, CoCoA.scala:142,183);
+            # the local advances are discarded, only dw is reduced
+            wl = w.copy() if mode == "cocoa" else w
+            for li in idxs:
+                gi = offs[s] + li
+                cols = indices[indptr[gi]:indptr[gi + 1]]
+                vals = values[indptr[gi]:indptr[gi + 1]]
+                yy = y[gi]
+                if plus:
+                    grad = (yy * (vals @ w[cols] + sigma * (vals @ dw[cols]))
+                            - 1.0) * lam_n
+                else:  # "cocoa" (locally-advancing wl) and "frozen" (MbCD)
+                    grad = (yy * (vals @ wl[cols]) - 1.0) * lam_n
+                proj = grad
+                if a[li] <= 0.0:
+                    proj = min(grad, 0.0)
+                elif a[li] >= 1.0:
+                    proj = max(grad, 0.0)
+                if proj != 0.0:
+                    qii = float(vals @ vals) * (sigma if plus else 1.0)
+                    new_a = 1.0 if qii == 0.0 else min(
+                        max(a[li] - grad / qii, 0.0), 1.0)
+                    coef = yy * (new_a - a[li]) / lam_n
+                    dw[cols] += coef * vals
+                    if mode == "cocoa":
+                        wl[cols] += coef * vals
+                    a[li] = new_a
+            dw_sum += dw
+        w = w + dw_sum  # gamma=1 additive
+    return rounds / (time.perf_counter() - t0)
 
 
 def _oracle_rounds_per_s(ds_like, lam, h, k, n, rounds=3):
@@ -86,7 +167,7 @@ def _oracle_rounds_per_s(ds_like, lam, h, k, n, rounds=3):
     return rounds / (time.perf_counter() - t0)
 
 
-def bench_demo(results):
+def bench_demo(results, perf_rows):
     import jax.numpy as jnp
 
     from cocoa_tpu.config import DebugParams, Params
@@ -114,9 +195,11 @@ def bench_demo(results):
         vs_oracle=round(rec.round / rate / secs, 1),
         oracle_basis="measured (3 rounds)",
     ))
+    perf_rows.append(_perf("demo-cocoa+", secs, rec.round, n=data.n,
+                           d=DEMO_D, k=4, h=50, path="pallas"))
 
 
-def bench_epsilon(results, quick):
+def bench_epsilon(results, perf_rows, quick):
     import jax.numpy as jnp
 
     from cocoa_tpu.config import DebugParams, Params
@@ -151,6 +234,27 @@ def bench_epsilon(results, quick):
         vs_oracle=round(rec.round / rate / secs, 1),
         oracle_basis=f"extrapolated from n={n_sub} subsample",
     ))
+    perf_rows.append(_perf("epsilon-cocoa+", secs, rec.round, n=n, d=d,
+                           k=k, h=h, path="pallas"))
+
+    # the block-coordinate inner solver (--blockSize=256): same index
+    # stream and math, restructured for the MXU (ops/pallas_chain.py)
+    def go_block():
+        return run_cocoa(ds, params, debug, plus=True, quiet=True,
+                         math="fast", block_size=256, device_loop=True,
+                         gap_target=1e-4)
+
+    secs_b, (w_b, a_b, traj_b) = _time_warm(go_block)
+    rec_b = traj_b.records[-1]
+    results.append(dict(
+        config="epsilon-cocoa+(block256)", n=n, d=d, k=k, h=h,
+        lam=1e-3, gap_target=1e-4, rounds=rec_b.round,
+        gap=float(rec_b.gap), wallclock_s=round(secs_b, 3),
+        vs_oracle=round(rec_b.round / rate / secs_b, 1),
+        oracle_basis=f"extrapolated from n={n_sub} subsample",
+    ))
+    perf_rows.append(_perf("epsilon-cocoa+(block256)", secs_b, rec_b.round,
+                           n=n, d=d, k=k, h=h, path="block", block=256))
 
     # Local SGD on the same data (primal-only baseline; fixed 100 rounds)
     from cocoa_tpu.solvers import run_sgd
@@ -159,7 +263,7 @@ def bench_epsilon(results, quick):
     d2 = DebugParams(debug_iter=100, seed=0)
 
     def go_sgd():
-        return run_sgd(ds, p2, d2, local=True, quiet=True)
+        return run_sgd(ds, p2, d2, local=True, quiet=True, device_loop=True)
 
     secs2, (w2, traj2) = _time_warm(go_sgd)
     rec2 = traj2.records[-1]
@@ -168,9 +272,13 @@ def bench_epsilon(results, quick):
         rounds=rec2.round, primal=float(rec2.primal),
         wallclock_s=round(secs2, 3),
     ))
+    # SGD.scala:117-129 per step: O(d) rescale + conditional axpy — the
+    # "exact"-path model (4·d per step, no margins pass) is the right count
+    perf_rows.append(_perf("epsilon-localsgd", secs2, rec2.round, n=n, d=d,
+                           k=k, h=h, path="exact", debug_iter=100))
 
 
-def bench_rcv1(results, quick):
+def bench_rcv1(results, perf_rows, quick):
     import jax.numpy as jnp
 
     from cocoa_tpu.config import DebugParams, Params
@@ -183,6 +291,8 @@ def bench_rcv1(results, quick):
     ds = shard_dataset(data, k=k, layout="sparse", dtype=jnp.float32)
     h = n // k // 10
     debug = DebugParams(debug_iter=25, seed=0)
+    nnz = len(data.values) / n
+    rate_plus = _oracle_rounds_per_s_csr(data, 1e-4, h, k, n, mode="plus")
 
     for gap_target in (1e-3, 1e-4):
         params = Params(n=n, num_rounds=1500, local_iters=h, lam=1e-4)
@@ -198,7 +308,13 @@ def bench_rcv1(results, quick):
             config=f"rcv1-cocoa+({gap_target:g})", n=n, d=d, k=k, h=h,
             lam=1e-4, gap_target=gap_target, rounds=rec.round,
             gap=float(rec.gap), wallclock_s=round(secs, 3),
+            vs_oracle=round(rec.round / rate_plus / secs, 1),
+            oracle_basis="measured (2 rounds, CSR)",
         ))
+        perf_rows.append(_perf(f"rcv1-cocoa+({gap_target:g})", secs,
+                               rec.round, n=n, d=d, k=k, h=h,
+                               layout="sparse", nnz=nnz, path="pallas",
+                               debug_iter=25))
 
     # Mini-batch CD on the same data (fixed 100 rounds; its β/(K·H)
     # scaling needs far more rounds per unit of gap progress — the CoCoA
@@ -207,18 +323,63 @@ def bench_rcv1(results, quick):
     d2 = DebugParams(debug_iter=100, seed=0)
 
     def go_mbcd():
-        return run_minibatch_cd(ds, p2, d2, quiet=True)
+        return run_minibatch_cd(ds, p2, d2, quiet=True, math="fast",
+                                device_loop=True)
 
     secs2, (w2, a2, traj2) = _time_warm(go_mbcd)
     rec2 = traj2.records[-1]
+    rate_f = _oracle_rounds_per_s_csr(data, 1e-4, h, k, n, mode="frozen")
     results.append(dict(
         config="rcv1-mbcd", n=n, d=d, k=k, h=h, lam=1e-4,
         rounds=rec2.round, gap=float(rec2.gap), primal=float(rec2.primal),
         wallclock_s=round(secs2, 3),
+        vs_oracle=round(rec2.round / rate_f / secs2, 1),
+        oracle_basis="measured (2 rounds, CSR)",
     ))
+    perf_rows.append(_perf("rcv1-mbcd", secs2, rec2.round, n=n, d=d, k=k,
+                           h=h, layout="sparse", nnz=nnz, path="pallas",
+                           debug_iter=100))
 
 
-def bench_lasso(results, quick):
+def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2):
+    """Single-thread literal prox-CD oracle round rate (ProxCoCoA+ lasso,
+    gamma=1): per step one column dot against r, one against the local
+    Δv, a soft-threshold, one column axpy."""
+    from cocoa_tpu.data.sharding import split_sizes
+    from cocoa_tpu.utils.prng import sample_indices
+
+    n, d = A.shape
+    A = np.asfortranarray(A)  # contiguous columns — the unit of access,
+                              # as Breeze column vectors are materialized
+    sizes = split_sizes(d, k)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    sigma = float(k)
+    r = -bvec.astype(np.float64)
+    x = np.zeros(d)
+    t0 = time.perf_counter()
+    for t in range(1, rounds + 1):
+        dv_sum = np.zeros(n)
+        for sh in range(k):
+            idxs = sample_indices(0, range(t, t + 1), h, sizes[sh])[0]
+            dv = np.zeros(n)
+            for lj in idxs:
+                gj = offs[sh] + lj
+                aj = A[:, gj]
+                a = x[gj]
+                z = aj @ r + sigma * (aj @ dv)
+                q = sigma * float(aj @ aj)
+                if q <= 0.0:
+                    continue
+                u = (q * a - z) / q
+                tstar = np.sign(u) * max(abs(u) - lam / q, 0.0)
+                dv += aj * (tstar - a)
+                x[gj] = tstar
+            dv_sum += dv
+        r = r + dv_sum
+    return rounds / (time.perf_counter() - t0)
+
+
+def bench_lasso(results, perf_rows, quick):
     """ProxCoCoA+ lasso (the L1 extension, no reference analogue): dense
     Gaussian design with a planted 64-sparse x*, λ = 0.3·λ_max, to a
     RELATIVE duality gap of 1e-3 (gap ≤ 1e-3 · ½‖b‖² — lasso objectives
@@ -257,14 +418,21 @@ def bench_lasso(results, quick):
 
     secs, (x, r, traj) = _time_warm(go)
     rec = traj.records[-1]
+    rate = _oracle_rounds_per_s_lasso(A, bvec, lam, h, k)
     results.append(dict(
         config="lasso-proxcocoa+", n=n, d=d, k=k, h=h,
         lam=round(lam, 5), gap_target=f"1e-3 relative", rounds=rec.round,
         gap=float(rec.gap), wallclock_s=round(secs, 3),
+        vs_oracle=round(rec.round / rate / secs, 1),
+        oracle_basis="measured (2 rounds)",
     ))
+    # roles swapped: d coordinates play the example axis, dense columns of
+    # length n play the rows (see solvers/prox_cocoa.py)
+    perf_rows.append(_perf("lasso-proxcocoa+", secs, rec.round, n=d, d=n,
+                           k=k, h=h, path="pallas", debug_iter=50))
 
 
-def write_results(results, out_dir, partial=False):
+def write_results(results, perf_rows, out_dir, partial=False):
     """Full runs own results.jsonl / RESULTS.md (the artifacts BASELINE.md
     cites); --quick / --only runs write to *.partial.* so they can never
     clobber the recorded numbers."""
@@ -273,6 +441,8 @@ def write_results(results, out_dir, partial=False):
     with open(jl, "w") as f:
         for r in results:
             f.write(json.dumps(r) + "\n")
+        for r in perf_rows:
+            f.write(json.dumps({"type": "perf", **r}) + "\n")
     md = os.path.join(out_dir, f"RESULTS{suffix}.md")
     cols = ["config", "n", "d", "k", "h", "lam", "gap_target", "rounds",
             "gap", "primal", "wallclock_s", "vs_oracle"]
@@ -289,6 +459,57 @@ def write_results(results, out_dir, partial=False):
                 str(r.get(c, "")) if not isinstance(r.get(c), float)
                 else f"{r[c]:.4g}" for c in cols
             ) + " |\n")
+        if perf_rows:
+            f.write(
+                "\n## Perf accounting (VERDICT r1 item 1)\n\n"
+                "FLOP/byte models in `benchmarks/perf.py`; the accounting "
+                "contract is the reference hot loop CoCoA.scala:148-188 "
+                "(4·nnz useful FLOPs per coordinate step) plus the margins "
+                "and eval passes of the measured path.  `useful` counts the "
+                "reference's math; `physical` adds the FLOPs the TPU "
+                "formulation spends to buy parallelism (block Gram work, "
+                "lane padding).  MFU is against the chip's public dense "
+                "bf16 peak — a conservative lower bound for f32 work.  "
+                "Times include the per-`debugIter` eval amortized in, and "
+                "a fixed ~0.1-0.3 s dispatch+fetch cost of the tunneled "
+                "device spread over the run's rounds.\n\n"
+            )
+            pcols = ["config", "device", "ms_per_round", "us_per_step",
+                     "useful_gflops", "physical_gflops", "mfu_pct",
+                     "physical_mfu_pct", "hbm_floor_ms", "hbm_bound_pct",
+                     "bound"]
+            f.write("| " + " | ".join(pcols) + " |\n")
+            f.write("|" + "---|" * len(pcols) + "\n")
+            for r in perf_rows:
+                f.write("| " + " | ".join(str(r.get(c, "")) for c in pcols)
+                        + " |\n")
+            f.write(
+                "\nEvery config is latency-bound: the measured round time "
+                "sits far above both the HBM-traffic floor and the FLOP "
+                "floor, because the algorithm's hot loop is a sequential "
+                "chain of O(nnz) coordinate steps (CoCoA.scala:148-188) — "
+                "per-step chain latency (~1-4 µs across the kernels, "
+                "~0.9 µs for the block-coordinate kernel), not bandwidth "
+                "or MXU throughput, sets the ceiling.  Corollary: rcv1's "
+                "1450 rounds to the 1e-4 gap is λ=1e-4 *conditioning* "
+                "(2.6 µs/step is already near the chain floor; the same "
+                "kernel reaches the 1e-3 gap in 325 rounds), not a sparse-"
+                "kernel inefficiency.\n"
+                "\nRoofline reading, per config:\n\n"
+            )
+            for r in perf_rows:
+                hbm = r.get("hbm_bound_pct")
+                f.write(
+                    f"- **{r['config']}** — {r['ms_per_round']} ms/round, "
+                    f"{r['us_per_step']} µs per coordinate step "
+                    f"(amortized over the K parallel shards); useful "
+                    f"{r['useful_gflops']} GFLOP/s ≈ "
+                    f"{r.get('mfu_pct', '?')}% MFU "
+                    f"(physical {r.get('physical_mfu_pct', '?')}%).  The "
+                    f"HBM-traffic model floor is {r.get('hbm_floor_ms', '?')} "
+                    f"ms ({hbm}% of measured) → **{r.get('bound', '?')}-"
+                    f"bound**.\n"
+                )
     print(f"wrote {jl} and {md}")
 
 
@@ -302,21 +523,25 @@ def main():
     only = set(args.only.split(",")) if args.only else None
 
     results = []
+    perf_rows = []
     if only is None or "demo" in only:
-        bench_demo(results)
+        bench_demo(results, perf_rows)
         print(json.dumps(results[-1]))
     if only is None or "epsilon" in only:
-        bench_epsilon(results, args.quick)
-        for r in results[-2:]:
+        bench_epsilon(results, perf_rows, args.quick)
+        for r in results[-3:]:
             print(json.dumps(r))
     if only is None or "rcv1" in only:
-        bench_rcv1(results, args.quick)
+        bench_rcv1(results, perf_rows, args.quick)
         for r in results[-3:]:
             print(json.dumps(r))
     if only is None or "lasso" in only:
-        bench_lasso(results, args.quick)
+        bench_lasso(results, perf_rows, args.quick)
         print(json.dumps(results[-1]))
-    write_results(results, os.path.dirname(os.path.abspath(__file__)),
+    for r in perf_rows:
+        print(json.dumps({"type": "perf", **r}))
+    write_results(results, perf_rows,
+                  os.path.dirname(os.path.abspath(__file__)),
                   partial=args.quick or only is not None)
     return 0
 

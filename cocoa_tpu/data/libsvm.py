@@ -135,5 +135,9 @@ def load_libsvm(path: str, num_features: int, prefer_native: bool = True) -> Lib
         from cocoa_tpu.data import native_loader
 
         if native_loader.available():
-            return _validate(native_loader.parse_file(path, num_features), path)
+            data = native_loader.parse_file(path, num_features)
+            if data is not None:
+                return _validate(data, path)
+            # None: the path can't be mmap'd (missing or non-regular) —
+            # the Python parser owns those cases (clean OSError / pipes)
     return _validate(load_libsvm_python(path, num_features), path)

@@ -79,25 +79,25 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(_SO_PATH)
-        lib.cocoa_parse_libsvm.restype = ctypes.c_void_p
-        lib.cocoa_parse_libsvm.argtypes = [ctypes.c_char_p]
-        lib.cocoa_parsed_n.restype = ctypes.c_int64
-        lib.cocoa_parsed_n.argtypes = [ctypes.c_void_p]
-        lib.cocoa_parsed_nnz.restype = ctypes.c_int64
-        lib.cocoa_parsed_nnz.argtypes = [ctypes.c_void_p]
-        lib.cocoa_parsed_fill.restype = None
-        lib.cocoa_parsed_fill.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_double),  # labels (n)
-            ctypes.POINTER(ctypes.c_int64),   # indptr (n+1)
-            ctypes.POINTER(ctypes.c_int32),   # indices (nnz)
-            ctypes.POINTER(ctypes.c_double),  # values (nnz)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.cocoa_libsvm_count.restype = ctypes.c_int
+        lib.cocoa_libsvm_count.argtypes = [ctypes.c_char_p, i64p, i64p]
+        lib.cocoa_libsvm_parse.restype = ctypes.c_int
+        lib.cocoa_libsvm_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_double),  # labels (cap_rows)
+            i64p,                             # indptr (cap_rows + 1)
+            ctypes.POINTER(ctypes.c_int32),   # indices (cap_pairs)
+            ctypes.POINTER(ctypes.c_double),  # values (cap_pairs)
+            ctypes.c_int64,                   # cap_rows
+            ctypes.c_int64,                   # cap_pairs
+            i64p,                             # actual rows out
+            i64p,                             # actual pairs out
         ]
-        lib.cocoa_parsed_free.restype = None
-        lib.cocoa_parsed_free.argtypes = [ctypes.c_void_p]
     except (OSError, AttributeError):
-        # corrupt/incompatible .so (e.g. an interrupted foreign build):
-        # honor the fallback contract — the Python parser takes over
+        # corrupt/incompatible .so (e.g. an interrupted foreign build, or
+        # one with the pre-two-pass ABI): honor the fallback contract —
+        # the Python parser takes over
         return None
     _lib = lib
     return lib
@@ -108,32 +108,47 @@ def available() -> bool:
 
 
 def parse_file(path: str, num_features: int) -> Optional[LibsvmData]:
-    """Parse via the C++ library; None when the library is not built."""
+    """Parse via the C++ library; None when the library is not built or the
+    path cannot be mmap'd (missing / non-regular file — the Python parser
+    owns those cases).
+
+    Two passes (see native/libsvm_parser.cpp): a memchr count pass bounds
+    the row/pair counts, numpy buffers are allocated ONCE at those bounds,
+    and the parse writes directly into them — no intermediate growable
+    buffers, no copy-out, so peak RSS is ~the parsed arrays alone even at
+    multi-GB input sizes (np.empty pages materialize only as the parser
+    writes them)."""
     lib = _load()
     if lib is None:
         return None
-    handle = lib.cocoa_parse_libsvm(path.encode())
-    if not handle:
-        raise IOError(f"native parser failed to open {path}")
-    try:
-        n = lib.cocoa_parsed_n(handle)
-        nnz = lib.cocoa_parsed_nnz(handle)
-        labels = np.empty(n, dtype=np.float64)
-        indptr = np.empty(n + 1, dtype=np.int64)
-        indices = np.empty(max(nnz, 1), dtype=np.int32)
-        values = np.empty(max(nnz, 1), dtype=np.float64)
-        lib.cocoa_parsed_fill(
-            handle,
-            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        )
-    finally:
-        lib.cocoa_parsed_free(handle)
+    rows_b, pairs_b = ctypes.c_int64(), ctypes.c_int64()
+    if lib.cocoa_libsvm_count(path.encode(), ctypes.byref(rows_b),
+                              ctypes.byref(pairs_b)) != 0:
+        return None
+    nb, zb = rows_b.value, pairs_b.value
+    labels = np.empty(max(nb, 1), dtype=np.float64)
+    indptr = np.empty(nb + 2, dtype=np.int64)
+    indices = np.empty(max(zb, 1), dtype=np.int32)
+    values = np.empty(max(zb, 1), dtype=np.float64)
+    rows, pairs = ctypes.c_int64(), ctypes.c_int64()
+    rc = lib.cocoa_libsvm_parse(
+        path.encode(),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(max(nb, 1)), ctypes.c_int64(max(zb, 1)),
+        ctypes.byref(rows), ctypes.byref(pairs),
+    )
+    if rc != 0:
+        # -1: file vanished between the passes; 1: it GREW past the counted
+        # capacities (truncated output) — either way the Python parser owns
+        # the racing-writer case
+        return None
+    n, nnz = rows.value, pairs.value
     return LibsvmData(
-        labels=labels,
-        indptr=indptr,
+        labels=labels[:n],
+        indptr=indptr[:n + 1],
         indices=indices[:nnz],
         values=values[:nnz],
         num_features=num_features,

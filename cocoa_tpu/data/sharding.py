@@ -159,6 +159,83 @@ except ValueError:
     pass  # already registered (module re-imported/reloaded)
 
 
+def _build_shard_slabs(data, lo, hi, n_shard, layout, np_dtype, d, width,
+                       row_nnz, row_sq) -> dict:
+    """One shard's padded host arrays (rows [lo, hi) of ``data``)."""
+    m = hi - lo
+    labels = np.zeros(n_shard, np_dtype)
+    labels[:m] = data.labels[lo:hi]
+    mask = np.zeros(n_shard, np_dtype)
+    mask[:m] = 1.0
+    sq = np.zeros(n_shard, np_dtype)
+    sq[:m] = row_sq[lo:hi]
+    out = dict(labels=labels, mask=mask, sq_norms=sq)
+    a, b = data.indptr[lo], data.indptr[hi]
+    rows = np.repeat(np.arange(m), row_nnz[lo:hi])
+    if layout == "dense":
+        X = np.zeros((n_shard, d), np_dtype)
+        X[rows, data.indices[a:b]] = data.values[a:b]
+        out["X"] = X
+    else:
+        cols = np.arange(a, b) - np.repeat(data.indptr[lo:hi], row_nnz[lo:hi])
+        spi = np.zeros((n_shard, width), np.int32)
+        spv = np.zeros((n_shard, width), np_dtype)
+        spi[rows, cols] = data.indices[a:b]
+        spv[rows, cols] = data.values[a:b]
+        out["sp_indices"] = spi
+        out["sp_values"] = spv
+    return out
+
+
+def _shard_dataset_distributed(data, k, layout, np_dtype, mesh, sizes,
+                               offsets, n_shard, d, width, row_nnz,
+                               row_sq) -> ShardedDataset:
+    """Multi-process assembly: each process materializes ONLY the shards
+    whose dp mesh position is one of its own devices, then the global
+    (K, ...) arrays are assembled from the per-device pieces
+    (``jax.make_array_from_single_device_arrays``) — per-process host
+    memory stays ~1/P of the dense matrix instead of P full copies
+    (VERDICT r1 item 5; the reference reads only local HDFS blocks per
+    executor, OptUtils.scala:14).  dp-only meshes (the fp extension keeps
+    the replicated-assembly path)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dev_grid = np.asarray(mesh.devices).reshape(k, -1)
+    me = jax.process_index()
+    local = {s: dev_grid[s, 0] for s in range(k)
+             if dev_grid[s, 0].process_index == me}
+    built = {
+        s: _build_shard_slabs(data, offsets[s], offsets[s + 1], n_shard,
+                              layout, np_dtype, d, width, row_nnz, row_sq)
+        for s in local
+    }
+
+    def assemble(field, trailing):
+        sh = NamedSharding(mesh, P(mesh_lib.DP_AXIS, *([None] * len(trailing))))
+        pieces = [jax.device_put(built[s][field][None], dev)
+                  for s, dev in local.items()]
+        return jax.make_array_from_single_device_arrays(
+            (k, *trailing), sh, pieces
+        )
+
+    kwargs: dict = {}
+    if layout == "dense":
+        kwargs["X"] = assemble("X", (n_shard, d))
+    else:
+        kwargs["sp_indices"] = assemble("sp_indices", (n_shard, width))
+        kwargs["sp_values"] = assemble("sp_values", (n_shard, width))
+    return ShardedDataset(
+        layout=layout,
+        n=data.n,
+        num_features=d,
+        counts=sizes.astype(np.int64),
+        labels=assemble("labels", (n_shard,)),
+        mask=assemble("mask", (n_shard,)),
+        sq_norms=assemble("sq_norms", (n_shard,)),
+        **kwargs,
+    )
+
+
 def shard_dataset(
     data: LibsvmData,
     k: int,
@@ -171,6 +248,10 @@ def shard_dataset(
 
     ``layout="auto"`` picks sparse when the density nnz/(n*d) is below 10%
     (rcv1-like) and dense otherwise (epsilon-like).
+
+    Multi-process runs (``jax.process_count() > 1`` with a dp mesh)
+    materialize only each process's own shards host-side — see
+    :func:`_shard_dataset_distributed`.
     """
     n, d = data.n, data.num_features
     if layout == "auto":
@@ -193,12 +274,33 @@ def shard_dataset(
     offsets = np.concatenate([[0], np.cumsum(sizes)])
     n_shard = pad_rows(int(sizes.max())) if k > 0 else 0
 
+    row_nnz = np.diff(data.indptr)
+    row_sq = segment_sq_norms(data.values, data.indptr)
+    width = 0
+    if layout == "sparse":
+        width = int(max_nnz if max_nnz is not None
+                    else max(1, row_nnz.max(initial=1)))
+        if n and int(row_nnz.max(initial=0)) > width:
+            raise ValueError(
+                f"row nnz {int(row_nnz.max())} exceeds max_nnz {width}"
+            )
+
+    if (
+        mesh is not None
+        and jax.process_count() > 1
+        and not mesh_lib.has_fp(mesh)
+        and mesh.devices.size == k
+    ):
+        return _shard_dataset_distributed(
+            data, k, layout, np_dtype, mesh, sizes, offsets, n_shard,
+            # mirror the replicated path: only the dense layout pads d
+            mesh_lib.pad_features(d, mesh) if layout == "dense" else d,
+            width, row_nnz, row_sq,
+        )
+
     labels = np.zeros((k, n_shard), dtype=np_dtype)
     mask = np.zeros((k, n_shard), dtype=np_dtype)
     sq_norms = np.zeros((k, n_shard), dtype=np_dtype)
-
-    row_nnz = np.diff(data.indptr)
-    row_sq = segment_sq_norms(data.values, data.indptr)
     for s in range(k):
         lo, hi = offsets[s], offsets[s + 1]
         m = hi - lo
@@ -217,11 +319,6 @@ def shard_dataset(
             X[s][rows, data.indices[a:b]] = data.values[a:b]
         kwargs["X"] = X
     else:
-        width = int(max_nnz if max_nnz is not None else max(1, row_nnz.max(initial=1)))
-        if n and int(row_nnz.max(initial=0)) > width:
-            raise ValueError(
-                f"row nnz {int(row_nnz.max())} exceeds max_nnz {width}"
-            )
         sp_idx = np.zeros((k, n_shard, width), dtype=np.int32)
         sp_val = np.zeros((k, n_shard, width), dtype=np_dtype)
         for s in range(k):

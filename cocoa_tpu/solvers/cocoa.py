@@ -456,7 +456,8 @@ def run_sdca_family(
                               sampler.chunk_indices(t0, c), shard_arrays)
 
         cache_key = (
-            "sdca", alg_name, alg, math, pallas, block_size, k, mesh,
+            "sdca", alg_name, alg, math, pallas, block_size, block_chain,
+            k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,
             params.num_rounds, debug.debug_iter, start_round,

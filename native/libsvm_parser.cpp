@@ -7,23 +7,67 @@
 //     (OptUtils.scala:35-37)
 //   - 1-based idx:val pairs -> 0-based indices (OptUtils.scala:42)
 //
-// Exposed through a tiny C ABI consumed via ctypes
-// (cocoa_tpu/data/native_loader.py): parse -> query sizes -> fill
-// caller-allocated numpy buffers -> free.
+// Two-pass C ABI consumed via ctypes (cocoa_tpu/data/native_loader.py):
+//
+//   cocoa_libsvm_count(path, &rows, &pairs)  -> upper bounds ('\n' and ':'
+//                                               counts; cheap memchr scan)
+//   cocoa_libsvm_parse(path, labels, indptr, indices, values,
+//                      cap_rows, cap_pairs,
+//                      &rows, &pairs)        -> writes DIRECTLY into the
+//                                               caller-allocated (numpy)
+//                                               buffers, never past the
+//                                               given capacities; outputs
+//                                               actual row/pair counts
+//
+// Memory strategy (multi-GB inputs; see native/README.md): the file is
+// mmap'd read-only and parsed in place — no text copy, no intermediate
+// growable buffers, no copy-out — with MADV_SEQUENTIAL readahead, and
+// each consumed 16 MB window released with MADV_DONTNEED so resident text
+// stays bounded regardless of file size.  The parse never writes to the
+// mapping (the classic '\0'-at-eol trick would COW-dirty every page);
+// number parsing is bounded per line instead, and a final line without a
+// trailing newline is bounced through a small NUL-terminated copy so
+// strtod can never read past the mapping.  Peak RSS is therefore ~the
+// parsed CSR arrays alone (~0.8x the text for typical idx:val widths).
+// Non-regular files (pipes) are rejected (count returns -1) — the Python
+// parser handles those.
 
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
-#include <vector>
+
+#ifndef _WIN32
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#endif
+
+#ifndef __GLIBC__
+// memrchr is a GNU extension; portable fallback for other libcs (macOS)
+static const void* cocoa_memrchr(const void* s, int c, size_t n) {
+  const unsigned char* p = static_cast<const unsigned char*>(s);
+  while (n--) {
+    if (p[n] == static_cast<unsigned char>(c)) return p + n;
+  }
+  return nullptr;
+}
+#define memrchr cocoa_memrchr
+#endif
 
 namespace {
 
-struct Parsed {
-  std::vector<double> labels;
-  std::vector<int64_t> indptr;
-  std::vector<int32_t> indices;
-  std::vector<double> values;
+struct Sink {
+  double* labels;
+  int64_t* indptr;
+  int32_t* indices;
+  double* values;
+  int64_t cap_rows;   // hard bounds: a file that GROWS between the count
+  int64_t cap_pairs;  // and parse passes must truncate, never overflow
+  int64_t rows = 0;
+  int64_t pairs = 0;
+  bool truncated = false;
 };
 
 // Label rule per OptUtils.scala:35-37 ('+' anywhere in the token, or the
@@ -37,46 +81,27 @@ double parse_label(const char* tok, const char* end) {
   return (stop != tok && v == 1.0) ? 1.0 : -1.0;
 }
 
-}  // namespace
-
-extern "C" {
-
-void* cocoa_parse_libsvm(const char* path) {
-  FILE* f = fopen(path, "rb");
-  if (!f) return nullptr;
-
-  // read whole file (datasets at this scale fit host RAM comfortably;
-  // epsilon ~12GB text would want mmap, a TODO noted in native/README)
-  fseek(f, 0, SEEK_END);
-  long size = ftell(f);
-  fseek(f, 0, SEEK_SET);
-  char* buf = static_cast<char*>(malloc(size + 1));
-  if (!buf || fread(buf, 1, size, f) != static_cast<size_t>(size)) {
-    fclose(f);
-    free(buf);
-    return nullptr;
-  }
-  fclose(f);
-  buf[size] = '\0';
-
-  auto* out = new Parsed();
-  out->indptr.push_back(0);
-
-  char* p = buf;
-  char* fend = buf + size;
+// Parse the lines in [p, fend) into the sink.  Every line in the region
+// MUST be newline-terminated or the region itself NUL-terminated (the
+// caller guarantees one or the other): strtol/strtod stop at '\n'
+// naturally, and the per-pair loop never starts a number at or past the
+// line end, so the parse cannot escape the region.
+void parse_region(const char* p, const char* fend, Sink* out) {
   while (p < fend) {
-    // find end of line
-    char* eol = static_cast<char*>(memchr(p, '\n', fend - p));
+    if (out->rows >= out->cap_rows) {
+      out->truncated = true;
+      return;
+    }
+    const char* eol = static_cast<const char*>(memchr(p, '\n', fend - p));
     if (!eol) eol = fend;
-    *eol = '\0';
 
     // skip leading spaces; blank lines are skipped entirely
-    while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+    while (p < eol && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
     if (p < eol) {
       // label token ends at first space
-      char* sp = p;
+      const char* sp = p;
       while (sp < eol && *sp != ' ' && *sp != '\t') ++sp;
-      out->labels.push_back(parse_label(p, sp));
+      out->labels[out->rows] = parse_label(p, sp);
 
       // idx:val pairs
       p = sp;
@@ -85,41 +110,168 @@ void* cocoa_parse_libsvm(const char* path) {
         if (p >= eol) break;
         char* stop = nullptr;
         long idx = strtol(p, &stop, 10);
-        if (stop == p || *stop != ':') break;  // malformed tail: stop row
+        if (stop == p || stop >= eol || *stop != ':') break;  // malformed
         p = stop + 1;
+        if (p >= eol) break;  // "idx:" at line end: malformed tail
         double val = strtod(p, &stop);
         if (stop == p) break;
         p = stop;
-        out->indices.push_back(static_cast<int32_t>(idx - 1));  // 1->0 based
-        out->values.push_back(val);
+        if (out->pairs >= out->cap_pairs) {
+          out->truncated = true;
+          break;
+        }
+        out->indices[out->pairs] = static_cast<int32_t>(idx - 1);  // 1->0
+        out->values[out->pairs] = val;
+        ++out->pairs;
       }
-      out->indptr.push_back(static_cast<int64_t>(out->indices.size()));
+      ++out->rows;
+      out->indptr[out->rows] = out->pairs;
     }
     p = eol + 1;
   }
-
-  free(buf);
-  return out;
 }
 
-int64_t cocoa_parsed_n(void* handle) {
-  return static_cast<Parsed*>(handle)->labels.size();
+#ifndef _WIN32
+struct Mapping {
+  char* buf = nullptr;
+  size_t size = 0;
+  bool ok = false;
+};
+
+Mapping map_file(const char* path) {
+  Mapping m;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return m;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || !S_ISREG(st.st_mode)) {
+    close(fd);
+    return m;
+  }
+  m.size = static_cast<size_t>(st.st_size);
+  if (m.size == 0) {
+    close(fd);
+    m.ok = true;  // empty regular file: zero rows, valid
+    return m;
+  }
+  m.buf = static_cast<char*>(
+      mmap(nullptr, m.size, PROT_READ, MAP_PRIVATE, fd, 0));
+  close(fd);
+  if (m.buf == MAP_FAILED) {
+    m.buf = nullptr;
+    return m;
+  }
+  m.ok = true;
+  madvise(m.buf, m.size, MADV_SEQUENTIAL);
+  return m;
+}
+#endif
+
+constexpr size_t kWindow = size_t(16) << 20;
+
+}  // namespace
+
+extern "C" {
+
+// Upper-bound counts for buffer allocation: rows <= newlines + 1 (final
+// unterminated line), pairs <= ':' count.  Returns 0 on success, -1 when
+// the file cannot be mmap'd (missing / non-regular — callers fall back).
+int cocoa_libsvm_count(const char* path, int64_t* rows_out,
+                       int64_t* pairs_out) {
+#ifndef _WIN32
+  Mapping m = map_file(path);
+  if (!m.ok) return -1;
+  int64_t colons = 0, newlines = 0;
+  for (size_t off = 0; off < m.size; off += kWindow) {
+    size_t len = m.size - off < kWindow ? m.size - off : kWindow;
+    const char* q = m.buf + off;
+    const char* qe = q + len;
+    while ((q = static_cast<const char*>(memchr(q, ':', qe - q)))) {
+      ++colons;
+      ++q;
+    }
+    q = m.buf + off;
+    while ((q = static_cast<const char*>(memchr(q, '\n', qe - q)))) {
+      ++newlines;
+      ++q;
+    }
+    madvise(m.buf + off, len, MADV_DONTNEED);
+  }
+  if (m.buf) munmap(m.buf, m.size);
+  *rows_out = newlines + 1;
+  *pairs_out = colons;
+  return 0;
+#else
+  (void)path;
+  (void)rows_out;
+  (void)pairs_out;
+  return -1;
+#endif
 }
 
-int64_t cocoa_parsed_nnz(void* handle) {
-  return static_cast<Parsed*>(handle)->indices.size();
+// Parse into caller-allocated buffers sized from cocoa_libsvm_count:
+// labels (cap_rows), indptr (cap_rows + 1), indices/values (cap_pairs).
+// Writes the ACTUAL row/pair counts (<= the capacities).  Returns 0 on
+// success, 1 when the file outgrew the capacities between the two passes
+// (output truncated — callers should fall back or retry), -1 on open
+// failure.
+int cocoa_libsvm_parse(const char* path, double* labels, int64_t* indptr,
+                       int32_t* indices, double* values, int64_t cap_rows,
+                       int64_t cap_pairs, int64_t* rows_out,
+                       int64_t* pairs_out) {
+#ifndef _WIN32
+  Mapping m = map_file(path);
+  if (!m.ok) return -1;
+  Sink sink{labels, indptr, indices, values, cap_rows, cap_pairs};
+  sink.indptr[0] = 0;
+  if (m.size) {
+    const char* fend = m.buf + m.size;
+    const char* last_nl =
+        static_cast<const char*>(memrchr(m.buf, '\n', m.size));
+    const char* main_end = last_nl ? last_nl + 1 : m.buf;
+    const char* p = m.buf;
+    // windowed parse of the newline-terminated body; release consumed text
+    while (p < main_end) {
+      const char* wend = p + kWindow;
+      if (wend >= main_end) {
+        wend = main_end;
+      } else {
+        wend = static_cast<const char*>(memrchr(p, '\n', wend - p));
+        wend = wend ? wend + 1 : main_end;  // pathological: one huge line
+      }
+      parse_region(p, wend, &sink);
+      // drop only the newly-consumed pages (page-aligned inner range)
+      const long page = sysconf(_SC_PAGESIZE);
+      uintptr_t lo = (reinterpret_cast<uintptr_t>(p) + page - 1)
+                     / page * page;
+      uintptr_t hi = reinterpret_cast<uintptr_t>(wend) / page * page;
+      if (hi > lo)
+        madvise(reinterpret_cast<void*>(lo), hi - lo, MADV_DONTNEED);
+      p = wend;
+    }
+    // tail: a final line with no trailing newline could make strtod read
+    // one byte past the mapping (exact-page-multiple files) — bounce it
+    // through a small NUL-terminated copy
+    if (main_end < fend) {
+      size_t tail = fend - main_end;
+      char* tbuf = static_cast<char*>(malloc(tail + 1));
+      if (tbuf) {
+        memcpy(tbuf, main_end, tail);
+        tbuf[tail] = '\0';
+        parse_region(tbuf, tbuf + tail, &sink);
+        free(tbuf);
+      }
+    }
+    munmap(m.buf, m.size);
+  }
+  *rows_out = sink.rows;
+  *pairs_out = sink.pairs;
+  return sink.truncated ? 1 : 0;
+#else
+  (void)path; (void)labels; (void)indptr; (void)indices; (void)values;
+  (void)cap_rows; (void)cap_pairs;
+  (void)rows_out; (void)pairs_out;
+  return -1;
+#endif
 }
-
-void cocoa_parsed_fill(void* handle, double* labels, int64_t* indptr,
-                       int32_t* indices, double* values) {
-  auto* parsed = static_cast<Parsed*>(handle);
-  memcpy(labels, parsed->labels.data(), parsed->labels.size() * sizeof(double));
-  memcpy(indptr, parsed->indptr.data(), parsed->indptr.size() * sizeof(int64_t));
-  memcpy(indices, parsed->indices.data(),
-         parsed->indices.size() * sizeof(int32_t));
-  memcpy(values, parsed->values.data(), parsed->values.size() * sizeof(double));
-}
-
-void cocoa_parsed_free(void* handle) { delete static_cast<Parsed*>(handle); }
 
 }  // extern "C"

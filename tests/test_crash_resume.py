@@ -7,6 +7,8 @@ run (round-indexed RNG makes the resumed trajectory bit-identical)."""
 
 import os
 import signal
+
+import pytest
 import subprocess
 import sys
 import time
@@ -38,6 +40,7 @@ def _summary(out: str):
             if "Total Objective" in ln or "Duality Gap" in ln]
 
 
+@pytest.mark.slow
 def test_sigkill_then_resume_matches_uninterrupted(tmp_path):
     ck = str(tmp_path / "ck")
     os.makedirs(ck)

@@ -95,6 +95,7 @@ def test_dist_gd_chunked_on_mesh_matches_local(tiny_data):
     np.testing.assert_allclose(np.asarray(w1), np.asarray(w0), atol=1e-12)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("layout", ["dense", "sparse"])
 def test_mbcd_device_paths_match(tiny_data, layout):
     """Mini-batch CD through the shared SDCA driver: chunked, device-loop,

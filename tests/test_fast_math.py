@@ -88,6 +88,7 @@ def test_pallas_interpret_matches_xla_fast(tiny_data, mode, sigma):
                                    np.asarray(da), atol=1e-14)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0), ("frozen", 1.0)])
 def test_pallas_sparse_interpret_matches_xla_fast(tiny_data, mode, sigma):
     """The sparse (padded-CSR) kernel — in-kernel margins, SMEM feature
@@ -123,6 +124,7 @@ def test_pallas_sparse_interpret_matches_xla_fast(tiny_data, mode, sigma):
                                    np.asarray(da), atol=1e-13)
 
 
+@pytest.mark.slow
 def test_pallas_sparse_solver_end_to_end_interpret(tiny_data):
     """Full CoCoA+ run through the sparse Pallas kernel (interpret mode,
     chunked driver) tracks the fori_loop fast path."""
@@ -137,6 +139,7 @@ def test_pallas_sparse_solver_end_to_end_interpret(tiny_data):
     np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_f), atol=1e-10)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("unroll", [1, 2, 4, 8])
 def test_pallas_unroll_invariant(tiny_data, unroll):
     """The step-group size S is a pure DMA-batching knob: every S must
@@ -185,6 +188,7 @@ def test_fast_solver_converges_like_exact(tiny_data, plus):
     assert gap_f >= -1e-12
 
 
+@pytest.mark.slow
 def test_pallas_solver_end_to_end_interpret(tiny_data):
     """Full CoCoA+ run through the Pallas kernel (interpret mode, chunked
     driver, single-chip path) tracks the exact solver."""
@@ -215,6 +219,7 @@ def test_fast_math_on_mesh_without_pallas(tiny_data, scan):
     assert tm.records[-1].gap == pytest.approx(tl.records[-1].gap, abs=1e-12)
 
 
+@pytest.mark.slow
 def test_pallas_mesh_per_round_driver_reroutes(tiny_data):
     """pallas on a mesh with scan_chunk=0 must not crash (regression: it is
     rerouted through the chunked driver)."""
@@ -234,6 +239,7 @@ def test_math_flag_validated(tiny_data):
                   math="fas")
 
 
+@pytest.mark.slow
 def test_pallas_mesh_equals_local(tiny_data):
     """Pallas kernel inside shard_map (4-device mesh) == single-chip path."""
     k = 4

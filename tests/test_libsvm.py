@@ -1,10 +1,14 @@
 """Parser golden tests against the bundled reference data
 (/root/reference/data, SURVEY.md §4)."""
 
+import os
+
 import numpy as np
 import pytest
 
 from cocoa_tpu.data.libsvm import _parse_label, load_libsvm_python
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_small_train_shape_and_labels(small_train):
@@ -72,3 +76,49 @@ def test_python_parser_is_fallback_identical(small_train):
     np.testing.assert_array_equal(py.indptr, small_train.indptr)
     np.testing.assert_array_equal(py.indices, small_train.indices)
     np.testing.assert_array_equal(py.values, small_train.values)
+
+
+@pytest.mark.slow
+def test_native_parse_memory_bounded(tmp_path):
+    """native/README.md memory contract: the native parser's RSS delta on
+    a big file stays under 1.2x the text size (mmap + windowed
+    MADV_DONTNEED + direct-into-numpy two-pass parse; the parsed CSR
+    arrays alone are ~0.85x at this nnz density).  Delta, not absolute:
+    the interpreter + jax baseline is not the parser's footprint."""
+    import subprocess
+    import sys
+
+    from cocoa_tpu.data import native_loader
+
+    if not native_loader.available():
+        pytest.skip("native parser not built and no toolchain")
+
+    path = tmp_path / "big.svm"
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(1000):
+        idx = np.sort(rng.choice(40000, 75, replace=False)) + 1
+        vals = rng.standard_normal(75)
+        rows.append(("+1" if i % 2 else "-1") + " " +
+                    " ".join(f"{a}:{v:.6f}" for a, v in zip(idx, vals)))
+    block = ("\n".join(rows) + "\n").encode()
+    with path.open("wb") as f:
+        written = 0
+        while written < (80 << 20):
+            f.write(block)
+            written += len(block)
+    size = path.stat().st_size
+    code = f"""
+import resource, sys
+sys.path.insert(0, {str(ROOT)!r})
+from cocoa_tpu.data import native_loader
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+d = native_loader.parse_file({str(path)!r}, 40001)
+assert d is not None and d.n > 0
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+print(peak - base)
+"""
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, check=True)
+    delta = int(out.stdout.strip())
+    assert delta < 1.2 * size, (delta, size)

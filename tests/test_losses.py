@@ -159,6 +159,7 @@ def test_fast_math_matches_exact_each_loss(tiny_data, loss):
     np.testing.assert_allclose(np.asarray(a_f), np.asarray(a_e), atol=1e-8)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("loss", ["smooth_hinge", "logistic"])
 def test_pallas_interpret_matches_fast_each_loss(tiny_data, loss):
     ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=np.float64)

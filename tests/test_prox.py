@@ -90,6 +90,7 @@ def test_prox_matches_oracle_exact():
     np.testing.assert_allclose(np.asarray(r)[:len(b)], r_o, atol=1e-12)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("l2", [0.0, 0.3])
 def test_prox_fast_and_paths_match_exact(l2):
     A, b, _, data = _problem(seed=1)
@@ -109,6 +110,7 @@ def test_prox_fast_and_paths_match_exact(l2):
                                    err_msg=str(kw))
 
 
+@pytest.mark.slow
 def test_prox_sparse_columns_match_dense():
     """The padded-CSC column layout must produce exactly the dense column
     layout's trajectory, on both the fori paths and the sparse Pallas
@@ -137,6 +139,7 @@ def test_shard_columns_rejects_degenerate_csc():
         shard_columns(data, K, layout="sparse", max_col_nnz=2)
 
 
+@pytest.mark.slow
 def test_prox_mesh_matches_local():
     A, b, _, data = _problem(seed=2)
     d = data.num_features

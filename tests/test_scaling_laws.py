@@ -169,6 +169,7 @@ def test_fast_math_gamma_off_fixed_point(tiny_data):
     np.testing.assert_allclose(np.asarray(w), w_o, rtol=1e-6, atol=1e-8)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("layout", ["dense", "sparse"])
 def test_pallas_gamma_off_fixed_point(tiny_data, layout):
     """The Pallas kernels (interpret mode on CPU) must agree with the
