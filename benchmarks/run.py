@@ -471,8 +471,12 @@ def write_results(results, perf_rows, out_dir, partial=False):
                 "lane padding).  MFU is against the chip's public dense "
                 "bf16 peak — a conservative lower bound for f32 work.  "
                 "Times include the per-`debugIter` eval amortized in, and "
-                "a fixed ~0.1-0.3 s dispatch+fetch cost of the tunneled "
-                "device spread over the run's rounds.\n\n"
+                "the tunneled device's dispatch+fetch overhead — hundreds "
+                "of ms to several seconds, varying run to run — spread "
+                "over the run's rounds, which can dominate ms_per_round "
+                "at small round counts; benchmarks/KERNELS.md carries the "
+                "slope-measured per-round kernel times with that overhead "
+                "cancelled.\n\n"
             )
             pcols = ["config", "device", "ms_per_round", "us_per_step",
                      "useful_gflops", "physical_gflops", "mfu_pct",
