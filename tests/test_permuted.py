@@ -1,0 +1,128 @@
+"""Random-reshuffling sampler (``--rng=permuted``).
+
+A flag-gated deviation from the reference's with-replacement draws
+(CoCoA.scala:151): each shard walks a fresh per-epoch permutation.  The
+contract tested here: exact epoch coverage (every coordinate exactly once
+per n_local draws, across round and epoch boundaries), determinism and
+chunking-invariance (what makes checkpoint/resume exact), decorrelation
+across shards, end-to-end solver validity (the duality-gap certificate is
+index-stream-independent), and the convergence advantage that justifies
+the mode's existence.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.solvers import run_cocoa
+from cocoa_tpu.solvers.base import IndexSampler
+
+
+def test_epoch_coverage_exact():
+    """Unequal shard sizes, H crossing epoch boundaries mid-round: every
+    epoch's draws for a shard are a permutation of range(count)."""
+    counts = np.array([7, 13, 16])
+    h = 5
+    s = IndexSampler("permuted", seed=3, h=h, counts=counts)
+    tab = np.asarray(s.chunk_indices(1, 40))          # (40, 3, 5)
+    for k, cnt in enumerate(counts):
+        stream = tab[:, k, :].reshape(-1)
+        n_epochs = len(stream) // cnt
+        for e in range(n_epochs):
+            ep = stream[e * cnt:(e + 1) * cnt]
+            np.testing.assert_array_equal(np.sort(ep), np.arange(cnt))
+
+
+def test_chunking_invariance_and_determinism():
+    """The stream is a pure function of (seed, shard, global step): any
+    chunking, any starting round, same tables — resume is exact."""
+    counts = np.array([10, 10])
+    s1 = IndexSampler("permuted", seed=5, h=7, counts=counts)
+    s2 = IndexSampler("permuted", seed=5, h=7, counts=counts)
+    whole = np.asarray(s1.chunk_indices(1, 12))
+    split = np.concatenate([
+        np.asarray(s2.chunk_indices(1, 5)),
+        np.asarray(s2.chunk_indices(6, 4)),
+        np.asarray(s2.chunk_indices(10, 3)),
+    ])
+    np.testing.assert_array_equal(split, whole)
+    # different seed, different stream
+    s3 = IndexSampler("permuted", seed=6, h=7, counts=counts)
+    assert not np.array_equal(np.asarray(s3.chunk_indices(1, 12)), whole)
+
+
+def test_shards_decorrelated():
+    counts = np.array([64, 64, 64, 64])
+    s = IndexSampler("permuted", seed=0, h=64, counts=counts)
+    tab = np.asarray(s.chunk_indices(1, 1))[0]        # (4, 64)
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert not np.array_equal(tab[a], tab[b])
+
+
+def test_solver_end_to_end_and_certificate(tiny_data):
+    """run_cocoa with rng='permuted': gap certified, α in box, and the
+    host and device-loop paths agree (the tables ride the same chunked
+    machinery as the other modes)."""
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = Params(n=tiny_data.n, num_rounds=20, local_iters=20, lam=0.01)
+    dbg = DebugParams(debug_iter=10, seed=0)
+    w, a, traj = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                           rng="permuted")
+    gaps = [r.gap for r in traj.records]
+    assert all(g >= -1e-12 for g in gaps)
+    assert gaps[-1] < gaps[0]
+    assert float(jnp.min(a)) >= 0.0 and float(jnp.max(a)) <= 1.0
+    w2, a2, traj2 = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                              rng="permuted", math="fast",
+                              device_loop=True)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(w),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_reshuffling_converges_faster(tiny_data):
+    """The reason the mode exists: on the same problem and budget the
+    reshuffled stream's duality gap beats with-replacement sampling.
+    (Deterministic given the fixed seeds — not a flaky statistical
+    assertion; the epsilon-scale measurement is 20 vs 100 rounds.)"""
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = Params(n=tiny_data.n, num_rounds=15, local_iters=20, lam=0.01)
+    dbg = DebugParams(debug_iter=15, seed=0)
+    _, _, t_ref = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                            rng="reference")
+    _, _, t_perm = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                             rng="permuted")
+    assert t_perm.records[-1].gap < t_ref.records[-1].gap
+
+
+def test_permuted_with_block_kernel(tiny_data):
+    """Composes with the block-coordinate inner solver (duplicates within
+    a block are impossible inside one epoch, but blocks CROSS epoch
+    boundaries where repeats do occur — the equality tiles handle it)."""
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    p = Params(n=tiny_data.n, num_rounds=10, local_iters=20, lam=0.01)
+    dbg = DebugParams(debug_iter=10, seed=0)
+    w_f, _, tf = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                           rng="permuted", math="fast")
+    w_b, _, tb = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                           rng="permuted", math="fast", block_size=8)
+    np.testing.assert_allclose(np.asarray(w_b), np.asarray(w_f),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_cli_rng_permuted(capsys):
+    from cocoa_tpu import cli
+
+    rc = cli.main([
+        "--trainFile=/root/reference/data/small_train.dat",
+        "--numFeatures=9947", "--numSplits=4", "--numRounds=5",
+        "--localIterFrac=0.05", "--lambda=.001", "--justCoCoA=true",
+        "--debugIter=5", "--rng=permuted", "--mesh=1",
+    ])
+    assert rc == 0
+    assert "CoCoA+" in capsys.readouterr().out
+
+    with pytest.raises(ValueError, match="rng mode"):
+        IndexSampler("bogus", 0, 5, np.array([10]))
