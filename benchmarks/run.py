@@ -483,6 +483,22 @@ def bench_lasso(results, perf_rows, quick):
     perf_rows.append(_perf("lasso-proxcocoa+", secs, rec.round, n=d, d=n,
                            k=k, h=h, path="pallas", debug_iter=50))
 
+    def go_perm():
+        return run_prox_cocoa(ds, b, params, debug, quiet=True, math="fast",
+                              device_loop=True, gap_target=1e-3 * p0,
+                              rng="permuted")
+
+    secs_p, (x_p, r_p, traj_p) = _time_warm(go_perm)
+    rec_p = traj_p.records[-1]
+    results.append(dict(
+        config="lasso-proxcocoa+(permuted)", n=n, d=d, k=k, h=h,
+        lam=round(lam, 5), gap_target=f"1e-3 relative",
+        rounds=rec_p.round, gap=float(rec_p.gap),
+        wallclock_s=round(secs_p, 3),
+        vs_oracle=round(rec.round / rate / secs_p, 1),
+        oracle_basis="oracle rounds = reference-mode rounds",
+    ))
+
 
 def write_results(results, perf_rows, out_dir, partial=False):
     """Full runs own results.jsonl / RESULTS.md (the artifacts BASELINE.md

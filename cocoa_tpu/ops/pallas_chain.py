@@ -167,7 +167,10 @@ def chain_block_batched(
     caller's additive scatter — duplicate-safe by construction) and Δw
     coefficients (for the caller's ``coefs·X_B`` apply).  B must be a
     multiple of 128 (whole lane tiles)."""
-    k, _, b = scal.shape
+    k, nrows, b = scal.shape
+    if nrows != SCAL_ROWS:
+        raise ValueError(f"scal must carry {SCAL_ROWS} metric rows, "
+                         f"got {nrows}")
     if b % LANES:
         raise ValueError(f"chain_block_batched needs B % {LANES} == 0, "
                          f"got {b}")
@@ -175,7 +178,7 @@ def chain_block_batched(
         raise ValueError(f"gq shape {gq.shape} does not match frozen={frozen}")
     # (K, 6, B) -> (6K, B) grouped by metric so the kernel's static column
     # slices are [m0_0..m0_K-1 | y_0.. | ...]
-    scal_rows = scal.transpose(1, 0, 2).reshape(6 * k, b)
+    scal_rows = scal.transpose(1, 0, 2).reshape(SCAL_ROWS * k, b)
     kernel = functools.partial(
         _chain_kernel_batched, k=k, b=b, lam_n=lam_n, coef_div=coef_div,
         sig_eff=sig_eff, frozen=frozen,

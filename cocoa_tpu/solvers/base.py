@@ -487,6 +487,7 @@ class IndexSampler:
         self.h = h
         self.counts = np.asarray(counts)
         self._key = None
+        self._perm_cache: dict = {}
         if mode == "jax":
             self._key = jax.random.key(seed)
 
@@ -526,23 +527,40 @@ class IndexSampler:
         coordinate exactly once, resumable from any round."""
         k = self.counts.shape[0]
         out = np.empty((c, k, self.h), np.int32)
-        g = np.arange((t0 - 1) * self.h, (t0 - 1 + c) * self.h)
+        g0, g1 = (t0 - 1) * self.h, (t0 - 1 + c) * self.h
         for s in range(k):
             cnt = int(self.counts[s])
-            epochs = g // cnt
-            pos = g % cnt
-            vals = np.empty(len(g), np.int32)
-            for e in np.unique(epochs):
-                perm = np.random.default_rng(
-                    # SeedSequence rejects negatives; the other modes accept
-                    # any int seed, so mask to keep --seed=-1 etc. working
-                    np.random.SeedSequence(
-                        [self.seed & 0xFFFFFFFF, s, int(e)])
-                ).permutation(cnt).astype(np.int32)
-                m = epochs == e
-                vals[m] = perm[pos[m]]
+            vals = np.empty(g1 - g0, np.int32)
+            # epochs cover contiguous global-step ranges — fill by slices
+            for e in range(g0 // cnt, (g1 - 1) // cnt + 1):
+                perm = self._epoch_perm(s, e, cnt)
+                lo, hi = max(g0, e * cnt), min(g1, (e + 1) * cnt)
+                vals[lo - g0:hi - g0] = perm[lo - e * cnt:hi - e * cnt]
             out[:, s, :] = vals.reshape(c, self.h)
         return out
+
+    def _epoch_perm(self, s: int, e: int, cnt: int) -> np.ndarray:
+        """Deterministic permutation for (seed, shard, epoch), memoized:
+        the host-stepped path consumes each epoch across up to cnt/H
+        chunk_indices calls, and regenerating an O(n_shard) shuffle per
+        call is pure rework.  One entry per shard suffices — streams are
+        consumed sequentially (chunks may straddle two epochs; the newer
+        one wins the cache slot and the older is a one-off regen)."""
+        key = (s, e)
+        perm = self._perm_cache.get(key)
+        if perm is None:
+            perm = np.random.default_rng(
+                # SeedSequence rejects negative entries; mask to the full
+                # 64-bit word so any int seed works (like the other modes)
+                # without collapsing seeds that differ above bit 31
+                np.random.SeedSequence(
+                    [self.seed & 0xFFFFFFFFFFFFFFFF, s, e])
+            ).permutation(cnt).astype(np.int32)
+            self._perm_cache[key] = perm
+            # evict this shard's older epochs (sequential consumption)
+            for old in [o for o in self._perm_cache if o[0] == s and o[1] < e]:
+                del self._perm_cache[old]
+        return perm
 
 
 def drive_device_paths(

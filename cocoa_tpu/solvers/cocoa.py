@@ -125,6 +125,16 @@ def _sdca_round_parts(
     )
     from cocoa_tpu.ops.rows import shard_margins
 
+    def block_round(w, alpha, idxs_kh, shards):
+        """The batched block kernel with this algorithm's parameters — the
+        one call site per_shard (mesh) and per_round_batched (single chip)
+        share."""
+        return local_sdca_block_batched(
+            w, alpha, shards, idxs_kh, params.lam, params.n, mode=mode,
+            sigma=sigma, loss=params.loss, smoothing=params.smoothing,
+            block=block, interpret=(block_chain == "pallas_interpret"),
+        )
+
     def per_shard(w, alpha_k, idxs_k, shard_k):
         if pallas:
             # only reached inside the chunked mesh driver, which runs its
@@ -140,11 +150,9 @@ def _sdca_round_parts(
         if block and block_chain != "xla":
             # single-shard view of the batched block kernel (the mesh path:
             # one shard per device under shard_map, check_vma=False)
-            da, dw = local_sdca_block_batched(
-                w, alpha_k[None], jax.tree.map(lambda a: a[None], shard_k),
-                idxs_k[None], params.lam, params.n, mode=mode, sigma=sigma,
-                loss=params.loss, smoothing=params.smoothing, block=block,
-                interpret=(block_chain == "pallas_interpret"),
+            da, dw = block_round(
+                w, alpha_k[None], idxs_k[None],
+                jax.tree.map(lambda a: a[None], shard_k),
             )
             return dw[0], alpha_k + scaling * da[0]
         m0 = shard_margins(w, shard_k)
@@ -174,12 +182,7 @@ def _sdca_round_parts(
         # Pallas instance — vmap(per_shard) would serialize K kernel
         # instances through the grid instead
         def per_round_batched(w, alpha, idxs_kh, shards):
-            da, dw = local_sdca_block_batched(
-                w, alpha, shards, idxs_kh, params.lam, params.n,
-                mode=mode, sigma=sigma, loss=params.loss,
-                smoothing=params.smoothing, block=block,
-                interpret=(block_chain == "pallas_interpret"),
-            )
+            da, dw = block_round(w, alpha, idxs_kh, shards)
             return dw.sum(axis=0), alpha + scaling * da
 
     return per_shard, per_round_batched, apply_fn

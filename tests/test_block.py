@@ -233,6 +233,43 @@ def test_batched_pallas_chain_matches_fast(tiny_data, mode, sigma, layout):
                                    rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.parametrize("loss,smoothing", [("smooth_hinge", 0.5),
+                                            ("logistic", 1.0)])
+def test_batched_chain_generic_losses(tiny_data, loss, smoothing):
+    """The non-hinge losses ride the chain kernel's generic branch (no
+    algebraic collapse; losses.alpha_step runs on (K, 1) columns in the
+    chain) — must match the sequential fast path."""
+    from cocoa_tpu.ops.local_sdca import local_sdca_block_batched
+
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    sa = ds.shard_arrays()
+    rng = np.random.default_rng(9)
+    d = tiny_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0.01, 0.99)
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 37, ds.counts)[:, 0, :]
+    )
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode="plus", sigma=4.0,
+        loss=loss, smoothing=smoothing, block=128, interpret=True,
+    )
+    for s in range(K):
+        shard = {kk: v[s] for kk, v in sa.items()}
+        m0 = shard_margins(w, shard)
+        da_f, dw_f = local_sdca_fast(
+            m0, alpha[s], shard, idxs[s], 0.01, tiny_data.n,
+            jnp.zeros(d), mode="plus", sigma=4.0, loss=loss,
+            smoothing=smoothing,
+        )
+        np.testing.assert_allclose(np.asarray(da_b[s]), np.asarray(da_f),
+                                   rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(dw_b[s]), np.asarray(dw_f),
+                                   rtol=1e-8, atol=1e-10)
+
+
 def test_batched_chain_zero_norm_row(tiny_data):
     """qii == 0: the compressed hinge chain must reproduce alpha_step's
     projected-gradient outcome (α → 1) for a zero row in the stream."""
