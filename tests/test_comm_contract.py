@@ -72,6 +72,25 @@ def test_sdca_chunk_round_has_exactly_one_psum(tiny_data, math, alg_key):
     assert _census(txt) == {"all_reduce": 2}, _census(txt)
 
 
+@pytest.mark.parametrize("chain", ["xla", "pallas_interpret"])
+def test_block_chunk_round_has_exactly_one_psum(tiny_data, chain):
+    """The block-coordinate inner loop (--blockSize) must not change the
+    census: its gathers, Gram einsums, Pallas chain, and additive alpha
+    scatter are all shard-local — still ONE Δw psum per round."""
+    from cocoa_tpu.solvers.cocoa import _alg_config, _make_chunk_kernel
+
+    mesh = make_mesh(K)
+    ds, w, alpha = _mesh_state(tiny_data, mesh)
+    p = _params(tiny_data)
+    alg = _alg_config(p, K, True)
+    kernel = _make_chunk_kernel(mesh, p, K, alg, math="fast",
+                                block=8 if chain == "xla" else 128,
+                                block_chain=chain)
+    idxs = jnp.zeros((C, K, H), dtype=jnp.int32)
+    txt = jax.jit(kernel).lower(w, alpha, idxs, ds.shard_arrays()).as_text()
+    assert _census(txt) == {"all_reduce": 2}, _census(txt)
+
+
 @pytest.mark.parametrize("local", [True, False])
 def test_sgd_chunk_round_has_exactly_one_psum(tiny_data, local):
     from cocoa_tpu.solvers.sgd import _make_chunk_kernel
