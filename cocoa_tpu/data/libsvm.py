@@ -21,8 +21,26 @@ semantic oracle.
 from __future__ import annotations
 
 import dataclasses
+import re
 
 import numpy as np
+
+# Tokens are delimited by exactly the whitespace set the native parser's
+# is_ws() skips (C-locale isspace minus '\n').  NOT str.split(): that also
+# splits on Unicode whitespace (NBSP, \x1c-\x1f, \x85) the native scanner
+# treats as ordinary junk bytes, which would silently change which pairs a
+# line yields depending on which parser ran.
+_WS_SPLIT = re.compile(r"[ \t\r\v\f]+")
+
+# Shared numeric grammar, enforced on BOTH parsers: plain ASCII decimal
+# (optionally signed, optional fraction/exponent).  Python's int()/float()
+# and C's strtol/strtod each accept extras the other rejects (digit-group
+# underscores and Unicode digits vs. hex floats, "nan(...)", "inf"); the
+# character class below excludes every such form, and within it the two
+# accept exactly the same strings, so token validity cannot depend on
+# which parser happened to be built.
+_INT_CHARS = frozenset("+-0123456789")
+_NUM_CHARS = frozenset("+-.eE0123456789")
 
 
 @dataclasses.dataclass
@@ -63,11 +81,12 @@ class LibsvmData:
 
 
 def _parse_label(token: str) -> float:
-    """Reference label rule (OptUtils.scala:35-37)."""
+    """Reference label rule (OptUtils.scala:35-37), restricted to the
+    shared decimal grammar (a "0x1" label is −1 on both parsers)."""
     if "+" in token:
         return 1.0
     try:
-        if float(token) == 1.0:
+        if _NUM_CHARS.issuperset(token) and float(token) == 1.0:
             return 1.0
     except ValueError:
         pass
@@ -75,27 +94,56 @@ def _parse_label(token: str) -> float:
 
 
 def load_libsvm_python(path: str, num_features: int) -> LibsvmData:
-    """Pure-Python reference parser (semantic oracle for the native one)."""
+    """Pure-Python reference parser (semantic oracle for the native one).
+
+    Malformed ``idx:val`` tails (missing ``:``, index or value outside the
+    shared decimal grammar, empty value — e.g. a stray ``"3: "``) end the
+    pair list for that line; earlier pairs and later lines are kept.  The
+    native parser applies the identical rule (strtol/strtod longest-prefix
+    parse + whole-token and character-class validation), so both paths
+    agree byte-for-byte on such files — pinned by the parity cases in
+    ``test_native_parser_malformed_whitespace_tails``.  The reference
+    simply threw (``"".toDouble``) — crashing on bad input is not behavior
+    worth replicating.
+    """
     labels: list[float] = []
     indptr: list[int] = [0]
     indices: list[np.ndarray] = []
     values: list[np.ndarray] = []
     nnz = 0
-    with open(path, "r") as f:
+    # latin-1 + newline="\n" = byte-transparent read: every byte decodes
+    # (a non-UTF-8 byte is junk to reject, not a decode crash the native
+    # path doesn't have) and a lone '\r' stays in-line whitespace instead
+    # of universal-newlines splitting the row — both exactly as the
+    # byte-oriented native scanner sees the file.
+    with open(path, "r", encoding="latin-1", newline="\n") as f:
         for line in f:
-            parts = line.strip().split(" ")
-            if not parts or parts == [""]:
+            parts = [t for t in _WS_SPLIT.split(line.rstrip("\n")) if t]
+            if not parts:
                 continue
             labels.append(_parse_label(parts[0]))
             row_idx = np.empty(len(parts) - 1, dtype=np.int32)
             row_val = np.empty(len(parts) - 1, dtype=np.float64)
             m = 0
             for tok in parts[1:]:
-                if not tok:
-                    continue
-                i, v = tok.split(":")
-                row_idx[m] = int(i) - 1  # 1-based → 0-based (OptUtils.scala:42)
-                row_val[m] = float(v)
+                head, sep, val = tok.partition(":")
+                if (not sep or not head or not val
+                        or not _INT_CHARS.issuperset(head)
+                        or not _NUM_CHARS.issuperset(val)):
+                    break
+                try:
+                    i = int(head)
+                    v = float(val)
+                except ValueError:
+                    break
+                # 1-based index must land in int32 after the -1 shift;
+                # out-of-range (incl. idx<1) is malformed, same as native —
+                # a silent int32 cast there would alias huge indices onto
+                # valid features
+                if i < 1 or i - 1 > 2**31 - 1:
+                    break
+                row_idx[m] = i - 1  # 1-based → 0-based (OptUtils.scala:42)
+                row_val[m] = v
                 m += 1
             indices.append(row_idx[:m])
             values.append(row_val[:m])

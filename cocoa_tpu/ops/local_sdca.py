@@ -322,14 +322,18 @@ def local_sdca_block_batched(
     """All-K-shards block-coordinate round on one chip — the TPU-native
     shape of :func:`local_sdca_block`, and the ``--blockSize`` hot path.
 
-    Per block of B draws: batched row gathers, the base margins
-    ``X_B·(w + sig_eff·Δw)`` and the K Gram matrices as (K, B, ·) MXU
-    einsums, then ONE Pallas kernel advancing all K shards' B-step
-    recurrences in lockstep (ops/pallas_chain.chain_block_batched — each
-    scalar step serves every shard at one chain's latency, which is what
-    makes this faster than the sequential per-shard kernels).  α advances
-    by additive scatter of the kernel's per-step deltas (exact under
-    duplicates — they telescope).
+    Hot configs run ops/pallas_chain.fused_block: ONE kernel per block
+    computing the sampled rows' margins, the K Gram matrices, the
+    duplicate-equality tile, the B-step lockstep chain, and the Δw update
+    entirely in VMEM (see the design note in pallas_chain.py — profiling
+    showed the XLA einsum/concat/scatter materialization around the
+    chain-only kernel cost ~4 ms/round at epsilon scale, an order of
+    magnitude more than the chain itself).  Per block the XLA side does
+    only the truly XLA-shaped work: the row-tile gather, the α
+    gather/scatter (TPU has no cheap in-kernel vector gather), and two
+    (K, d) adds.  Configs whose half-tile does not fit VMEM
+    (``fused_fits``) fall back to the split form: per-block XLA einsums
+    feeding the chain-only kernel (chain_block_batched).
 
     Unlike the sequential fast path there is NO whole-shard margins matvec:
     only the H sampled rows' margins are ever computed, from the same row
@@ -339,14 +343,16 @@ def local_sdca_block_batched(
     HBM traffic).
 
     Identical real arithmetic to K independent :func:`local_sdca_fast`
-    runs.  Precision policy (f32 on TPU): the margins/Gram einsums run at
-    DEFAULT — exactly the precision the fast path's ``shard_margins``
-    matvec uses — and the Δw-update einsum at HIGH (bf16x3, ~f32) so the
-    primal-dual correspondence ``w = (1/λn)·Σyαx`` the gap certificate
-    rests on stays tight over thousands of accumulated blocks.  Returns
-    (delta_alpha (K, n_shard), delta_w (K, d)).
+    runs.  Precision policy (f32 on TPU): margins/Gram at DEFAULT — the
+    precision the fast path's ``shard_margins`` matvec uses — and the Δw
+    update accumulated in f32 so the primal-dual correspondence
+    ``w = (1/λn)·Σyαx`` the gap certificate rests on stays tight over
+    thousands of accumulated blocks.  Returns (delta_alpha (K, n_shard),
+    delta_w (K, d)).
     """
-    from cocoa_tpu.ops.pallas_chain import chain_block_batched
+    from cocoa_tpu.ops.pallas_chain import (
+        chain_block_batched, fused_block, fused_fits,
+    )
 
     losses.validate(loss, smoothing)
     sig_eff, qii_factor = mode_factors(mode, sigma)
@@ -358,10 +364,6 @@ def local_sdca_block_batched(
     k = alpha.shape[0]
     h = idxs_kh.shape[-1]
     d = w.shape[-1]
-    # margins/Gram at DEFAULT precision — exactly the precision the fast
-    # path's shard_margins matvec runs at; the Δw update at HIGH (bf16x3,
-    # ~f32) so the primal-dual correspondence w = (1/λn)Σyαx the gap
-    # certificate rests on stays tight over thousands of accumulated blocks
     mm = jax.lax.Precision.DEFAULT
     hi = jax.lax.Precision.HIGH
 
@@ -370,41 +372,89 @@ def local_sdca_block_batched(
         .reshape(k, nb, block).transpose(1, 0, 2)             # (nb, K, B)
     mask_b = (jnp.arange(nb * block) < h).reshape(nb, block)  # (nb, B)
 
+    def gather_rows(bidx):
+        """(K, B, d) dense row tile for one block (sparse rows densify —
+        padded slots carry index 0 / value 0 and scatter harmlessly)."""
+        if "X" in shards:
+            return jnp.take_along_axis(shards["X"], bidx[:, :, None], axis=1)
+        spi = jnp.take_along_axis(shards["sp_indices"], bidx[:, :, None],
+                                  axis=1)
+        spv = jnp.take_along_axis(shards["sp_values"], bidx[:, :, None],
+                                  axis=1)
+        return jnp.zeros((k, block, d), dtype).at[
+            jnp.arange(k)[:, None, None],
+            jnp.arange(block)[None, :, None], spi].add(spv)
+
+    gat = lambda v, bidx: jnp.take_along_axis(v, bidx, axis=1)  # noqa: E731
+
+    if fused_fits(k, block, d, jnp.dtype(dtype).itemsize,
+                  alpha.shape[1]):
+        # idx-only per-draw vectors hoist out of the block scan (they are
+        # tiny — (nb, K, B) — unlike the row tiles, whose hoisting was
+        # measured SLOWER than in-scan gathering, see pallas_chain.py)
+        flat = idxs_b.transpose(1, 0, 2).reshape(k, nb * block)
+        per_block = lambda v: gat(v, flat) \
+            .reshape(k, nb, block).transpose(1, 0, 2)  # noqa: E731
+        yb_all = per_block(labels)
+        qb_all = per_block(sq_norms) * qf
+        idxf_all = idxs_b.astype(dtype)
+        live_all = jnp.broadcast_to(
+            mask_b[:, None, :].astype(dtype), (nb, k, block))
+
+        def block_step(carry, inp):
+            dw, a_vec = carry            # (K, d), (K, n_shard)
+            bidx, yb, qb, idxf, live = inp
+            xb = gather_rows(bidx)
+            if mode == "frozen":
+                v = jnp.broadcast_to(w[None], (k, d)).astype(dtype)
+            else:
+                v = w[None] + sig_c * dw
+            delta, dwu = fused_block(
+                xb, idxf, yb, qb, gat(a_vec, bidx), live, v,
+                lam_n=float(lam * n),
+                coef_div=float(coef_divisor(mode, lam * n)),
+                sig_eff=float(sig_eff), frozen=(mode == "frozen"),
+                loss=loss, smoothing=smoothing, interpret=interpret,
+            )
+            a_vec = a_vec.at[jnp.arange(k)[:, None], bidx].add(delta)
+            return (dw + dwu, a_vec), None
+
+        dw0 = jnp.zeros((k, d), dtype) + 0.0 * w[None]
+        (dw, alpha_final), _ = lax.scan(
+            block_step, (dw0, alpha),
+            (idxs_b, yb_all, qb_all, idxf_all, live_all),
+        )
+        return alpha_final - alpha, dw
+
+    # legacy split path: per-block XLA einsums feeding the chain-only
+    # kernel (configs whose half-tile does not fit VMEM)
+
     def block_step(carry, inp):
         dw, a_vec = carry            # (K, d), (K, n_shard)
         bidx, bmask = inp            # (K, B), (B,)
-        if "X" in shards:
-            xb = jnp.take_along_axis(
-                shards["X"], bidx[:, :, None], axis=1)        # (K, B, d)
-        else:
-            spi = jnp.take_along_axis(
-                shards["sp_indices"], bidx[:, :, None], axis=1)
-            spv = jnp.take_along_axis(
-                shards["sp_values"], bidx[:, :, None], axis=1)
-            xb = jnp.zeros((k, block, d), dtype).at[
-                jnp.arange(k)[:, None, None],
-                jnp.arange(block)[None, :, None], spi].add(spv)
-        gat = lambda v: jnp.take_along_axis(v, bidx, axis=1)  # noqa: E731
-        # the equality tile, directly in the kernel's (B, K, B) j-sliceable
-        # layout: eq_t[j, k, i] = (idx_i == idx_j) within shard k
+        xb = gather_rows(bidx)
+        # the equality tile, directly in the kernel's (B, K, B)
+        # j-sliceable layout: eq_t[j, k, i] = (idx_i == idx_j) in shard k
         eq_t = (bidx.T[:, :, None] == bidx[None, :, :]).astype(dtype)
         if mode == "frozen":
             # frozen margins never see Δw: base = X_B·w, no Gram needed
             mbase = jnp.einsum("kbd,d->kb", xb, w, precision=mm)
             gq = eq_t
         else:
-            # one matvec carries both margin terms: x·w + sig_eff·(x·Δw_blockstart)
+            # one matvec carries both margin terms:
+            # x·w + sig_eff·(x·Δw_blockstart)
             mbase = jnp.einsum("kbd,kd->kb", xb, w[None] + sig_c * dw,
                                precision=mm)
             gq = jnp.concatenate(
                 [jnp.einsum("kjd,kid->jki", xb, xb, precision=mm), eq_t],
                 axis=1,
-            )                                                 # (B, 2K, B)
+            )                                             # (B, 2K, B)
         scal = jnp.stack([
-            mbase, gat(labels), gat(sq_norms) * qf, gat(a_vec),
-            jnp.zeros_like(mbase),   # within-block Δw margin lives in gram
+            mbase, gat(labels, bidx), gat(sq_norms, bidx) * qf,
+            gat(a_vec, bidx),
+            jnp.zeros_like(mbase),  # within-block Δw margin lives in gram
             jnp.broadcast_to(bmask[None].astype(dtype), (k, block)),
-        ], axis=1)                                            # (K, 6, B)
+        ], axis=1)                                        # (K, 6, B)
         delta, coefs = chain_block_batched(
             scal, gq,
             lam_n=float(lam * n),

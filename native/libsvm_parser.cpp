@@ -70,22 +70,48 @@ struct Sink {
   bool truncated = false;
 };
 
+// Shared numeric grammar (see cocoa_tpu/data/libsvm.py _NUM_CHARS): plain
+// ASCII decimal only.  strtod additionally accepts hex floats, "nan(...)"
+// and "inf", which Python's float() rejects — restricting both sides to
+// this character class makes token validity independent of which parser
+// ran.
+inline bool is_num_char(char c) {
+  return (c >= '0' && c <= '9') || c == '+' || c == '-' || c == '.' ||
+         c == 'e' || c == 'E';
+}
+
 // Label rule per OptUtils.scala:35-37 ('+' anywhere in the token, or the
-// token parsing to 1, means +1; everything else silently -1).
+// token parsing to 1 under the shared decimal grammar, means +1;
+// everything else silently -1).
 double parse_label(const char* tok, const char* end) {
   for (const char* p = tok; p < end; ++p) {
     if (*p == '+') return 1.0;
   }
+  for (const char* p = tok; p < end; ++p) {
+    if (!is_num_char(*p)) return -1.0;
+  }
   char* stop = nullptr;
   double v = strtod(tok, &stop);
-  return (stop != tok && v == 1.0) ? 1.0 : -1.0;
+  // whole-token parse required, like Python float(): "1junk" is -1
+  return (stop == end && v == 1.0) ? 1.0 : -1.0;
+}
+
+// True for every whitespace byte strtol/strtod would skip (isspace in the
+// C locale).  The manual skip loops below must cover this exact set:
+// any whitespace they leave in place would let strtol/strtod's own
+// leading-whitespace skip run PAST '\n' into the next line (misparse) or
+// past the region end (OOB read on an exactly-page-sized mapping).
+inline bool is_ws(char c) {
+  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
 }
 
 // Parse the lines in [p, fend) into the sink.  Every line in the region
 // MUST be newline-terminated or the region itself NUL-terminated (the
 // caller guarantees one or the other): strtol/strtod stop at '\n'
-// naturally, and the per-pair loop never starts a number at or past the
-// line end, so the parse cannot escape the region.
+// naturally, and the per-pair loop only ever starts a number at a
+// non-whitespace byte strictly before the line end (whitespace after
+// 'idx:' is treated as a malformed tail), so the parse cannot escape the
+// region.
 void parse_region(const char* p, const char* fend, Sink* out) {
   while (p < fend) {
     if (out->rows >= out->cap_rows) {
@@ -95,26 +121,43 @@ void parse_region(const char* p, const char* fend, Sink* out) {
     const char* eol = static_cast<const char*>(memchr(p, '\n', fend - p));
     if (!eol) eol = fend;
 
-    // skip leading spaces; blank lines are skipped entirely
-    while (p < eol && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    // skip leading whitespace; blank lines are skipped entirely
+    while (p < eol && is_ws(*p)) ++p;
     if (p < eol) {
-      // label token ends at first space
+      // label token ends at first whitespace
       const char* sp = p;
-      while (sp < eol && *sp != ' ' && *sp != '\t') ++sp;
+      while (sp < eol && !is_ws(*sp)) ++sp;
       out->labels[out->rows] = parse_label(p, sp);
 
       // idx:val pairs
       p = sp;
       while (p < eol) {
-        while (p < eol && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+        while (p < eol && is_ws(*p)) ++p;
         if (p >= eol) break;
         char* stop = nullptr;
         long idx = strtol(p, &stop, 10);
-        if (stop == p || stop >= eol || *stop != ':') break;  // malformed
+        if (stop == p || stop > eol) break;  // malformed / ran past eol
+        if (stop == eol || *stop != ':') break;  // malformed
+        // 1-based index must land in int32 after the -1 shift (idx<1 and
+        // strtol's ERANGE clamp included): out of range = malformed tail,
+        // matching the Python oracle — a silent cast would alias huge
+        // indices onto valid features
+        if (idx < 1 || idx - 1 > INT32_MAX) break;
         p = stop + 1;
         if (p >= eol) break;  // "idx:" at line end: malformed tail
+        if (is_ws(*p)) break;  // "idx: val": strtod would skip past '\n'
+        // value must lie entirely within the shared decimal grammar —
+        // rejects hex floats / nan / inf up front so strtod cannot accept
+        // a form the Python oracle would drop
+        const char* vend = p;
+        while (vend < eol && is_num_char(*vend)) ++vend;
+        if (vend == p) break;  // empty or non-decimal value
         double val = strtod(p, &stop);
-        if (stop == p) break;
+        if (stop != vend || stop > eol) break;  // partial parse = junk
+        // junk glued to the value ("1:2.0x", "1:2:3"): malformed — pairs
+        // are whitespace-delimited, matching the Python oracle's
+        // token.partition(':') rule
+        if (stop < eol && !is_ws(*stop)) break;
         p = stop;
         if (out->pairs >= out->cap_pairs) {
           out->truncated = true;

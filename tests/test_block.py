@@ -398,3 +398,47 @@ def test_cli_block_size_flag(tmp_path, capsys):
     ])
     assert rc == 2
     assert "--math=fast" in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
+                                        ("frozen", 1.0)])
+@pytest.mark.parametrize("layout", ["dense", "sparse"])
+def test_fused_block_kernel_matches_fast(tiny_data, mode, sigma, layout):
+    """The FUSED per-block kernel (ops/pallas_chain.fused_block — in-kernel
+    Gram, margins, equality tile, chain, and Δw update) is the f32
+    production path; the float64 parity tests above exercise only the
+    legacy split path (fused_fits requires itemsize 4).  This f32
+    interpret-mode run must take the fused branch and match the sequential
+    fast path to f32 tolerance."""
+    from cocoa_tpu.ops.local_sdca import local_sdca_block_batched
+    from cocoa_tpu.ops.pallas_chain import fused_fits
+
+    ds = shard_dataset(tiny_data, k=K, layout=layout, dtype=jnp.float32)
+    sa = ds.shard_arrays()
+    d = tiny_data.num_features
+    assert fused_fits(K, 128, d, 4, ds.n_shard), \
+        "test config must exercise the fused branch"
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0, 1),
+        jnp.float32,
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 37, ds.counts)[:, 0, :]
+    )
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode=mode, sigma=sigma,
+        block=128, interpret=True,
+    )
+    for s in range(K):
+        shard = {kk: v[s] for kk, v in sa.items()}
+        m0 = shard_margins(w, shard)
+        da_f, dw_f = local_sdca_fast(
+            m0, alpha[s], shard, idxs[s], 0.01, tiny_data.n,
+            jnp.zeros(d, jnp.float32), mode=mode, sigma=sigma,
+        )
+        np.testing.assert_allclose(np.asarray(da_b[s]), np.asarray(da_f),
+                                   rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw_b[s]), np.asarray(dw_f),
+                                   rtol=2e-4, atol=1e-6)

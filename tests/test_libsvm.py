@@ -122,3 +122,121 @@ print(peak - base)
                          text=True, check=True)
     delta = int(out.stdout.strip())
     assert delta < 1.2 * size, (delta, size)
+
+
+def test_native_parser_malformed_whitespace_tails(tmp_path):
+    """Whitespace after 'idx:' must end the pair list for that line, never
+    let strtod's own whitespace skip run past '\\n' into the next line
+    (which misparsed the next line's leading number as this pair's value)
+    or past the end of an exactly-page-sized mapping (OOB read)."""
+    from cocoa_tpu.data import native_loader
+
+    if not native_loader.available():
+        pytest.skip("native parser not built (make -C native)")
+
+    # 'idx: val' — the space after ':' makes the pair malformed; the rest
+    # of the line is dropped but the NEXT line must parse intact (the old
+    # code attached the next token as this pair's value).
+    p1 = tmp_path / "sp.svm"
+    p1.write_bytes(b"1 3: \n-1 1:7.0\n")
+    d = native_loader.parse_file(str(p1), 10)
+    np.testing.assert_array_equal(d.labels, [1.0, -1.0])
+    np.testing.assert_array_equal(d.indptr, [0, 0, 1])
+    np.testing.assert_array_equal(d.indices, [0])
+    np.testing.assert_array_equal(d.values, [7.0])
+
+    # '\v' is whitespace to strtol but was missing from the manual skip
+    # set — a line ending '1 \v' must yield zero pairs, not a cross-line
+    # number parse.
+    p2 = tmp_path / "vt.svm"
+    p2.write_bytes(b"1 \v\n-1 1:7.0\n")
+    d = native_loader.parse_file(str(p2), 10)
+    np.testing.assert_array_equal(d.labels, [1.0, -1.0])
+    np.testing.assert_array_equal(d.indptr, [0, 0, 1])
+
+    # Exactly-page-multiple mapping whose LAST line has the malformed
+    # 'idx: ' tail: the old whitespace skip could read one byte past the
+    # mmap'd region.  Blank pad lines are skipped by the parser.
+    import mmap
+
+    p3 = tmp_path / "page.svm"
+    head = b"+1 1:1.0\n"
+    tail = b"1 2: \n"
+    pad = 2 * mmap.PAGESIZE - len(head) - len(tail)
+    p3.write_bytes(head + b"\n" * pad + tail)
+    assert p3.stat().st_size % mmap.PAGESIZE == 0
+    d = native_loader.parse_file(str(p3), 10)
+    np.testing.assert_array_equal(d.labels, [1.0, 1.0])
+    np.testing.assert_array_equal(d.indptr, [0, 1, 1])
+    np.testing.assert_array_equal(d.indices, [0])
+    np.testing.assert_array_equal(d.values, [1.0])
+
+    # Native and Python parsers must agree on every malformed-tail rule:
+    # earlier pairs kept, rest of the line dropped, later lines intact.
+    p4 = tmp_path / "parity.svm"
+    p4.write_bytes(
+        b"1 1:1.0 3: 5.0\n"      # space after ':'
+        b"-1 1:2.0 2:3.0x 4:9\n"  # junk glued to a value
+        b"1 1:4.0 2:5:6 4:9\n"    # second ':' in token
+        b"-1 3.5:1.0\n"           # non-integer index
+        b"1 2 3\n"                # no ':' at all
+        b"-1 1:7.0\n"             # clean line after all that
+    )
+    nat = native_loader.parse_file(str(p4), 10)
+    py = load_libsvm_python(str(p4), 10)
+    np.testing.assert_array_equal(nat.labels, py.labels)
+    np.testing.assert_array_equal(nat.indptr, py.indptr)
+    np.testing.assert_array_equal(nat.indices, py.indices)
+    np.testing.assert_array_equal(nat.values, py.values)
+    np.testing.assert_array_equal(py.labels, [1, -1, 1, -1, 1, -1])
+    np.testing.assert_array_equal(py.indptr, [0, 1, 2, 3, 3, 3, 4])
+
+    # Shared-grammar parity: forms exactly one of int()/float() or
+    # strtol/strtod would accept must be malformed on BOTH sides —
+    # C-only hex floats / nan(...) / inf, Python-only Unicode digits and
+    # digit-group underscores — and Unicode whitespace (NBSP) is an
+    # ordinary junk byte, not a token delimiter, on both.
+    p5 = tmp_path / "grammar.svm"
+    p5.write_bytes(
+        b"1 1:0x10 2:3.0\n"            # hex float value
+        b"1 1:nan(0) 2:3.0\n"          # C-only nan-with-payload
+        b"1 1:inf 2:3.0\n"             # C-only inf word
+        b"1 \xd9\xa1:2.0\n"            # Arabic-Indic digit index (Python int() accepts)
+        b"1 1:1_0.5 2:3.0\n"           # underscored float (Python float() accepts)
+        b"1 1:2.0\xc2\xa03:4.0\n"      # NBSP inside the pair list
+        b"0x1 1:5.0\n"                 # hex label -> -1 on both
+        b"-1 1:7.0\n"                  # clean terminal line
+    )
+    nat = native_loader.parse_file(str(p5), 10)
+    py = load_libsvm_python(str(p5), 10)
+    np.testing.assert_array_equal(nat.labels, py.labels)
+    np.testing.assert_array_equal(nat.indptr, py.indptr)
+    np.testing.assert_array_equal(nat.indices, py.indices)
+    np.testing.assert_array_equal(nat.values, py.values)
+    np.testing.assert_array_equal(py.labels, [1, 1, 1, 1, 1, 1, -1, -1])
+    np.testing.assert_array_equal(py.indptr, [0, 0, 0, 0, 0, 0, 0, 1, 2])
+    np.testing.assert_array_equal(py.indices, [0, 0])
+    np.testing.assert_array_equal(py.values, [5.0, 7.0])
+
+    # Byte-level parity: lone '\r' is in-line whitespace (NOT a row
+    # break — no universal newlines), non-UTF-8 bytes are junk (not a
+    # decode crash), and indices that would wrap an int32 cast (or idx<1)
+    # are malformed on both sides.
+    p6 = tmp_path / "bytes.svm"
+    p6.write_bytes(
+        b"1 1:2.0\r2:3.0\n"           # '\r' separates pairs, same row
+        b"1 1:4.0 \xff 2:6.0\n"       # raw 0xff byte: drops the tail
+        b"1 4294967301:2.0 2:8.0\n"   # idx-1 wraps int32: malformed
+        b"1 0:9.0 2:8.0\n"            # idx<1: malformed
+        b"-1 2147483648:5.0\n"        # idx-1 == INT32_MAX: valid
+    )
+    nat = native_loader.parse_file(str(p6), 2**31)
+    py = load_libsvm_python(str(p6), 2**31)
+    np.testing.assert_array_equal(nat.labels, py.labels)
+    np.testing.assert_array_equal(nat.indptr, py.indptr)
+    np.testing.assert_array_equal(nat.indices, py.indices)
+    np.testing.assert_array_equal(nat.values, py.values)
+    np.testing.assert_array_equal(py.labels, [1, 1, 1, 1, -1])
+    np.testing.assert_array_equal(py.indptr, [0, 2, 1 + 2, 1 + 2, 1 + 2, 2 + 2])
+    np.testing.assert_array_equal(py.indices, [0, 1, 0, 2**31 - 1])
+    np.testing.assert_array_equal(py.values, [2.0, 3.0, 4.0, 5.0])
