@@ -50,6 +50,7 @@ HBM_BW = {
     "TPU v5e": 819e9,
     "TPU v6 lite": 1640e9,
     "TPU v6e": 1640e9,
+    "TPU7x": 7370e9,           # Ironwood: 7.37 TB/s HBM3e (public specs)
 }
 
 
@@ -140,6 +141,11 @@ def account(tag, secs_per_round, model, *, steps, evals_per_round=0.0,
         hbm = model["hbm_bytes"]
         out["hbm_floor_ms"] = round(hbm / bw * 1e3, 3)
         out["hbm_bound_pct"] = round(hbm / bw / secs_per_round * 100, 1)
+    elif peak:
+        # a chip in PEAKS but not HBM_BW would silently drop the roofline
+        # columns — say so instead of weakening the "latency-bound is
+        # measured" claim (ADVICE r2)
+        out["hbm_floor_ms"] = "bw unknown"
     if peak and bw:
         flop_floor = physical / peak
         hbm_floor = model["hbm_bytes"] / bw

@@ -10,24 +10,38 @@ Configs (BASELINE.json "eval" list):
   data/synth.py), K=8, H=0.1·n/K, λ=1e-3, to 1e-4 gap.
 - ``rcv1``     — rcv1.binary-like sparse synthetic (20242×47236, ~75
   nnz/row), K=8, H=0.1·n/K, λ=1e-4, to 1e-3 and 1e-4 gaps.
-- ``mbcd-rcv1`` / ``sgd-epsilon`` — the baseline algorithms on the same
-  data (fixed round budgets; they have no duality-gap certificate to
-  target — SGD is primal-only, and mini-batch CD's β/(K·H) scaling makes
-  gap progress per round much slower than CoCoA's, exactly the point the
-  CoCoA papers make).
+- ``mbcd-rcv1`` / SGD-family / DistGD rows — the remaining reference
+  algorithms on the same data (fixed round budgets; they have no duality-
+  gap certificate to target — SGD/DistGD are primal-only, and mini-batch
+  CD's β/(K·H) scaling makes gap progress per round much slower than
+  CoCoA's, exactly the point the CoCoA papers make).  All six reference
+  algorithms (hingeDriver.scala:84-110) have a row.
+- ``lasso`` / ``elastic`` — ProxCoCoA+ on the L1 / L1+L2 objectives.
 
-Each timed run is warm (the first run compiles, the second is measured).
-``--quick`` shrinks the synthetic sizes ~10x for smoke-testing the suite.
+**Timing is slope-measured** (VERDICT r2 item 2): the raw wall-clock of a
+run through a tunneled device carries hundreds of ms of dispatch+fetch
+noise — more than many whole configs.  For each config the gap-targeted
+run determines the round count R (and verifies the certificate); two
+fixed-round runs at R and m·R then give per_round = (T(mR) − T(R))/((m−1)R),
+``wallclock_s`` = per_round·R (the steady state), and ``fixed_s`` =
+T(R) − wallclock_s (the dispatch overhead, reported separately).  m is
+sized so the span dominates the noise.  ``--quick`` shrinks the synthetic
+sizes ~10x for smoke-testing the suite.
 
 The ``vs_oracle`` column is the speedup over the literal NumPy oracle of
 the Scala update rules (tests/oracle.py) executing the same number of
 rounds single-threaded — measured directly for the demo config and
-extrapolated from 3 oracle rounds at the big scales (the oracle is the
-reference's *math* without Spark overhead, so this flatters the
-reference).
+extrapolated from a few oracle rounds at the big scales (the oracle is
+the reference's *math* without Spark overhead, so this flatters the
+reference).  Permuted-sampling rows reach the same certified gap in
+FEWER rounds; their cross-mode speedup (oracle at reference-mode rounds
+vs the permuted run's wall-clock) is reported in a separate
+``vs_oracle_same_gap`` column so ``vs_oracle`` keeps one meaning
+(ADVICE r2).
 
-Writes one JSON line per config to benchmarks/results.jsonl and a
-markdown table to benchmarks/RESULTS.md.
+Writes one JSON line per config to benchmarks/results.jsonl, a markdown
+table to benchmarks/RESULTS.md, and regenerates the marked perf blocks
+in BASELINE.md and PARITY.md from the same rows (one source of truth).
 """
 
 from __future__ import annotations
@@ -48,6 +62,43 @@ DEMO_TRAIN = "/root/reference/data/small_train.dat"
 DEMO_TEST = "/root/reference/data/small_test.dat"
 DEMO_D = 9947
 
+# published shapes of the real datasets (the integrity pin the air-gapped
+# build CAN carry — see benchmarks/fetch_data.sh for the sha256 story)
+REAL_SHAPES = {
+    "rcv1_train.binary": (20_242, 47_236),
+    "epsilon_normalized": (400_000, 2_000),
+}
+
+
+def _maybe_real(data_dir, fname):
+    """Load benchmarks/data/<fname> when present (fetched by
+    fetch_data.sh), validating the published (n, d) shape; None when
+    absent (the synthetic stand-in is used and labeled as such)."""
+    path = os.path.join(data_dir, fname)
+    if not os.path.exists(path):
+        return None
+    from cocoa_tpu.data import load_libsvm
+
+    n_want, d_want = REAL_SHAPES[fname]
+    data = load_libsvm(path, d_want)
+    if data.n != n_want:
+        raise ValueError(
+            f"{path}: expected the published shape n={n_want} "
+            f"(d={d_want}), parsed n={data.n} — corrupt or wrong file"
+        )
+    print(f"using real dataset {fname}: n={data.n} d={d_want} "
+          f"nnz/row={len(data.values) / data.n:.1f}")
+    return data
+
+
+def _dense_subsample(data, n_sub):
+    """(X, y) dense NumPy arrays of the first n_sub rows (oracle input)."""
+    X = np.zeros((n_sub, data.num_features))
+    for i in range(n_sub):
+        lo, hi = data.indptr[i], data.indptr[i + 1]
+        X[i, data.indices[lo:hi]] = data.values[lo:hi]
+    return X, data.labels[:n_sub].astype(np.float64)
+
 
 def _time_warm(fn, reps=2):
     """Warm (compiled) best-of-``reps`` timing: the tunneled device's
@@ -61,6 +112,9 @@ def _time_warm(fn, reps=2):
         dt = time.perf_counter() - t0
         best = dt if best is None or dt < best else best
     return best, out
+
+
+from slope import slope_time as _slope_time  # noqa: E402
 
 
 def _perf(tag, secs, rounds, *, n, d, k, h, layout="dense", nnz=None,
@@ -167,6 +221,64 @@ def _oracle_rounds_per_s(ds_like, lam, h, k, n, rounds=3):
     return rounds / (time.perf_counter() - t0)
 
 
+def _oracle_rounds_per_s_sgd(ds_like, lam, h, k, rounds=3, local=True):
+    """Single-thread oracle round rate for the SGD family (SGD.scala):
+    per round each shard runs H Pegasos-style steps (local) or sums raw
+    subgradients (mini-batch); driver applies the scaling law."""
+    import oracle
+
+    from cocoa_tpu.utils.prng import sample_indices
+
+    X, y = ds_like
+    sizes = np.full(k, X.shape[0] // k)
+    sizes[: X.shape[0] % k] += 1
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    shards = [
+        (X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(k)
+    ]
+    w = np.zeros(X.shape[1])
+    t0 = time.perf_counter()
+    for t in range(1, rounds + 1):
+        if not local:
+            step = 1.0 / (lam * t)
+            w = w * (1.0 - step * lam)
+        dw_sum = np.zeros_like(w)
+        for sidx, (Xk, yk) in enumerate(shards):
+            idxs = sample_indices(0, range(t, t + 1), h, Xk.shape[0])[0]
+            t_global = (t - 1) * h * k
+            dw_sum += oracle.sgd_partition(Xk, yk, w, idxs, lam, t_global,
+                                           local)
+        if local:
+            w = w + dw_sum / k           # beta/K, beta=1 (SGD.scala:36,55)
+        else:
+            w = w + dw_sum * (step / (k * h))   # eta*beta/(K*H) (:38,57-59)
+    return rounds / (time.perf_counter() - t0)
+
+
+def _oracle_rounds_per_s_distgd(ds_like, lam, k, rounds=2):
+    """Single-thread oracle round rate for DistGD (DistGD.scala): one
+    deterministic full pass per shard per round + the normalized step."""
+    import oracle
+
+    X, y = ds_like
+    sizes = np.full(k, X.shape[0] // k)
+    sizes[: X.shape[0] % k] += 1
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    shards = [
+        (X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(k)
+    ]
+    w = np.zeros(X.shape[1])
+    t0 = time.perf_counter()
+    for t in range(1, rounds + 1):
+        dw = np.zeros_like(w)
+        for Xk, yk in shards:
+            dw += oracle.dist_gd_partition(Xk, yk, w, lam)
+        nrm = np.linalg.norm(dw)
+        if nrm > 0:
+            w = w + dw * ((1.0 / t) / nrm)    # eta = 1/(beta*t), beta=1
+    return rounds / (time.perf_counter() - t0)
+
+
 def bench_demo(results, perf_rows):
     import jax.numpy as jnp
 
@@ -176,22 +288,28 @@ def bench_demo(results, perf_rows):
 
     data = load_libsvm(DEMO_TRAIN, DEMO_D)
     ds = shard_dataset(data, k=4, layout="dense", dtype=jnp.float32)
-    params = Params(n=data.n, num_rounds=600, local_iters=50, lam=1e-3)
     debug = DebugParams(debug_iter=10, seed=0)
 
-    def go():
-        return run_cocoa(ds, params, debug, plus=True, quiet=True,
-                         math="fast", device_loop=True, gap_target=1e-4)
+    def make_run(nr, rng="reference"):
+        p = Params(n=data.n, num_rounds=nr, local_iters=50, lam=1e-3)
+        return lambda: run_cocoa(ds, p, debug, plus=True, quiet=True,
+                                 math="fast", device_loop=True, rng=rng)
 
-    secs, (w, a, traj) = _time_warm(go)
+    def gap_run(rng="reference"):
+        p = Params(n=data.n, num_rounds=600, local_iters=50, lam=1e-3)
+        return run_cocoa(ds, p, debug, plus=True, quiet=True, math="fast",
+                         device_loop=True, gap_target=1e-4, rng=rng)
+
+    _, (w, a, traj) = _time_warm(gap_run, reps=1)
     rec = traj.records[-1]
+    secs, fixed = _slope_time(make_run, rec.round)
     rate = _oracle_rounds_per_s(
         (data.to_dense(), data.labels), 1e-3, 50, 4, data.n
     )
     results.append(dict(
         config="demo-cocoa+", n=data.n, d=DEMO_D, k=4, h=50,
         lam=1e-3, gap_target=1e-4, rounds=rec.round, gap=float(rec.gap),
-        wallclock_s=round(secs, 3),
+        wallclock_s=round(secs, 3), fixed_s=round(fixed, 3),
         vs_oracle=round(rec.round / rate / secs, 1),
         oracle_basis="measured (3 rounds)",
     ))
@@ -200,122 +318,186 @@ def bench_demo(results, perf_rows):
 
     # random reshuffling (--rng=permuted): fewer comm-rounds to the same
     # certified gap — the certificate is exact under any index stream
-    def go_perm():
-        return run_cocoa(ds, params, debug, plus=True, quiet=True,
-                         math="fast", device_loop=True, gap_target=1e-4,
-                         rng="permuted")
-
-    secs_p, (w_p, a_p, traj_p) = _time_warm(go_perm)
+    _, (w_p, a_p, traj_p) = _time_warm(lambda: gap_run("permuted"), reps=1)
     rec_p = traj_p.records[-1]
+    secs_p, fixed_p = _slope_time(
+        lambda nr: make_run(nr, "permuted"), rec_p.round)
     results.append(dict(
         config="demo-cocoa+(permuted)", n=data.n, d=DEMO_D, k=4, h=50,
         lam=1e-3, gap_target=1e-4, rounds=rec_p.round,
         gap=float(rec_p.gap), wallclock_s=round(secs_p, 3),
-        vs_oracle=round(rec.round / rate / secs_p, 1),
-        oracle_basis="oracle rounds = reference-mode rounds",
+        fixed_s=round(fixed_p, 3),
+        vs_oracle_same_gap=round(rec.round / rate / secs_p, 1),
+        oracle_basis="same-gap: oracle at reference-mode rounds",
     ))
 
 
-def bench_epsilon(results, perf_rows, quick):
+def bench_epsilon(results, perf_rows, quick, data_dir=""):
     import jax.numpy as jnp
 
     from cocoa_tpu.config import DebugParams, Params
     from cocoa_tpu.data.synth import synth_dense_sharded
-    from cocoa_tpu.solvers import run_cocoa
+    from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_sgd
 
-    n, d, k = (40_000, 2000, 8) if quick else (400_000, 2000, 8)
+    real = None if quick else _maybe_real(data_dir, "epsilon_normalized")
+    tag = "epsilon(real)" if real is not None else "epsilon"
+    if real is not None:
+        from cocoa_tpu.data import shard_dataset as _shard
+
+        import jax.numpy as _jnp
+
+        n, d, k = real.n, real.num_features, 8
+        ds = _shard(real, k=k, layout="dense", dtype=_jnp.float32)
+    else:
+        n, d, k = (40_000, 2000, 8) if quick else (400_000, 2000, 8)
+        ds = synth_dense_sharded(n, d, k, seed=0)
     h = n // k // 10
-    ds = synth_dense_sharded(n, d, k, seed=0)
-    params = Params(n=n, num_rounds=400, local_iters=h, lam=1e-3)
     debug = DebugParams(debug_iter=10, seed=0)
 
-    def go():
-        return run_cocoa(ds, params, debug, plus=True, quiet=True,
-                         math="fast", device_loop=True, gap_target=1e-4)
+    def make_run(nr, rng="reference", block=0):
+        p = Params(n=n, num_rounds=nr, local_iters=h, lam=1e-3)
+        return lambda: run_cocoa(ds, p, debug, plus=True, quiet=True,
+                                 math="fast", device_loop=True, rng=rng,
+                                 block_size=block)
 
-    secs, (w, a, traj) = _time_warm(go)
+    def gap_run(rng="reference", block=0):
+        p = Params(n=n, num_rounds=400, local_iters=h, lam=1e-3)
+        return run_cocoa(ds, p, debug, plus=True, quiet=True, math="fast",
+                         device_loop=True, gap_target=1e-4, rng=rng,
+                         block_size=block)
+
+    _, (w, a, traj) = _time_warm(gap_run, reps=1)
     rec = traj.records[-1]
+    secs, fixed = _slope_time(make_run, rec.round)
     # oracle rate on a small same-d subsample, scaled by n (per-round work
     # is O(H·d) per shard with H ∝ n — linear in n at fixed d, k)
     n_sub = min(n, 20_000)
-    rng = np.random.default_rng(0)
-    Xs = rng.standard_normal((n_sub, d))
-    Xs /= np.linalg.norm(Xs, axis=1, keepdims=True)
-    ys = np.where(Xs @ rng.standard_normal(d) >= 0, 1.0, -1.0)
+    if real is not None:
+        Xs, ys = _dense_subsample(real, n_sub)
+    else:
+        rng = np.random.default_rng(0)
+        Xs = rng.standard_normal((n_sub, d))
+        Xs /= np.linalg.norm(Xs, axis=1, keepdims=True)
+        ys = np.where(Xs @ rng.standard_normal(d) >= 0, 1.0, -1.0)
     rate_sub = _oracle_rounds_per_s((Xs, ys), 1e-3, n_sub // k // 10, k, n_sub)
     rate = rate_sub * n_sub / n
+    basis = f"extrapolated from n={n_sub} subsample"
     results.append(dict(
-        config="epsilon-cocoa+", n=n, d=d, k=k, h=h,
+        config=f"{tag}-cocoa+", n=n, d=d, k=k, h=h,
         lam=1e-3, gap_target=1e-4, rounds=rec.round, gap=float(rec.gap),
-        wallclock_s=round(secs, 3),
-        vs_oracle=round(rec.round / rate / secs, 1),
-        oracle_basis=f"extrapolated from n={n_sub} subsample",
+        wallclock_s=round(secs, 3), fixed_s=round(fixed, 3),
+        vs_oracle=round(rec.round / rate / secs, 1), oracle_basis=basis,
     ))
-    perf_rows.append(_perf("epsilon-cocoa+", secs, rec.round, n=n, d=d,
+    perf_rows.append(_perf(f"{tag}-cocoa+", secs, rec.round, n=n, d=d,
                            k=k, h=h, path="pallas"))
 
-    # the block-coordinate inner solver (--blockSize=256): same index
-    # stream and math, restructured for the MXU (ops/pallas_chain.py)
-    def go_block():
-        return run_cocoa(ds, params, debug, plus=True, quiet=True,
-                         math="fast", block_size=256, device_loop=True,
-                         gap_target=1e-4)
-
-    secs_b, (w_b, a_b, traj_b) = _time_warm(go_block)
+    # the block-coordinate inner solver (--blockSize=128): same index
+    # stream and math, restructured for the MXU — the fused per-block
+    # kernel (ops/pallas_chain.fused_block)
+    _, (w_b, a_b, traj_b) = _time_warm(lambda: gap_run(block=128), reps=1)
     rec_b = traj_b.records[-1]
+    secs_b, fixed_b = _slope_time(lambda nr: make_run(nr, block=128),
+                                  rec_b.round)
     results.append(dict(
-        config="epsilon-cocoa+(block256)", n=n, d=d, k=k, h=h,
+        config=f"{tag}-cocoa+(block128)", n=n, d=d, k=k, h=h,
         lam=1e-3, gap_target=1e-4, rounds=rec_b.round,
         gap=float(rec_b.gap), wallclock_s=round(secs_b, 3),
-        vs_oracle=round(rec_b.round / rate / secs_b, 1),
-        oracle_basis=f"extrapolated from n={n_sub} subsample",
+        fixed_s=round(fixed_b, 3),
+        vs_oracle=round(rec_b.round / rate / secs_b, 1), oracle_basis=basis,
     ))
-    perf_rows.append(_perf("epsilon-cocoa+(block256)", secs_b, rec_b.round,
-                           n=n, d=d, k=k, h=h, path="block", block=256))
+    perf_rows.append(_perf(f"{tag}-cocoa+(block128)", secs_b, rec_b.round,
+                           n=n, d=d, k=k, h=h, path="block", block=128))
 
     # reshuffled sampling + block kernel: the TPU-first mode — same
     # certified 1e-4 gap in ~5x fewer comm-rounds (see tests/test_permuted)
-    def go_pb():
-        return run_cocoa(ds, params, debug, plus=True, quiet=True,
-                         math="fast", block_size=256, device_loop=True,
-                         gap_target=1e-4, rng="permuted")
-
-    secs_pb, (w_pb, a_pb, traj_pb) = _time_warm(go_pb)
+    _, (w_pb, a_pb, traj_pb) = _time_warm(
+        lambda: gap_run("permuted", block=128), reps=1)
     rec_pb = traj_pb.records[-1]
+    secs_pb, fixed_pb = _slope_time(
+        lambda nr: make_run(nr, "permuted", block=128), rec_pb.round)
     results.append(dict(
-        config="epsilon-cocoa+(permuted+block256)", n=n, d=d, k=k, h=h,
+        config=f"{tag}-cocoa+(permuted+block128)", n=n, d=d, k=k, h=h,
         lam=1e-3, gap_target=1e-4, rounds=rec_pb.round,
         gap=float(rec_pb.gap), wallclock_s=round(secs_pb, 3),
-        vs_oracle=round(rec.round / rate / secs_pb, 1),
-        oracle_basis="oracle rounds = reference-mode rounds",
+        fixed_s=round(fixed_pb, 3),
+        vs_oracle_same_gap=round(rec.round / rate / secs_pb, 1),
+        oracle_basis="same-gap: oracle at reference-mode rounds",
     ))
-    # no perf row: at ~20 rounds the whole run is tunnel fixed cost and a
-    # ms_per_round quotient would be meaningless — the kernel numbers are
-    # identical to the block256 row (same executable, different tables)
 
     # Local SGD on the same data (primal-only baseline; fixed 100 rounds)
-    from cocoa_tpu.solvers import run_sgd
-
-    p2 = Params(n=n, num_rounds=100, local_iters=h, lam=1e-3)
     d2 = DebugParams(debug_iter=100, seed=0)
 
-    def go_sgd():
-        return run_sgd(ds, p2, d2, local=True, quiet=True, device_loop=True)
+    def make_sgd(nr, local=True):
+        p = Params(n=n, num_rounds=nr, local_iters=h, lam=1e-3)
+        return lambda: run_sgd(ds, p, d2, local=local, quiet=True,
+                               device_loop=True)
 
-    secs2, (w2, traj2) = _time_warm(go_sgd)
+    _, (w2, traj2) = _time_warm(make_sgd(100), reps=1)
     rec2 = traj2.records[-1]
+    secs2, fixed2 = _slope_time(make_sgd, 100)
+    rate_lsgd = _oracle_rounds_per_s_sgd((Xs, ys), 1e-3, n_sub // k // 10,
+                                         k, local=True) * n_sub / n
     results.append(dict(
-        config="epsilon-localsgd", n=n, d=d, k=k, h=h, lam=1e-3,
+        config=f"{tag}-localsgd", n=n, d=d, k=k, h=h, lam=1e-3,
         rounds=rec2.round, primal=float(rec2.primal),
-        wallclock_s=round(secs2, 3),
+        wallclock_s=round(secs2, 3), fixed_s=round(fixed2, 3),
+        vs_oracle=round(100 / rate_lsgd / secs2, 1), oracle_basis=basis,
     ))
     # SGD.scala:117-129 per step: O(d) rescale + conditional axpy — the
     # "exact"-path model (4·d per step, no margins pass) is the right count
-    perf_rows.append(_perf("epsilon-localsgd", secs2, rec2.round, n=n, d=d,
+    perf_rows.append(_perf(f"{tag}-localsgd", secs2, rec2.round, n=n, d=d,
                            k=k, h=h, path="exact", debug_iter=100))
 
+    # Mini-batch SGD (SGD.scala local=false; fixed 100 rounds)
+    _, (w3, traj3) = _time_warm(make_sgd(100, local=False), reps=1)
+    rec3 = traj3.records[-1]
+    secs3, fixed3 = _slope_time(lambda nr: make_sgd(nr, local=False), 100)
+    rate_mbsgd = _oracle_rounds_per_s_sgd((Xs, ys), 1e-3, n_sub // k // 10,
+                                          k, local=False) * n_sub / n
+    results.append(dict(
+        config=f"{tag}-mbsgd", n=n, d=d, k=k, h=h, lam=1e-3,
+        rounds=rec3.round, primal=float(rec3.primal),
+        wallclock_s=round(secs3, 3), fixed_s=round(fixed3, 3),
+        vs_oracle=round(100 / rate_mbsgd / secs3, 1), oracle_basis=basis,
+    ))
+    perf_rows.append(_perf(f"{tag}-mbsgd", secs3, rec3.round, n=n, d=d,
+                           k=k, h=h, path="exact", debug_iter=100))
 
-def bench_rcv1(results, perf_rows, quick):
+    # DistGD (full deterministic subgradient pass per round; fixed 50
+    # rounds — its per-round cost is a whole-shard pass, H-independent)
+    from cocoa_tpu.config import Params as _P
+
+    d3 = DebugParams(debug_iter=50, seed=0)
+
+    def make_dgd(nr):
+        p = _P(n=n, num_rounds=nr, local_iters=h, lam=1e-3)
+        return lambda: run_dist_gd(ds, p, d3, quiet=True, device_loop=True)
+
+    _, (w4, traj4) = _time_warm(make_dgd(50), reps=1)
+    rec4 = traj4.records[-1]
+    secs4, fixed4 = _slope_time(make_dgd, 50)
+    # per-round cost is one full shard pass: rate scales 1/n at fixed d, k
+    rate_dgd = _oracle_rounds_per_s_distgd((Xs, ys), 1e-3, k) * n_sub / n
+    results.append(dict(
+        config=f"{tag}-distgd", n=n, d=d, k=k, h="n/K",
+        lam=1e-3, rounds=rec4.round, primal=float(rec4.primal),
+        wallclock_s=round(secs4, 3), fixed_s=round(fixed4, 3),
+        vs_oracle=round(50 / rate_dgd / secs4, 1), oracle_basis=basis,
+    ))
+    # DistGD reads every row once per round: model it as one "margins
+    # pass" with zero coordinate steps
+    import perf as _perfmod
+
+    model = _perfmod.sdca_round_model(n, d, k, 0, path="fast")
+    perf_rows.append(_perfmod.account(
+        f"{tag}-distgd", secs4 / max(1, rec4.round), model,
+        steps=n,   # one subgradient evaluation per example per round
+        evals_per_round=1.0 / 50,
+        eval_fl=_perfmod.eval_flops(n, d),
+    ))
+
+
+def bench_rcv1(results, perf_rows, quick, data_dir=""):
     import jax.numpy as jnp
 
     from cocoa_tpu.config import DebugParams, Params
@@ -323,80 +505,91 @@ def bench_rcv1(results, perf_rows, quick):
     from cocoa_tpu.data.synth import synth_sparse
     from cocoa_tpu.solvers import run_cocoa, run_minibatch_cd
 
-    n, d, k = (4000, 47236, 8) if quick else (20242, 47236, 8)
-    data = synth_sparse(n, d, nnz_mean=75, seed=0)
+    real = None if quick else _maybe_real(data_dir, "rcv1_train.binary")
+    rtag = "rcv1(real)" if real is not None else "rcv1"
+    if real is not None:
+        data, (n, d, k) = real, (real.n, real.num_features, 8)
+    else:
+        n, d, k = (4000, 47236, 8) if quick else (20242, 47236, 8)
+        data = synth_sparse(n, d, nnz_mean=75, seed=0)
     ds = shard_dataset(data, k=k, layout="sparse", dtype=jnp.float32)
     h = n // k // 10
     debug = DebugParams(debug_iter=25, seed=0)
     nnz = len(data.values) / n
     rate_plus = _oracle_rounds_per_s_csr(data, 1e-4, h, k, n, mode="plus")
 
+    def make_run(nr, rng="reference"):
+        p = Params(n=n, num_rounds=nr, local_iters=h, lam=1e-4)
+        return lambda: run_cocoa(ds, p, debug, plus=True, quiet=True,
+                                 math="fast", device_loop=True, rng=rng)
+
     for gap_target in (1e-3, 1e-4):
         params = Params(n=n, num_rounds=1500, local_iters=h, lam=1e-4)
 
-        def go():
+        def gap_run(rng="reference"):
             return run_cocoa(ds, params, debug, plus=True, quiet=True,
                              math="fast", device_loop=True,
-                             gap_target=gap_target)
+                             gap_target=gap_target, rng=rng)
 
-        secs, (w, a, traj) = _time_warm(go)
+        _, (w, a, traj) = _time_warm(gap_run, reps=1)
         rec = traj.records[-1]
+        secs, fixed = _slope_time(make_run, rec.round)
         results.append(dict(
-            config=f"rcv1-cocoa+({gap_target:g})", n=n, d=d, k=k, h=h,
+            config=f"{rtag}-cocoa+({gap_target:g})", n=n, d=d, k=k, h=h,
             lam=1e-4, gap_target=gap_target, rounds=rec.round,
             gap=float(rec.gap), wallclock_s=round(secs, 3),
+            fixed_s=round(fixed, 3),
             vs_oracle=round(rec.round / rate_plus / secs, 1),
             oracle_basis="measured (2 rounds, CSR)",
         ))
-        perf_rows.append(_perf(f"rcv1-cocoa+({gap_target:g})", secs,
+        perf_rows.append(_perf(f"{rtag}-cocoa+({gap_target:g})", secs,
                                rec.round, n=n, d=d, k=k, h=h,
                                layout="sparse", nnz=nnz, path="pallas",
                                debug_iter=25))
-        def go_perm():
-            return run_cocoa(ds, params, debug, plus=True, quiet=True,
-                             math="fast", device_loop=True,
-                             gap_target=gap_target, rng="permuted")
 
-        secs_p, (w_p, a_p, traj_p) = _time_warm(go_perm)
+        _, (w_p, a_p, traj_p) = _time_warm(lambda: gap_run("permuted"),
+                                           reps=1)
         rec_p = traj_p.records[-1]
+        secs_p, fixed_p = _slope_time(
+            lambda nr: make_run(nr, "permuted"), rec_p.round)
         results.append(dict(
-            config=f"rcv1-cocoa+({gap_target:g}, permuted)", n=n, d=d,
+            config=f"{rtag}-cocoa+({gap_target:g}, permuted)", n=n, d=d,
             k=k, h=h, lam=1e-4, gap_target=gap_target,
             rounds=rec_p.round, gap=float(rec_p.gap),
-            wallclock_s=round(secs_p, 3),
-            vs_oracle=round(rec.round / rate_plus / secs_p, 1),
-            oracle_basis="oracle rounds = reference-mode rounds",
+            wallclock_s=round(secs_p, 3), fixed_s=round(fixed_p, 3),
+            vs_oracle_same_gap=round(rec.round / rate_plus / secs_p, 1),
+            oracle_basis="same-gap: oracle at reference-mode rounds",
         ))
 
     # Mini-batch CD on the same data (fixed 100 rounds; its β/(K·H)
     # scaling needs far more rounds per unit of gap progress — the CoCoA
     # papers' point)
-    p2 = Params(n=n, num_rounds=100, local_iters=h, lam=1e-4)
     d2 = DebugParams(debug_iter=100, seed=0)
 
-    def go_mbcd():
-        return run_minibatch_cd(ds, p2, d2, quiet=True, math="fast",
-                                device_loop=True)
+    def make_mbcd(nr):
+        p = Params(n=n, num_rounds=nr, local_iters=h, lam=1e-4)
+        return lambda: run_minibatch_cd(ds, p, d2, quiet=True, math="fast",
+                                        device_loop=True)
 
-    secs2, (w2, a2, traj2) = _time_warm(go_mbcd)
+    _, (w2, a2, traj2) = _time_warm(make_mbcd(100), reps=1)
     rec2 = traj2.records[-1]
+    secs2, fixed2 = _slope_time(make_mbcd, 100)
     rate_f = _oracle_rounds_per_s_csr(data, 1e-4, h, k, n, mode="frozen")
     results.append(dict(
-        config="rcv1-mbcd", n=n, d=d, k=k, h=h, lam=1e-4,
+        config=f"{rtag}-mbcd", n=n, d=d, k=k, h=h, lam=1e-4,
         rounds=rec2.round, gap=float(rec2.gap), primal=float(rec2.primal),
-        wallclock_s=round(secs2, 3),
+        wallclock_s=round(secs2, 3), fixed_s=round(fixed2, 3),
         vs_oracle=round(rec2.round / rate_f / secs2, 1),
         oracle_basis="measured (2 rounds, CSR)",
     ))
-    perf_rows.append(_perf("rcv1-mbcd", secs2, rec2.round, n=n, d=d, k=k,
+    perf_rows.append(_perf(f"{rtag}-mbcd", secs2, rec2.round, n=n, d=d, k=k,
                            h=h, layout="sparse", nnz=nnz, path="pallas",
                            debug_iter=100))
 
-
-def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2):
-    """Single-thread literal prox-CD oracle round rate (ProxCoCoA+ lasso,
-    gamma=1): per step one column dot against r, one against the local
-    Δv, a soft-threshold, one column axpy."""
+def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2, l2=0.0):
+    """Single-thread literal prox-CD oracle round rate (ProxCoCoA+ lasso /
+    elastic net, gamma=1): per step one column dot against r, one against
+    the local Δv, a soft-threshold, one column axpy."""
     from cocoa_tpu.data.sharding import split_sizes
     from cocoa_tpu.utils.prng import sample_indices
 
@@ -422,8 +615,8 @@ def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2):
                 q = sigma * float(aj @ aj)
                 if q <= 0.0:
                     continue
-                u = (q * a - z) / q
-                tstar = np.sign(u) * max(abs(u) - lam / q, 0.0)
+                u = (q * a - z) / (q + l2)
+                tstar = np.sign(u) * max(abs(u) - lam / (q + l2), 0.0)
                 dv += aj * (tstar - a)
                 x[gj] = tstar
             dv_sum += dv
@@ -432,10 +625,12 @@ def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2):
 
 
 def bench_lasso(results, perf_rows, quick):
-    """ProxCoCoA+ lasso (the L1 extension, no reference analogue): dense
-    Gaussian design with a planted 64-sparse x*, λ = 0.3·λ_max, to a
-    RELATIVE duality gap of 1e-3 (gap ≤ 1e-3 · ½‖b‖² — lasso objectives
-    are scale-dependent, so an absolute target would be meaningless)."""
+    """ProxCoCoA+ lasso + elastic net (the L1 extension, no reference
+    analogue): dense Gaussian design with a planted 64-sparse x*,
+    λ = 0.3·λ_max, to a RELATIVE duality gap of 1e-3 (gap ≤ 1e-3·½‖b‖² —
+    these objectives are scale-dependent, so an absolute target would be
+    meaningless).  The elastic-net row exercises the smoothed-conjugate
+    certificate (VERDICT r2 item 4)."""
     import jax.numpy as jnp
 
     from cocoa_tpu.config import DebugParams, Params
@@ -460,44 +655,54 @@ def bench_lasso(results, perf_rows, quick):
     lam = 0.3 * float(np.max(np.abs(A.T @ bvec)))
     p0 = 0.5 * float(bvec @ bvec)
     h = d // k // 10
-    params = Params(n=d, num_rounds=3000, local_iters=h, lam=lam,
-                    loss="lasso", smoothing=0.0)
     debug = DebugParams(debug_iter=50, seed=0)
 
-    def go():
-        return run_prox_cocoa(ds, b, params, debug, quiet=True, math="fast",
-                              device_loop=True, gap_target=1e-3 * p0)
+    for tag, l2 in (("lasso-proxcocoa+", 0.0), ("elastic-proxcocoa+", 0.1)):
+        def make_run(nr, rng_mode="reference", l2=l2):
+            p = Params(n=d, num_rounds=nr, local_iters=h, lam=lam,
+                       loss="lasso", smoothing=l2)
+            return lambda: run_prox_cocoa(ds, b, p, debug, quiet=True,
+                                          math="fast", device_loop=True,
+                                          rng=rng_mode)
 
-    secs, (x, r, traj) = _time_warm(go)
-    rec = traj.records[-1]
-    rate = _oracle_rounds_per_s_lasso(A, bvec, lam, h, k)
-    results.append(dict(
-        config="lasso-proxcocoa+", n=n, d=d, k=k, h=h,
-        lam=round(lam, 5), gap_target=f"1e-3 relative", rounds=rec.round,
-        gap=float(rec.gap), wallclock_s=round(secs, 3),
-        vs_oracle=round(rec.round / rate / secs, 1),
-        oracle_basis="measured (2 rounds)",
-    ))
-    # roles swapped: d coordinates play the example axis, dense columns of
-    # length n play the rows (see solvers/prox_cocoa.py)
-    perf_rows.append(_perf("lasso-proxcocoa+", secs, rec.round, n=d, d=n,
-                           k=k, h=h, path="pallas", debug_iter=50))
+        def gap_run(rng_mode="reference", l2=l2):
+            p = Params(n=d, num_rounds=3000, local_iters=h, lam=lam,
+                       loss="lasso", smoothing=l2)
+            return run_prox_cocoa(ds, b, p, debug, quiet=True, math="fast",
+                                  device_loop=True, gap_target=1e-3 * p0,
+                                  rng=rng_mode)
 
-    def go_perm():
-        return run_prox_cocoa(ds, b, params, debug, quiet=True, math="fast",
-                              device_loop=True, gap_target=1e-3 * p0,
-                              rng="permuted")
+        _, (x, r, traj) = _time_warm(gap_run, reps=1)
+        rec = traj.records[-1]
+        secs, fixed = _slope_time(make_run, rec.round)
+        rate = _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, l2=l2)
+        results.append(dict(
+            config=tag, n=n, d=d, k=k, h=h,
+            lam=round(lam, 5), l2=l2, gap_target="1e-3 relative",
+            rounds=rec.round, gap=float(rec.gap),
+            wallclock_s=round(secs, 3), fixed_s=round(fixed, 3),
+            vs_oracle=round(rec.round / rate / secs, 1),
+            oracle_basis="measured (2 rounds)",
+        ))
+        # roles swapped: d coordinates play the example axis, dense columns
+        # of length n play the rows (see solvers/prox_cocoa.py)
+        perf_rows.append(_perf(tag, secs, rec.round, n=d, d=n,
+                               k=k, h=h, path="pallas", debug_iter=50))
 
-    secs_p, (x_p, r_p, traj_p) = _time_warm(go_perm)
-    rec_p = traj_p.records[-1]
-    results.append(dict(
-        config="lasso-proxcocoa+(permuted)", n=n, d=d, k=k, h=h,
-        lam=round(lam, 5), gap_target=f"1e-3 relative",
-        rounds=rec_p.round, gap=float(rec_p.gap),
-        wallclock_s=round(secs_p, 3),
-        vs_oracle=round(rec.round / rate / secs_p, 1),
-        oracle_basis="oracle rounds = reference-mode rounds",
-    ))
+        if l2 == 0.0:
+            _, (x_p, r_p, traj_p) = _time_warm(
+                lambda: gap_run("permuted"), reps=1)
+            rec_p = traj_p.records[-1]
+            secs_p, fixed_p = _slope_time(
+                lambda nr: make_run(nr, "permuted"), rec_p.round)
+            results.append(dict(
+                config="lasso-proxcocoa+(permuted)", n=n, d=d, k=k, h=h,
+                lam=round(lam, 5), gap_target="1e-3 relative",
+                rounds=rec_p.round, gap=float(rec_p.gap),
+                wallclock_s=round(secs_p, 3), fixed_s=round(fixed_p, 3),
+                vs_oracle_same_gap=round(rec.round / rate / secs_p, 1),
+                oracle_basis="same-gap: oracle at reference-mode rounds",
+            ))
 
 
 def write_results(results, perf_rows, out_dir, partial=False):
@@ -512,14 +717,24 @@ def write_results(results, perf_rows, out_dir, partial=False):
         for r in perf_rows:
             f.write(json.dumps({"type": "perf", **r}) + "\n")
     md = os.path.join(out_dir, f"RESULTS{suffix}.md")
-    cols = ["config", "n", "d", "k", "h", "lam", "gap_target", "rounds",
-            "gap", "primal", "wallclock_s", "vs_oracle"]
+    cols = ["config", "n", "d", "k", "h", "lam", "l2", "gap_target",
+            "rounds", "gap", "primal", "wallclock_s", "fixed_s",
+            "vs_oracle", "vs_oracle_same_gap"]
     with open(md, "w") as f:
         f.write("# Benchmark results\n\n")
         f.write("Produced by `python benchmarks/run.py` on the attached "
-                "TPU device (single chip, K logical shards).  See the "
-                "module docstring for config definitions and the "
-                "`vs_oracle` methodology.\n\n")
+                "TPU device (single chip, K logical shards).  "
+                "`wallclock_s` is the SLOPE-MEASURED steady-state time "
+                "for the row's rounds (fixed dispatch/fetch costs cancel "
+                "between an R-round and an mR-round run); `fixed_s` is "
+                "the cancelled per-run overhead — a raw stopwatch on one "
+                "run reads ≈ wallclock_s + fixed_s ± the tunnel's "
+                "run-to-run jitter.  `vs_oracle` compares equal rounds "
+                "against the single-thread NumPy oracle of the reference "
+                "math; permuted-sampling rows instead report "
+                "`vs_oracle_same_gap` (oracle at reference-mode rounds vs "
+                "this row's wall-clock — a cross-mode comparison).  See "
+                "the module docstring for config definitions.\n\n")
         f.write("| " + " | ".join(cols) + " |\n")
         f.write("|" + "---|" * len(cols) + "\n")
         for r in results:
@@ -538,13 +753,11 @@ def write_results(results, perf_rows, out_dir, partial=False):
                 "formulation spends to buy parallelism (block Gram work, "
                 "lane padding).  MFU is against the chip's public dense "
                 "bf16 peak — a conservative lower bound for f32 work.  "
-                "Times include the per-`debugIter` eval amortized in, and "
-                "the tunneled device's dispatch+fetch overhead — hundreds "
-                "of ms to several seconds, varying run to run — spread "
-                "over the run's rounds, which can dominate ms_per_round "
-                "at small round counts; benchmarks/KERNELS.md carries the "
-                "slope-measured per-round kernel times with that overhead "
-                "cancelled.\n\n"
+                "Times include the per-`debugIter` eval amortized in; "
+                "ms_per_round derives from the slope-measured steady "
+                "state, so the tunnel's dispatch+fetch overhead is "
+                "already cancelled (it is reported separately as the "
+                "result table's fixed_s).\n\n"
             )
             pcols = ["config", "device", "ms_per_round", "us_per_step",
                      "useful_gflops", "physical_gflops", "mfu_pct",
@@ -560,13 +773,12 @@ def write_results(results, perf_rows, out_dir, partial=False):
                 "sits far above both the HBM-traffic floor and the FLOP "
                 "floor, because the algorithm's hot loop is a sequential "
                 "chain of O(nnz) coordinate steps (CoCoA.scala:148-188) — "
-                "per-step chain latency (~1-4 µs across the kernels, "
-                "~0.9 µs for the block-coordinate kernel), not bandwidth "
-                "or MXU throughput, sets the ceiling.  Corollary: rcv1's "
-                "1450 rounds to the 1e-4 gap is λ=1e-4 *conditioning* "
-                "(2.6 µs/step is already near the chain floor; the same "
-                "kernel reaches the 1e-3 gap in 325 rounds), not a sparse-"
-                "kernel inefficiency.\n"
+                "per-step chain latency (see the us_per_step column and "
+                "benchmarks/KERNELS.md), not bandwidth or MXU throughput, "
+                "sets the ceiling.  Corollary: rcv1's round count to the "
+                "1e-4 gap is λ=1e-4 *conditioning*, not sparse-kernel "
+                "inefficiency — the same kernel reaches the 1e-3 gap in "
+                "a fraction of the rounds.\n"
                 "\nRoofline reading, per config:\n\n"
             )
             for r in perf_rows:
@@ -583,6 +795,84 @@ def write_results(results, perf_rows, out_dir, partial=False):
                     f"bound**.\n"
                 )
     print(f"wrote {jl} and {md}")
+    if not partial:
+        _sync_docs(results)
+
+
+def _sync_doc_block(path, text):
+    """Replace the GENERATED:bench block in ``path`` (between the marker
+    comments) with ``text``; no-op with a warning if markers are absent."""
+    start = "<!-- GENERATED:bench -->"
+    end = "<!-- /GENERATED:bench -->"
+    with open(path) as f:
+        s = f.read()
+    if start not in s or end not in s:
+        print(f"warning: {path} has no GENERATED:bench markers; skipped")
+        return
+    head, rest = s.split(start, 1)
+    _, tail = rest.split(end, 1)
+    with open(path, "w") as f:
+        f.write(head + start + "\n" + text + end + tail)
+    print(f"synced {path}")
+
+
+def _sync_docs(results):
+    """Regenerate the perf claims BASELINE.md and PARITY.md carry from the
+    measured rows — one source of truth (VERDICT r2 item 2: three documents
+    had three generations of numbers)."""
+    by = {r["config"]: r for r in results}
+
+    def row(cfg, label, extra=""):
+        # real-dataset runs label their configs e.g. rcv1(real)-... — the
+        # claims should follow whichever variant actually ran
+        r = by.get(cfg.replace("epsilon", "epsilon(real)")
+                   .replace("rcv1", "rcv1(real)")) or by.get(cfg)
+        if r is None:
+            return ""
+        vs = r.get("vs_oracle")
+        vs_s = f"≈{vs}× single-thread oracle" if vs is not None else \
+            f"≈{r.get('vs_oracle_same_gap')}× same-gap vs oracle"
+        fixed = r.get("fixed_s")
+        return (f"| TPU rebuild: {label} | **{r['wallclock_s']} s steady "
+                f"(+{fixed} s dispatch), {r['rounds']} comm-rounds** "
+                f"({vs_s}{extra}) | 1 TPU chip, K={r['k']} | "
+                f"benchmarks/RESULTS.md |\n")
+
+    base = (
+        row("demo-cocoa+", "demo config to 1e-4 gap")
+        + row("epsilon-cocoa+(block128)",
+              "epsilon-like 400K×2000 to 1e-4 gap (block kernel)",
+              extra="; λ=1e-3, H=0.1·n/K")
+        + row("epsilon-cocoa+(permuted+block128)",
+              "epsilon, reshuffled sampling + block kernel")
+        + row("rcv1-cocoa+(0.001)", "rcv1-like 20242×47236 sparse to 1e-3 gap")
+        + row("rcv1-cocoa+(0.0001)", "rcv1-like sparse to 1e-4 gap")
+        + row("lasso-proxcocoa+",
+              "lasso 8192×32768 (ProxCoCoA+, λ=0.3λmax) to 1e-3 rel. gap")
+        + row("elastic-proxcocoa+", "elastic net (l2=0.1), same design")
+    )
+    _sync_doc_block(os.path.join(ROOT, "BASELINE.md"), base)
+
+    d = by.get("demo-cocoa+")
+    e = (by.get("epsilon(real)-cocoa+(block128)")
+         or by.get("epsilon-cocoa+(block128)"))
+    rc = (by.get("rcv1(real)-cocoa+(0.001)")
+          or by.get("rcv1-cocoa+(0.001)"))
+    if d and e and rc:
+        par = (
+            f"See BASELINE.md / benchmarks/RESULTS.md (all numbers are the "
+            f"slope-measured steady state; the tunneled device's "
+            f"dispatch+fetch overhead is reported separately as fixed_s):\n"
+            f"demo config to the 1e-4 duality gap in {d['wallclock_s']} s "
+            f"({d['rounds']} comm-rounds) on one TPU chip — "
+            f"≈{d['vs_oracle']}× the single-threaded NumPy oracle of the "
+            f"reference math (the Spark stack itself cannot run here; the "
+            f"oracle has zero scheduler overhead, so the true Spark-vs-TPU "
+            f"gap is larger); epsilon-scale (400K×2000) in "
+            f"{e['wallclock_s']} s; rcv1-scale sparse (20242×47236) to "
+            f"1e-3 in {rc['wallclock_s']} s.\n"
+        )
+        _sync_doc_block(os.path.join(ROOT, "PARITY.md"), par)
 
 
 def main():
@@ -591,6 +881,12 @@ def main():
                     help="~10x smaller synthetic sizes (smoke test)")
     ap.add_argument("--only", default="",
                     help="comma-separated subset: demo,epsilon,rcv1,lasso")
+    ap.add_argument("--data-dir",
+                    default=os.path.join(os.path.dirname(
+                        os.path.abspath(__file__)), "data"),
+                    help="directory holding real datasets (fetch_data.sh); "
+                         "real files are preferred over synthetic stand-ins "
+                         "and rows are labeled e.g. rcv1(real)")
     args = ap.parse_args()
     only = set(args.only.split(",")) if args.only else None
 
@@ -600,11 +896,11 @@ def main():
         bench_demo(results, perf_rows)
         print(json.dumps(results[-1]))
     if only is None or "epsilon" in only:
-        bench_epsilon(results, perf_rows, args.quick)
+        bench_epsilon(results, perf_rows, args.quick, args.data_dir)
         for r in results[-3:]:
             print(json.dumps(r))
     if only is None or "rcv1" in only:
-        bench_rcv1(results, perf_rows, args.quick)
+        bench_rcv1(results, perf_rows, args.quick, args.data_dir)
         for r in results[-3:]:
             print(json.dumps(r))
     if only is None or "lasso" in only:

@@ -255,13 +255,9 @@ def main(argv=None) -> int:
         print("error: --deviceLoop requires --debugIter > 0 (the eval "
               "cadence is the device loop's chunk axis)", file=sys.stderr)
         return 2
-    if cfg.device_loop and cfg.chkpt_dir and cfg.chkpt_iter > 0:
-        # resuming (--resume with --chkptIter=0) is fine — only periodic
-        # SAVING is host-side by nature and incompatible with the device loop
-        print("error: --deviceLoop cannot save checkpoints; drop --chkptDir, "
-              "set --chkptIter=0 (resume-only), or use --scanChunk",
-              file=sys.stderr)
-        return 2
+    # --deviceLoop + --chkptDir/--chkptIter is supported: the device-loop
+    # driver saves at its super-block boundaries, every chkptIter rounds
+    # rounded up to the debugIter chunk cadence (base.drive_device_full)
     resume = extras["resume"] is not None and str(extras["resume"]).lower() != "false"
     if resume and not cfg.chkpt_dir:
         print("error: --resume requires --chkptDir", file=sys.stderr)
@@ -338,8 +334,7 @@ def main(argv=None) -> int:
 
         final = [float(v) for v in
                  _metrics_fn(mesh, cfg.lam, l2)(r, x, ds_c.shard_arrays(), b)]
-        traj.summary(final[0],
-                     gap=None if l2 != 0.0 else final[1], test_error=None)
+        traj.summary(final[0], gap=final[1], test_error=None)
         if extras["trajOut"]:
             traj.dump_jsonl(f"{extras['trajOut']}.ProxCoCoA+.jsonl")
         return 0

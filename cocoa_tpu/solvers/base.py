@@ -243,8 +243,9 @@ def drive_on_device(
     remainder through the host-stepped path).  Trajectory metrics land in a
     preallocated device buffer, fetched once.
 
-    Checkpointing is host-side by nature — callers with chkpt_iter > 0 use
-    :func:`drive_chunked` instead.
+    Checkpointing is host-side by nature, so it is NOT done here — the
+    wrapper :func:`drive_device_full` saves at its super-block boundaries
+    (where this function returns and the state is host-reachable).
 
     ``cache_key``: any hashable token fully determining the closures
     (algorithm + params + flags + mesh + chunk geometry + gap target).  When
@@ -315,13 +316,25 @@ def drive_device_full(
             "the device loop requires debug_iter > 0 (the eval cadence is "
             "its chunk axis)"
         )
-    if debug.chkpt_dir and debug.chkpt_iter > 0:
-        raise ValueError(
-            "the device loop cannot checkpoint (host-side by nature); use "
-            "the chunked driver for checkpointed runs"
-        )
     c = debug.debug_iter
     traj = Trajectory(name, quiet=quiet)
+    # Device-loop checkpointing (reference anchor CoCoA.scala:59-62: the
+    # production path checkpoints): state is host-reachable at every
+    # super-block boundary (each drive_on_device return is the block's one
+    # host sync), so save there — every chkptIter rounds, rounded UP to the
+    # block boundary.  Block sizes are capped below so a boundary occurs at
+    # least every ceil(chkptIter / debugIter) chunks.
+    ckpt_on = bool(debug.chkpt_dir) and debug.chkpt_iter > 0
+    last_saved = start_round - 1
+
+    def maybe_ckpt(done_round):
+        nonlocal last_saved
+        if ckpt_on and done_round - last_saved >= debug.chkpt_iter:
+            ckpt_lib.save(
+                debug.chkpt_dir, name, done_round, state[0],
+                state[1] if len(state) > 1 else None, seed=debug.seed,
+            )
+            last_saved = done_round
 
     def hit_target():
         return (
@@ -341,6 +354,7 @@ def drive_device_full(
             primal, gap, test_err = eval_fn(state)
             traj.log_round(head_end, primal=primal, gap=gap,
                            test_error=test_err)
+        maybe_ckpt(head_end)
 
     n_full = max(0, (params.num_rounds - (t - 1)) // c)
     if n_full > 0 and not hit_target():
@@ -352,6 +366,10 @@ def drive_device_full(
         k = int(np.atleast_1d(sampler.counts).shape[0])
         chunk_ints = c * k * sampler.h
         max_block = max(1, MAX_IDX_TABLE_BYTES // (4 * chunk_ints))
+        if ckpt_on:
+            # a boundary (host sync + save opportunity) at least every
+            # chkptIter rounds, rounded up to the chunk cadence
+            max_block = min(max_block, max(1, -(-debug.chkpt_iter // c)))
         if gap_target is None or n_full * chunk_ints <= SMALL_TABLE_INTS:
             # no early stop possible (or the whole table is cheap anyway):
             # equal blocks → one executable, one host sync per ~256 MB
@@ -408,8 +426,14 @@ def drive_device_full(
                 # JSONL its monotone (round, time) pairs without fabricating
                 # flat per-round times.
                 traj.records[-1].wall_time = traj.elapsed()
-            done = start - 1 + b * c
+            # rounds actually executed: a gap-target run can stop the
+            # device while_loop mid-block, after fewer than b chunks —
+            # each executed chunk logged exactly one eval record.  Saving
+            # the nominal block end would overstate the checkpoint round
+            # and a later --resume would skip never-executed rounds.
+            done = start - 1 + len(dev_traj.records) * c
             start += b * c
+            maybe_ckpt(done)
             if hit_target():
                 break
         t = done + 1
@@ -418,6 +442,7 @@ def drive_device_full(
     if rem > 0 and not hit_target():
         # sub-cadence tail: run it, no eval (off the debugIter cadence)
         state = chunk_fn(t, rem, state)
+        maybe_ckpt(params.num_rounds)
     return state, traj
 
 

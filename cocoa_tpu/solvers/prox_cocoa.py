@@ -25,10 +25,19 @@ swapped:
 Because the structure is identical, the entire SDCA-family machinery —
 fast-math margins decomposition, both Pallas kernels, device-side chunked
 rounds and the device-resident loop, gap-target early stop — is reused
-verbatim via run_sdca_family with mode="prox" and a lasso-specific
-duality-gap certificate: gap = P(x) − D(s·r) with the dual-feasible
-scaling s = min(1, λ/‖Aᵀr‖∞), D(u) = −½‖u‖² − uᵀb (pure lasso only;
-the elastic-net gap is reported as None).
+verbatim via run_sdca_family with mode="prox" and a duality-gap
+certificate for the WHOLE family (the reference's principle: every
+primal-dual method certifies, OptUtils.scala:89-91 / README.md:14):
+
+- pure lasso (η = 0): gap = P(x) − D(s·r) with the dual-feasible scaling
+  s = min(1, λ/‖Aᵀr‖∞), D(u) = −½‖u‖² − uᵀb — the conjugate of λ|·| is
+  the indicator of [−λ, λ], so u must be scaled into the feasible box.
+- elastic net (η > 0): the l2 term smooths the conjugate —
+  h(t) = λ|t| + (η/2)t² has h*(s) = ([|s| − λ]₊)²/(2η), finite
+  everywhere — so the residual itself is dual-feasible and
+  gap = P(x) − D(r), D(u) = −½‖u‖² − uᵀb − Σ_j ([|a_jᵀu| − λ]₊)²/(2η).
+  Weak duality gives gap ≥ 0 for any x; at the optimum u* = r* makes it
+  0 (validated against the NumPy oracle in tests/test_prox.py).
 """
 
 from __future__ import annotations
@@ -49,19 +58,22 @@ from cocoa_tpu.solvers.cocoa import run_sdca_family
 
 
 def lasso_metrics(r, x, shard_arrays, b, l1: float, l2: float, mesh=None):
-    """(primal, gap|NaN, NaN) for the elastic-net objective, as one stacked
-    device array — one fan-out over the column shards (Σ|x|, Σx², and the
-    per-shard max |a_jᵀr| for the dual-feasible scaling), zero host syncs.
-    The gap certificate is exact for pure lasso (l2 == 0) and NaN
-    otherwise."""
+    """(primal, gap, NaN) for the elastic-net objective, as one stacked
+    device array — one fan-out over the column shards (Σ|x|, Σx², the
+    per-shard max |a_jᵀr| for the lasso dual-feasible scaling, and the
+    Σ([|a_jᵀr| − λ]₊)² the smoothed elastic-net conjugate needs), zero
+    host syncs.  The certificate is exact for both cases (module
+    docstring); weak duality makes it ≥ 0 at every iterate."""
     def per_shard(rw, x_k, shard):
         m = shard["mask"]
+        corr = jnp.abs(shard_margins(rw, shard)) * m
+        excess = jnp.maximum(corr - l1, 0.0)
         sums = jnp.stack([
             jnp.sum(jnp.abs(x_k) * m),
             jnp.sum(x_k * x_k * m),
+            jnp.sum(excess * excess),
         ])
-        corr_max = jnp.max(jnp.abs(shard_margins(rw, shard)) * m)
-        return sums, corr_max
+        return sums, jnp.max(corr)
 
     sums, corr_max_k = fanout(per_shard, mesh, r, x, shard_arrays)
     rr = r @ r
@@ -71,9 +83,10 @@ def lasso_metrics(r, x, shard_arrays, b, l1: float, l2: float, mesh=None):
         s = jnp.minimum(1.0, l1 / jnp.maximum(inf_norm, 1e-30))
         u = s * r
         dual = -0.5 * (u @ u) - u @ b
-        gap = primal - dual
     else:
-        gap = jnp.asarray(jnp.nan, primal.dtype)
+        # h*(s) = ([|s|-λ]₊)²/(2η): finite for any s, so u = r is feasible
+        dual = -0.5 * rr - r @ b - sums[2] / (2.0 * l2)
+    gap = primal - dual
     return jnp.stack([primal, gap, jnp.asarray(jnp.nan, primal.dtype)])
 
 
@@ -112,8 +125,9 @@ def run_prox_cocoa(
     ``params.lam`` is the L1 weight λ, ``params.smoothing`` the elastic-net
     l2 weight η (0 = pure lasso), ``params.gamma`` the aggregation γ
     (γ=1 additive, σ′ = K·γ — the CoCoA+ safe default), ``params.local_iters``
-    the per-round coordinate steps H.  ``gap_target`` stops at the lasso
-    duality gap (pure lasso only).  Execution options (``scan_chunk``,
+    the per-round coordinate steps H.  ``gap_target`` stops at the duality
+    gap (certified for both lasso and elastic net — module docstring).
+    Execution options (``scan_chunk``,
     ``math``, ``pallas``, ``device_loop``) as in run_sdca_family — all
     paths incl. both Pallas kernels work on the transposed layout."""
     l1, l2 = float(params.lam), float(params.smoothing)

@@ -442,3 +442,43 @@ def test_fused_block_kernel_matches_fast(tiny_data, mode, sigma, layout):
                                    rtol=2e-4, atol=1e-6)
         np.testing.assert_allclose(np.asarray(dw_b[s]), np.asarray(dw_f),
                                    rtol=2e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("loss,smoothing", [("smooth_hinge", 0.5),
+                                            ("logistic", 1.0)])
+def test_fused_block_kernel_generic_losses(tiny_data, loss, smoothing):
+    """The fused kernel's non-hinge branch (losses.alpha_step on (K, 1)
+    columns inside the chain) — the float64 generic-loss tests above only
+    pin the legacy split path."""
+    from cocoa_tpu.ops.local_sdca import local_sdca_block_batched
+    from cocoa_tpu.ops.pallas_chain import fused_fits
+
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float32)
+    sa = ds.shard_arrays()
+    d = tiny_data.num_features
+    assert fused_fits(K, 128, d, 4, ds.n_shard)
+    rng = np.random.default_rng(9)
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0, 1),
+        jnp.float32,
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(3, range(1, 2), 37, ds.counts)[:, 0, :]
+    )
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode="plus", sigma=4.0,
+        loss=loss, smoothing=smoothing, block=128, interpret=True,
+    )
+    for s in range(K):
+        shard = {kk: v[s] for kk, v in sa.items()}
+        m0 = shard_margins(w, shard)
+        da_f, dw_f = local_sdca_fast(
+            m0, alpha[s], shard, idxs[s], 0.01, tiny_data.n,
+            jnp.zeros(d, jnp.float32), mode="plus", sigma=4.0,
+            loss=loss, smoothing=smoothing,
+        )
+        np.testing.assert_allclose(np.asarray(da_b[s]), np.asarray(da_f),
+                                   rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw_b[s]), np.asarray(dw_f),
+                                   rtol=2e-4, atol=1e-6)

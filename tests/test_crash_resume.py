@@ -41,7 +41,14 @@ def _summary(out: str):
 
 
 @pytest.mark.slow
-def test_sigkill_then_resume_matches_uninterrupted(tmp_path):
+@pytest.mark.parametrize("extra", [[], ["--deviceLoop=true"]],
+                         ids=["chunked", "deviceloop"])
+def test_sigkill_then_resume_matches_uninterrupted(tmp_path, extra):
+    """Both checkpointing drivers: the chunked host-stepped path and the
+    device loop (VERDICT r2 item 3 — the production driver must survive a
+    kill; saves happen at super-block boundaries, chkptIter rounded up to
+    the debugIter cadence)."""
+    BASE = globals()["BASE"] + extra
     ck = str(tmp_path / "ck")
     os.makedirs(ck)
 

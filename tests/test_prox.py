@@ -177,14 +177,36 @@ def test_prox_gap_certificate_and_early_stop():
     assert primal - dual <= 1e-6 + 1e-12
 
 
-def test_prox_elastic_net_reports_no_gap():
-    _, b, _, data = _problem(seed=4)
+def test_prox_elastic_net_gap_certificate_and_early_stop():
+    """VERDICT r2 item 4: the l2 term smooths the L1 conjugate
+    (h*(s) = ([|s|−λ]₊)²/(2η)), so elastic net certifies too — gap
+    present at every eval, ≥ 0 (weak duality), honest against a direct
+    NumPy recomputation, and driving gap-target early stop."""
+    A, b, _, data = _problem(seed=4)
+    d = data.num_features
     ds, b_dev = shard_columns(data, K, dtype=jnp.float64)
-    p = _params(data.num_features, 0.05, smoothing=0.5, num_rounds=10)
-    x, r, traj = run_prox_cocoa(ds, b_dev, p, _DBG, quiet=True)
-    assert all(rec.gap is None for rec in traj.records)
-    primals = [rec.primal for rec in traj.records]
-    assert primals[-1] <= primals[0]
+    lam = 0.2 * np.max(np.abs(A.T @ b))
+    l2 = 0.5
+    p = _params(d, float(lam), smoothing=l2, num_rounds=400,
+                local_iters=24)
+    x, r, traj = run_prox_cocoa(ds, b_dev, p, _DBG, quiet=True,
+                                gap_target=1e-6, math="fast")
+    gaps = [rec.gap for rec in traj.records]
+    assert all(g is not None and g >= -1e-12 for g in gaps)
+    assert traj.records[-1].gap <= 1e-6
+    assert traj.records[-1].round < 400
+    # the certificate is honest: P(x) − D(r) recomputed directly
+    xs = np.concatenate([np.asarray(x[s])[:c]
+                         for s, c in enumerate(ds.counts)])
+    rr = np.asarray(r)[:len(b)]
+    np.testing.assert_allclose(rr, A @ xs - b, atol=1e-10)
+    primal = (0.5 * rr @ rr + lam * np.abs(xs).sum()
+              + 0.5 * l2 * (xs @ xs))
+    excess = np.maximum(np.abs(A.T @ rr) - lam, 0.0)
+    dual = -0.5 * rr @ rr - rr @ b - (excess @ excess) / (2 * l2)
+    np.testing.assert_allclose(traj.records[-1].gap, primal - dual,
+                               rtol=1e-6, atol=1e-12)
+    assert primal - dual <= 1e-6 + 1e-12
 
 
 def test_prox_resume_equals_uninterrupted(tmp_path):
