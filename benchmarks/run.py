@@ -100,20 +100,6 @@ def _dense_subsample(data, n_sub):
     return X, data.labels[:n_sub].astype(np.float64)
 
 
-def _time_warm(fn, reps=2):
-    """Warm (compiled) best-of-``reps`` timing: the tunneled device's
-    dispatch+fetch latency varies by whole seconds run-to-run, so a single
-    sample badly overstates small configs."""
-    fn()  # compile
-    best, out = None, None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn()
-        dt = time.perf_counter() - t0
-        best = dt if best is None or dt < best else best
-    return best, out
-
-
 from slope import slope_time as _slope_time  # noqa: E402
 
 
@@ -131,6 +117,18 @@ def _perf(tag, secs, rounds, *, n, d, k, h, layout="dense", nnz=None,
         evals_per_round=1.0 / debug_iter,
         eval_fl=perf.eval_flops(n, d, nnz=nnz, test_n=test_n),
     )
+
+
+def _round_rate(run_round, rounds):
+    """rounds/sec of ``run_round(t)`` (t 1-based), with round 1 executed
+    as an UNTIMED warm-up: the first NumPy round pays allocation/BLAS
+    warm-up and a 2-3 round window would otherwise overstate vs_oracle
+    ~3x vs the pinned bench.py rate."""
+    run_round(1)
+    t0 = time.perf_counter()
+    for t in range(2, rounds + 2):
+        run_round(t)
+    return rounds / (time.perf_counter() - t0)
 
 
 def _oracle_rounds_per_s_csr(data, lam, h, k, n, rounds=2, mode="plus"):
@@ -151,8 +149,9 @@ def _oracle_rounds_per_s_csr(data, lam, h, k, n, rounds=2, mode="plus"):
     sigma = float(k)
     plus = mode == "plus"
     lam_n = lam * n
-    t0 = time.perf_counter()
-    for t in range(1, rounds + 1):
+
+    def step(t):
+        nonlocal w
         dw_sum = np.zeros(d)
         for s in range(k):
             idxs = sample_indices(0, range(t, t + 1), h, sizes[s])[0]
@@ -188,7 +187,8 @@ def _oracle_rounds_per_s_csr(data, lam, h, k, n, rounds=2, mode="plus"):
                     a[li] = new_a
             dw_sum += dw
         w = w + dw_sum  # gamma=1 additive
-    return rounds / (time.perf_counter() - t0)
+
+    return _round_rate(step, rounds)
 
 
 def _oracle_rounds_per_s(ds_like, lam, h, k, n, rounds=3):
@@ -207,8 +207,9 @@ def _oracle_rounds_per_s(ds_like, lam, h, k, n, rounds=3):
     ]
     w = np.zeros(X.shape[1])
     alphas = [np.zeros(Xk.shape[0]) for Xk, _ in shards]
-    t0 = time.perf_counter()
-    for t in range(1, rounds + 1):
+
+    def step(t):
+        nonlocal w
         dw_sum = np.zeros_like(w)
         for s, (Xk, yk) in enumerate(shards):
             idxs = sample_indices(0, range(t, t + 1), h, Xk.shape[0])[0]
@@ -218,7 +219,8 @@ def _oracle_rounds_per_s(ds_like, lam, h, k, n, rounds=3):
             alphas[s] += da
             dw_sum += dw
         w += dw_sum
-    return rounds / (time.perf_counter() - t0)
+
+    return _round_rate(step, rounds)
 
 
 def _oracle_rounds_per_s_sgd(ds_like, lam, h, k, rounds=3, local=True):
@@ -237,11 +239,12 @@ def _oracle_rounds_per_s_sgd(ds_like, lam, h, k, rounds=3, local=True):
         (X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(k)
     ]
     w = np.zeros(X.shape[1])
-    t0 = time.perf_counter()
-    for t in range(1, rounds + 1):
+
+    def step(t):
+        nonlocal w
         if not local:
-            step = 1.0 / (lam * t)
-            w = w * (1.0 - step * lam)
+            eta = 1.0 / (lam * t)
+            w = w * (1.0 - eta * lam)
         dw_sum = np.zeros_like(w)
         for sidx, (Xk, yk) in enumerate(shards):
             idxs = sample_indices(0, range(t, t + 1), h, Xk.shape[0])[0]
@@ -251,8 +254,9 @@ def _oracle_rounds_per_s_sgd(ds_like, lam, h, k, rounds=3, local=True):
         if local:
             w = w + dw_sum / k           # beta/K, beta=1 (SGD.scala:36,55)
         else:
-            w = w + dw_sum * (step / (k * h))   # eta*beta/(K*H) (:38,57-59)
-    return rounds / (time.perf_counter() - t0)
+            w = w + dw_sum * (eta / (k * h))   # eta*beta/(K*H) (:38,57-59)
+
+    return _round_rate(step, rounds)
 
 
 def _oracle_rounds_per_s_distgd(ds_like, lam, k, rounds=2):
@@ -268,15 +272,17 @@ def _oracle_rounds_per_s_distgd(ds_like, lam, k, rounds=2):
         (X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(k)
     ]
     w = np.zeros(X.shape[1])
-    t0 = time.perf_counter()
-    for t in range(1, rounds + 1):
+
+    def step(t):
+        nonlocal w
         dw = np.zeros_like(w)
         for Xk, yk in shards:
             dw += oracle.dist_gd_partition(Xk, yk, w, lam)
         nrm = np.linalg.norm(dw)
         if nrm > 0:
             w = w + dw * ((1.0 / t) / nrm)    # eta = 1/(beta*t), beta=1
-    return rounds / (time.perf_counter() - t0)
+
+    return _round_rate(step, rounds)
 
 
 def bench_demo(results, perf_rows):
@@ -300,7 +306,7 @@ def bench_demo(results, perf_rows):
         return run_cocoa(ds, p, debug, plus=True, quiet=True, math="fast",
                          device_loop=True, gap_target=1e-4, rng=rng)
 
-    _, (w, a, traj) = _time_warm(gap_run, reps=1)
+    w, a, traj = gap_run()
     rec = traj.records[-1]
     secs, fixed = _slope_time(make_run, rec.round)
     rate = _oracle_rounds_per_s(
@@ -318,7 +324,7 @@ def bench_demo(results, perf_rows):
 
     # random reshuffling (--rng=permuted): fewer comm-rounds to the same
     # certified gap — the certificate is exact under any index stream
-    _, (w_p, a_p, traj_p) = _time_warm(lambda: gap_run("permuted"), reps=1)
+    w_p, a_p, traj_p = gap_run("permuted")
     rec_p = traj_p.records[-1]
     secs_p, fixed_p = _slope_time(
         lambda nr: make_run(nr, "permuted"), rec_p.round)
@@ -366,7 +372,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
                          device_loop=True, gap_target=1e-4, rng=rng,
                          block_size=block)
 
-    _, (w, a, traj) = _time_warm(gap_run, reps=1)
+    w, a, traj = gap_run()
     rec = traj.records[-1]
     secs, fixed = _slope_time(make_run, rec.round)
     # oracle rate on a small same-d subsample, scaled by n (per-round work
@@ -394,7 +400,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
     # the block-coordinate inner solver (--blockSize=128): same index
     # stream and math, restructured for the MXU — the fused per-block
     # kernel (ops/pallas_chain.fused_block)
-    _, (w_b, a_b, traj_b) = _time_warm(lambda: gap_run(block=128), reps=1)
+    w_b, a_b, traj_b = gap_run(block=128)
     rec_b = traj_b.records[-1]
     secs_b, fixed_b = _slope_time(lambda nr: make_run(nr, block=128),
                                   rec_b.round)
@@ -410,8 +416,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
 
     # reshuffled sampling + block kernel: the TPU-first mode — same
     # certified 1e-4 gap in ~5x fewer comm-rounds (see tests/test_permuted)
-    _, (w_pb, a_pb, traj_pb) = _time_warm(
-        lambda: gap_run("permuted", block=128), reps=1)
+    w_pb, a_pb, traj_pb = gap_run("permuted", block=128)
     rec_pb = traj_pb.records[-1]
     secs_pb, fixed_pb = _slope_time(
         lambda nr: make_run(nr, "permuted", block=128), rec_pb.round)
@@ -432,7 +437,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
         return lambda: run_sgd(ds, p, d2, local=local, quiet=True,
                                device_loop=True)
 
-    _, (w2, traj2) = _time_warm(make_sgd(100), reps=1)
+    w2, traj2 = make_sgd(100)()
     rec2 = traj2.records[-1]
     secs2, fixed2 = _slope_time(make_sgd, 100)
     rate_lsgd = _oracle_rounds_per_s_sgd((Xs, ys), 1e-3, n_sub // k // 10,
@@ -449,7 +454,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
                            k=k, h=h, path="exact", debug_iter=100))
 
     # Mini-batch SGD (SGD.scala local=false; fixed 100 rounds)
-    _, (w3, traj3) = _time_warm(make_sgd(100, local=False), reps=1)
+    w3, traj3 = make_sgd(100, local=False)()
     rec3 = traj3.records[-1]
     secs3, fixed3 = _slope_time(lambda nr: make_sgd(nr, local=False), 100)
     rate_mbsgd = _oracle_rounds_per_s_sgd((Xs, ys), 1e-3, n_sub // k // 10,
@@ -473,7 +478,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
         p = _P(n=n, num_rounds=nr, local_iters=h, lam=1e-3)
         return lambda: run_dist_gd(ds, p, d3, quiet=True, device_loop=True)
 
-    _, (w4, traj4) = _time_warm(make_dgd(50), reps=1)
+    w4, traj4 = make_dgd(50)()
     rec4 = traj4.records[-1]
     secs4, fixed4 = _slope_time(make_dgd, 50)
     # per-round cost is one full shard pass: rate scales 1/n at fixed d, k
@@ -531,7 +536,7 @@ def bench_rcv1(results, perf_rows, quick, data_dir=""):
                              math="fast", device_loop=True,
                              gap_target=gap_target, rng=rng)
 
-        _, (w, a, traj) = _time_warm(gap_run, reps=1)
+        w, a, traj = gap_run()
         rec = traj.records[-1]
         secs, fixed = _slope_time(make_run, rec.round)
         results.append(dict(
@@ -547,8 +552,7 @@ def bench_rcv1(results, perf_rows, quick, data_dir=""):
                                layout="sparse", nnz=nnz, path="pallas",
                                debug_iter=25))
 
-        _, (w_p, a_p, traj_p) = _time_warm(lambda: gap_run("permuted"),
-                                           reps=1)
+        w_p, a_p, traj_p = gap_run("permuted")
         rec_p = traj_p.records[-1]
         secs_p, fixed_p = _slope_time(
             lambda nr: make_run(nr, "permuted"), rec_p.round)
@@ -571,7 +575,7 @@ def bench_rcv1(results, perf_rows, quick, data_dir=""):
         return lambda: run_minibatch_cd(ds, p, d2, quiet=True, math="fast",
                                         device_loop=True)
 
-    _, (w2, a2, traj2) = _time_warm(make_mbcd(100), reps=1)
+    w2, a2, traj2 = make_mbcd(100)()
     rec2 = traj2.records[-1]
     secs2, fixed2 = _slope_time(make_mbcd, 100)
     rate_f = _oracle_rounds_per_s_csr(data, 1e-4, h, k, n, mode="frozen")
@@ -601,8 +605,9 @@ def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2, l2=0.0):
     sigma = float(k)
     r = -bvec.astype(np.float64)
     x = np.zeros(d)
-    t0 = time.perf_counter()
-    for t in range(1, rounds + 1):
+
+    def step(t):
+        nonlocal r
         dv_sum = np.zeros(n)
         for sh in range(k):
             idxs = sample_indices(0, range(t, t + 1), h, sizes[sh])[0]
@@ -621,7 +626,8 @@ def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2, l2=0.0):
                 x[gj] = tstar
             dv_sum += dv
         r = r + dv_sum
-    return rounds / (time.perf_counter() - t0)
+
+    return _round_rate(step, rounds)
 
 
 def bench_lasso(results, perf_rows, quick):
@@ -672,7 +678,7 @@ def bench_lasso(results, perf_rows, quick):
                                   device_loop=True, gap_target=1e-3 * p0,
                                   rng=rng_mode)
 
-        _, (x, r, traj) = _time_warm(gap_run, reps=1)
+        x, r, traj = gap_run()
         rec = traj.records[-1]
         secs, fixed = _slope_time(make_run, rec.round)
         rate = _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, l2=l2)
@@ -690,8 +696,7 @@ def bench_lasso(results, perf_rows, quick):
                                k=k, h=h, path="pallas", debug_iter=50))
 
         if l2 == 0.0:
-            _, (x_p, r_p, traj_p) = _time_warm(
-                lambda: gap_run("permuted"), reps=1)
+            x_p, r_p, traj_p = gap_run("permuted")
             rec_p = traj_p.records[-1]
             secs_p, fixed_p = _slope_time(
                 lambda nr: make_run(nr, "permuted"), rec_p.round)
@@ -822,11 +827,14 @@ def _sync_docs(results):
     had three generations of numbers)."""
     by = {r["config"]: r for r in results}
 
-    def row(cfg, label, extra=""):
+    def lookup(cfg):
         # real-dataset runs label their configs e.g. rcv1(real)-... — the
         # claims should follow whichever variant actually ran
-        r = by.get(cfg.replace("epsilon", "epsilon(real)")
-                   .replace("rcv1", "rcv1(real)")) or by.get(cfg)
+        return by.get(cfg.replace("epsilon", "epsilon(real)")
+                      .replace("rcv1", "rcv1(real)")) or by.get(cfg)
+
+    def row(cfg, label, extra=""):
+        r = lookup(cfg)
         if r is None:
             return ""
         vs = r.get("vs_oracle")
@@ -853,11 +861,9 @@ def _sync_docs(results):
     )
     _sync_doc_block(os.path.join(ROOT, "BASELINE.md"), base)
 
-    d = by.get("demo-cocoa+")
-    e = (by.get("epsilon(real)-cocoa+(block128)")
-         or by.get("epsilon-cocoa+(block128)"))
-    rc = (by.get("rcv1(real)-cocoa+(0.001)")
-          or by.get("rcv1-cocoa+(0.001)"))
+    d = lookup("demo-cocoa+")
+    e = lookup("epsilon-cocoa+(block128)")
+    rc = lookup("rcv1-cocoa+(0.001)")
     if d and e and rc:
         par = (
             f"See BASELINE.md / benchmarks/RESULTS.md (all numbers are the "
@@ -873,6 +879,44 @@ def _sync_docs(results):
             f"1e-3 in {rc['wallclock_s']} s.\n"
         )
         _sync_doc_block(os.path.join(ROOT, "PARITY.md"), par)
+
+    eb = lookup("epsilon-cocoa+(block128)")
+    ep = lookup("epsilon-cocoa+(permuted+block128)")
+    r3 = lookup("rcv1-cocoa+(0.001)")
+    r4 = lookup("rcv1-cocoa+(0.0001)")
+    la = lookup("lasso-proxcocoa+")
+    el = lookup("elastic-proxcocoa+")
+    d0 = lookup("demo-cocoa+")
+    dp = lookup("demo-cocoa+(permuted)")
+    if all(x for x in (eb, ep, r3, r4, la, el, d0, dp)):
+        readme = (
+            f"Recorded single-chip results (benchmarks/RESULTS.md; "
+            f"wall-clocks are the slope-measured steady state — the "
+            f"tunneled device's per-run dispatch overhead, reported "
+            f"separately as fixed_s, would otherwise swamp the small "
+            f"configs): the reference demo config in "
+            f"**{d0['wallclock_s']} s** ({d0['rounds']} comm-rounds "
+            f"reference-faithful, {dp['rounds']} with `--rng=permuted`); "
+            f"epsilon-like dense 400K×2000 in **{eb['wallclock_s']} s** "
+            f"({eb['rounds']} rounds with the fused block kernel; "
+            f"**{ep['rounds']} rounds** with `--rng=permuted`, same "
+            f"certified 1e-4 gap — comm-rounds are the baseline metric); "
+            f"rcv1-like sparse 20242×47236 in **{r3['wallclock_s']} s** "
+            f"to 1e-3 / **{r4['wallclock_s']} s** to 1e-4 "
+            f"({r3['rounds']} / {r4['rounds']} rounds — the 1e-4 count "
+            f"is λ=1e-4 conditioning, not kernel speed); lasso "
+            f"8192×32768 via ProxCoCoA+ in **{la['wallclock_s']} s** to "
+            f"a 1e-3 relative gap ({la['rounds']} rounds), elastic net "
+            f"(l2={el.get('l2')}) in **{el['wallclock_s']} s** "
+            f"({el['rounds']} rounds) with its smoothed-conjugate gap "
+            f"certificate.  RESULTS.md also carries the perf-accounting "
+            f"table (FLOPs, MFU, µs/coordinate-step, HBM floor, roofline "
+            f"bound per config — every config is latency-bound on the "
+            f"sequential coordinate chain, which is what the "
+            f"`--blockSize` kernel attacks); benchmarks/KERNELS.md "
+            f"records the controlled per-round kernel comparison.\n"
+        )
+        _sync_doc_block(os.path.join(ROOT, "README.md"), readme)
 
 
 def main():

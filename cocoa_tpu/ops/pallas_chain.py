@@ -303,6 +303,15 @@ def _fused_kernel(xb_ref, idxf_ref, idxft_ref, yb_ref, qb_ref, a0_ref,
     h = pl.program_id(0)
     b2 = b // 2
     dtype = xb_ref.dtype
+    # Precision: the Gram/margin products at DEFAULT measure EXACT against
+    # the sequential path (da error 0.0 at epsilon scale), but the
+    # vector-matrix Δw-update products lowered with ~bf16 error (2.9e-3
+    # relative — enough to stall the duality gap at ~3e-4, since the
+    # certificate rests on w = (1/λn)·Σyαx staying tight).  HIGHEST on
+    # everything OOMs the 16 MiB VMEM by ~1 MB of matmul temps at the
+    # k=8/B=128/d=2000 flagship shape, so it is applied ONLY where the
+    # error was measured: the dwu dots.
+    prec = jax.lax.Precision.HIGHEST
     dot2 = lambda a_, b_: jax.lax.dot_general(  # noqa: E731
         a_, b_, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dtype)
@@ -409,12 +418,12 @@ def _fused_kernel(xb_ref, idxf_ref, idxft_ref, yb_ref, qb_ref, a0_ref,
                 jax.lax.dot_general(
                     coefs[kk:kk + 1, :b2], s0_ref[kk],
                     (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
+                    preferred_element_type=jnp.float32, precision=prec,
                 )
                 + jax.lax.dot_general(
                     coefs[kk:kk + 1, b2:], xb_ref[kk],
                     (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
+                    preferred_element_type=jnp.float32, precision=prec,
                 )
             ).astype(dtype)
 

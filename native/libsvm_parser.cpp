@@ -135,13 +135,16 @@ void parse_region(const char* p, const char* fend, Sink* out) {
         while (p < eol && is_ws(*p)) ++p;
         if (p >= eol) break;
         char* stop = nullptr;
-        long idx = strtol(p, &stop, 10);
+        // strtoll, not strtol: on 32-bit-long platforms strtol clamps an
+        // overflowing index to LONG_MAX == INT32_MAX and the range guard
+        // below would wave it through as a valid aliased index
+        long long idx = strtoll(p, &stop, 10);
         if (stop == p || stop > eol) break;  // malformed / ran past eol
         if (stop == eol || *stop != ':') break;  // malformed
         // 1-based index must land in int32 after the -1 shift (idx<1 and
-        // strtol's ERANGE clamp included): out of range = malformed tail,
-        // matching the Python oracle — a silent cast would alias huge
-        // indices onto valid features
+        // strtoll's ERANGE clamp included — LLONG_MAX fails the test):
+        // out of range = malformed tail, matching the Python oracle — a
+        // silent cast would alias huge indices onto valid features
         if (idx < 1 || idx - 1 > INT32_MAX) break;
         p = stop + 1;
         if (p >= eol) break;  // "idx:" at line end: malformed tail

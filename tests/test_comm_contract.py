@@ -41,12 +41,12 @@ def _census(lowered_text: str) -> dict:
             for c in COLLECTIVES if lowered_text.count(f"stablehlo.{c}")}
 
 
-def _mesh_state(tiny_data, mesh, layout="dense"):
-    ds = shard_dataset(tiny_data, k=K, layout=layout, dtype=jnp.float64,
+def _mesh_state(tiny_data, mesh, layout="dense", dtype=jnp.float64):
+    ds = shard_dataset(tiny_data, k=K, layout=layout, dtype=dtype,
                        mesh=mesh)
-    w = jax.device_put(jnp.zeros(tiny_data.num_features),
+    w = jax.device_put(jnp.zeros(tiny_data.num_features, dtype),
                        primal_sharding(mesh))
-    alpha = jax.device_put(jnp.zeros((K, ds.n_shard)),
+    alpha = jax.device_put(jnp.zeros((K, ds.n_shard), dtype),
                            sharded_rows(mesh, extra_dims=1))
     return ds, w, alpha
 
@@ -73,19 +73,26 @@ def test_sdca_chunk_round_has_exactly_one_psum(tiny_data, math, alg_key):
 
 
 @pytest.mark.parametrize("chain", ["xla", "pallas_interpret"])
-def test_block_chunk_round_has_exactly_one_psum(tiny_data, chain):
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
+def test_block_chunk_round_has_exactly_one_psum(tiny_data, chain, dtype):
     """The block-coordinate inner loop (--blockSize) must not change the
     census: its gathers, Gram einsums, Pallas chain, and additive alpha
-    scatter are all shard-local — still ONE Δw psum per round."""
+    scatter are all shard-local — still ONE Δw psum per round.  The f32
+    parametrization lowers the FUSED per-block kernel (fused_fits needs
+    itemsize 4); f64 lowers the legacy split path."""
+    from cocoa_tpu.ops.pallas_chain import fused_fits
     from cocoa_tpu.solvers.cocoa import _alg_config, _make_chunk_kernel
 
     mesh = make_mesh(K)
-    ds, w, alpha = _mesh_state(tiny_data, mesh)
+    ds, w, alpha = _mesh_state(tiny_data, mesh, dtype=dtype)
     p = _params(tiny_data)
     alg = _alg_config(p, K, True)
+    block = 8 if chain == "xla" else 128
+    if chain != "xla" and dtype == jnp.float32:
+        assert fused_fits(1, block, tiny_data.num_features, 4), \
+            "f32 config must exercise the fused kernel"
     kernel = _make_chunk_kernel(mesh, p, K, alg, math="fast",
-                                block=8 if chain == "xla" else 128,
-                                block_chain=chain)
+                                block=block, block_chain=chain)
     idxs = jnp.zeros((C, K, H), dtype=jnp.int32)
     txt = jax.jit(kernel).lower(w, alpha, idxs, ds.shard_arrays()).as_text()
     assert _census(txt) == {"all_reduce": 2}, _census(txt)
