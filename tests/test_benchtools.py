@@ -1,0 +1,119 @@
+"""Benchmark-pipeline helpers: the slope-method timing math, the
+generated-doc sync, and the real-dataset shape pin.  These produce the
+recorded numbers and the claims in BASELINE.md/PARITY.md/README.md — a
+silent bug here corrupts every published figure, so the pure logic is
+pinned even though the suite itself only runs on hardware."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "benchmarks"))
+
+
+def _fake_clock(monkeypatch, fixed, per_round, log):
+    """Patch slope's perf_counter with a deterministic virtual clock where
+    running nr rounds advances time by fixed + nr*per_round."""
+    import slope as slope_mod
+
+    class Clock:
+        t = 0.0
+
+    monkeypatch.setattr(slope_mod.time, "perf_counter", lambda: Clock.t)
+
+    def make_run(nr):
+        def run():
+            Clock.t += fixed + nr * per_round
+            log.append(nr)
+        return run
+
+    return make_run
+
+
+def test_slope_time_cancels_fixed_cost(monkeypatch):
+    from slope import slope_time
+
+    fixed, per_round = 0.37, 0.004
+    log = []
+    make_run = _fake_clock(monkeypatch, fixed, per_round, log)
+    steady, fx = slope_time(make_run, 100, min_span_s=1.0, reps=2)
+    np.testing.assert_allclose(steady, 100 * per_round, rtol=1e-9)
+    np.testing.assert_allclose(fx, fixed, rtol=1e-9)
+    # no escalation needed: at m=4 the span is 300*0.004 = 1.2 >= 1.0
+    assert max(log) == 400, log
+
+
+def test_slope_time_escalates_when_fixed_dominates(monkeypatch):
+    from slope import slope_time
+
+    fixed, per_round = 2.0, 0.0004   # tiny workload under huge fixed cost
+    log = []
+    make_run = _fake_clock(monkeypatch, fixed, per_round, log)
+    steady, fx = slope_time(make_run, 100, min_span_s=1.0, reps=2)
+    np.testing.assert_allclose(steady, 100 * per_round, rtol=1e-9)
+    np.testing.assert_allclose(fx, fixed, rtol=1e-9)
+    # span at m: (m-1)*100*0.0004 >= 1.0 needs m >= 26 -> escalates to 32
+    assert max(log) == 3200, log
+
+
+def test_sync_doc_block_replaces_only_marked_region(tmp_path):
+    import run as run_mod
+
+    p = tmp_path / "DOC.md"
+    p.write_text("head\n<!-- GENERATED:bench -->\nOLD\n"
+                 "<!-- /GENERATED:bench -->\ntail\n")
+    run_mod._sync_doc_block(str(p), "NEW LINE\n")
+    assert p.read_text() == ("head\n<!-- GENERATED:bench -->\nNEW LINE\n"
+                             "<!-- /GENERATED:bench -->\ntail\n")
+    # marker-less file: untouched, no crash
+    q = tmp_path / "PLAIN.md"
+    q.write_text("nothing here\n")
+    run_mod._sync_doc_block(str(q), "NEW\n")
+    assert q.read_text() == "nothing here\n"
+
+
+def test_generated_docs_match_recorded_results():
+    """The committed BASELINE.md/PARITY.md/README.md generated blocks must
+    be derivable from the committed results.jsonl — re-running the sync
+    must be a no-op, or someone hand-edited a generated number."""
+    import run as run_mod
+
+    jl = os.path.join(ROOT, "benchmarks", "results.jsonl")
+    if not os.path.exists(jl):
+        pytest.skip("no recorded results.jsonl")
+    rows = [json.loads(line) for line in open(jl)]
+    rows = [r for r in rows if r.get("type") != "perf"]
+    docs = ["BASELINE.md", "PARITY.md", "README.md"]
+    # operate on COPIES in a temp ROOT — syncing in place would leave the
+    # tracked docs rewritten if the process dies mid-test
+    import shutil
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        before = {}
+        for d in docs:
+            shutil.copy(os.path.join(ROOT, d), os.path.join(td, d))
+            before[d] = open(os.path.join(td, d)).read()
+        real_root = run_mod.ROOT
+        run_mod.ROOT = td
+        try:
+            run_mod._sync_docs(rows)
+        finally:
+            run_mod.ROOT = real_root
+        after = {d: open(os.path.join(td, d)).read() for d in docs}
+        assert before == after, [d for d in docs if before[d] != after[d]]
+
+
+def test_maybe_real_rejects_wrong_shape(tmp_path):
+    import run as run_mod
+
+    p = tmp_path / "rcv1_train.binary"
+    p.write_text("1 1:0.5 3:0.25\n-1 2:1.0\n")
+    with pytest.raises(ValueError, match="published shape"):
+        run_mod._maybe_real(str(tmp_path), "rcv1_train.binary")
+    assert run_mod._maybe_real(str(tmp_path / "nope"),
+                               "rcv1_train.binary") is None
