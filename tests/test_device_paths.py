@@ -147,3 +147,32 @@ def test_device_loop_records_block_timestamps(tiny_data, monkeypatch):
     assert all(s > 0 for s in stamps)
     # every block boundary (here: every chunk) is stamped
     assert traj.records[-1].wall_time is not None
+
+
+def test_device_loop_ckpt_round_matches_early_stop(tiny_data, tmp_path):
+    """A gap-target run can stop the device while_loop mid-super-block;
+    the checkpoint saved at that block's boundary must carry the round
+    the run ACTUALLY executed (one eval record per executed chunk), not
+    the nominal block end — a later --resume would otherwise skip rounds
+    the round-keyed sampler never ran."""
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu.solvers import run_cocoa
+
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    p = _params(tiny_data, num_rounds=60, local_iters=25, lam=0.001)
+    # debug_iter=2, chkpt_iter=10 -> blocks of 5 chunks (10 rounds); a
+    # loose gap target stops well before round 60, usually mid-block
+    dbg = DebugParams(debug_iter=2, seed=0, chkpt_iter=10,
+                      chkpt_dir=str(tmp_path))
+    w, a, traj = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                           device_loop=True, gap_target=0.15)
+    last_round = traj.records[-1].round
+    assert traj.records[-1].gap <= 0.15
+    assert last_round < 60, "target must hit before the round cap"
+    path = ckpt_lib.latest(str(tmp_path), "CoCoA+")
+    assert path is not None, "device loop saved no checkpoint"
+    meta, _w, _a = ckpt_lib.load(path)
+    assert meta["round"] <= last_round, (
+        f"checkpoint round {meta['round']} overstates executed "
+        f"round {last_round}"
+    )
