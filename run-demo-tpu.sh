@@ -4,9 +4,9 @@
 # on-device while_loop (one dispatch, one host fetch), random-reshuffling
 # sampling (~25% fewer comm-rounds here, ~5x at epsilon scale; the duality
 # gap certificate is exact under any index stream), stopping at the
-# certified 1e-4 gap instead of a fixed round budget.  Append --blockSize=256
-# on large dense problems (H >= a few hundred) for the block-coordinate
-# MXU inner loop.
+# certified 1e-4 gap instead of a fixed round budget.  Append --blockSize=128
+# on large dense problems (H >= a few hundred) for the fused block-
+# coordinate MXU kernel (2.3x faster epsilon rounds, benchmarks/KERNELS.md).
 cd "$(dirname "$0")"
 exec python -m cocoa_tpu.cli \
   --trainFile=data/small_train.dat \
