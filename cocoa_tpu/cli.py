@@ -48,7 +48,7 @@ from cocoa_tpu.parallel import make_mesh
 from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
 
 _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
-              "smoothing")  # same-named RunConfig fields
+              "smoothing", "sampling")  # same-named RunConfig fields
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize")  # run-level
@@ -326,6 +326,7 @@ def main(argv=None) -> int:
                                  start_round=meta["round"] + 1)
         x, r, traj = run_prox_cocoa(
             ds_c, b, lasso_params, cfg.to_debug(), mesh=mesh, rng=cfg.rng,
+            sampling=cfg.sampling,
             gap_target=gap_target, scan_chunk=cfg.scan_chunk,
             math=cfg.math, device_loop=cfg.device_loop,
             block_size=block_size, **resume_kw,
@@ -374,7 +375,8 @@ def main(argv=None) -> int:
             path = f"{extras['trajOut']}.{traj.algorithm.replace(' ', '_')}.jsonl"
             traj.dump_jsonl(path)
 
-    common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng)
+    common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng,
+                  sampling=cfg.sampling)
 
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
                     math=cfg.math, device_loop=cfg.device_loop,

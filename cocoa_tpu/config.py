@@ -73,6 +73,11 @@ class RunConfig:
                                  #   per-epoch permutations, every coordinate
                                  #   once per epoch (~5x fewer comm-rounds to
                                  #   the certified gap at epsilon scale)
+    sampling: str = "auto"       # where index tables are generated:
+                                 # "auto" (in-jit on device whenever exact —
+                                 # the production default; tunneled h2d is
+                                 # ~10 MB/s with shards resident), "device",
+                                 # or "host" (concrete tables, debug path)
     scan_chunk: int = 0          # >0: run rounds device-side in lax.scan blocks
                                  # of this size (one dispatch per block)
     math: str = "exact"          # "exact": reference-order float ops (bit-

@@ -8,10 +8,17 @@ H steps and lets Pallas's grid pipeline prefetch each sampled row HBM→VMEM
 (double-buffered) while the previous step computes.
 
 Uses the margins decomposition (ops/local_sdca.py ``mode_factors``): the
-per-step margin is ``margins0[idx] + sig_eff·(x·Δw)`` with margins0 = X·w₀
-precomputed outside the kernel as one MXU matvec per round.  Per step the
-kernel does one row·Δw dot, scalar box-projection logic, one row axpy, and
-an α write.
+per-step margin is ``x·w₀ + sig_eff·(x·Δw)``, with **both dots computed
+in-kernel** against the VMEM-resident w₀ and Δw.  Round 3 precomputed
+margins0 = X·w₀ as one MXU matvec per round instead; round 4 retired it:
+the sampled row is already in VMEM for the axpy, so the w₀ dot is one more
+VPU reduce on data the step touches anyway (measured: free — scalar
+address generation bounds the step), while the matvec reads ALL of X every
+round — at localIterFrac = 0.1 that is 10× the rows the round touches
+(~90% of the demo round's HBM traffic, ~4 ms/round at epsilon scale).
+The sparse kernel (ops/pallas_sparse.py) has computed margins in-kernel
+since round 2 for the same reason.  Per step the kernel does the two row
+dots, scalar box-projection logic, one row axpy, and an α write.
 
 **Folded rows.**  A (1, d) row uses one sublane — 1/8 of the VPU.  The
 caller reinterprets each dense row as an (8, d/8) tile instead (a free
@@ -84,12 +91,12 @@ def check_dtype(dtype) -> None:
 
 def vmem_estimate(n_shard: int, d: int, itemsize: int, unroll: int) -> int:
     """Rough VMEM working set of the kernel: the lane-concatenated stacked
-    state (4·n_pad input, double-buffered across the k advance, + 4·n_pad
-    scratch) + the α output (double-buffered) — 14 n_pad-vectors total —
-    the Δw scratch/output plus temporaries (~4 d-vectors), and ``unroll``
-    double-buffered folded row blocks."""
+    state (3·n_pad input, double-buffered across the k advance, + 3·n_pad
+    scratch) + the α output (double-buffered) — 11 n_pad-vectors total —
+    the w₀ operand, the Δw scratch/output plus temporaries (~5 d-vectors),
+    and ``unroll`` double-buffered folded row blocks."""
     n_pad = -(-n_shard // LANES) * LANES
-    return itemsize * (14 * n_pad + (2 * unroll + 4) * d)
+    return itemsize * (11 * n_pad + (2 * unroll + 5) * d)
 
 
 def pick_unroll(n_shard: int, d: int, itemsize: int, h: int) -> int:
@@ -111,10 +118,11 @@ INTERLEAVE_BUDGET = 14 << 20  # measured headroom: flush-only outputs and the
 def interleave_vmem_estimate(k: int, n_shard: int, d: int, itemsize: int,
                              unroll: int) -> int:
     """Working set of the shard-interleaved kernel: ALL K shards' stacked
-    state resident at once (4·n_pad input + 4·n_pad scratch each), the Δw
-    accumulators/outputs, and K·unroll double-buffered row blocks."""
+    state resident at once (3·n_pad input + 3·n_pad scratch each), the w₀
+    operand, the Δw accumulators/outputs, and K·unroll double-buffered row
+    blocks."""
     n_pad = -(-n_shard // LANES) * LANES
-    return itemsize * (8 * k * n_pad + 3 * k * d + 2 * k * unroll * d)
+    return itemsize * (6 * k * n_pad + 3 * k * d + d + 2 * k * unroll * d)
 
 
 def pick_interleave(k: int, n_shard: int, d: int, itemsize: int, h: int) -> int:
@@ -144,37 +152,39 @@ def fold_rows(X: jax.Array) -> jax.Array:
     return X.reshape(k, n_shard, SUBLANES, d // SUBLANES)
 
 
-STACK = 4  # lane-concatenated per-shard rows: [margins0, labels, sqn, alpha]
+STACK = 3  # lane-concatenated per-shard rows: [labels, sqn, alpha]
 
 
-def _step_body(srow, sub_lane, live, x, dw_k, *, frozen, sig_eff,
+def _step_body(srow, sub_lane, live, x, dw_k, w_k, *, frozen, sig_eff,
                qii_factor, lam_n, coef_div, loss, smoothing):
-    """One coordinate step given the (1, 4·LANES) lane-concatenated state
-    row (margins0 in lanes [0,128), labels [128,256), ‖x‖² [256,384),
-    α [384,512)).  Returns (new row, Δw contribution).
+    """One coordinate step given the (1, 3·LANES) lane-concatenated state
+    row (labels in lanes [0,128), ‖x‖² [128,256), α [256,384)).  Returns
+    (new row, Δw contribution).
 
     The concatenated layout is the kernel's key scalar-unit optimization:
-    all four per-step values arrive from ONE dynamic slice, and the α
+    all three per-step values arrive from ONE dynamic slice, and the α
     write goes back through the same row — 2 dynamically-addressed VMEM
-    accesses per step instead of 6.  Address generation on the scalar core
+    accesses per step instead of 5.  Address generation on the scalar core
     is the per-step bottleneck, not the O(d) vector work (measured: the
-    frozen mode, which skips the Δw dot entirely, costs the same)."""
+    frozen mode, which skips the Δw dot entirely, costs the same) — which
+    is also why the base margin is one more VPU reduce against the
+    VMEM-resident w₀ rather than a precomputed margins0 read (see the
+    module docstring: the whole-shard matvec it replaces was most of the
+    round's HBM traffic)."""
     lane4 = jax.lax.broadcasted_iota(jnp.int32, (1, STACK * LANES), 1)
-    m0 = jnp.sum(jnp.where(lane4 == sub_lane, srow, 0.0))
-    y = jnp.sum(jnp.where(lane4 == sub_lane + LANES, srow, 0.0))
-    sq = jnp.sum(jnp.where(lane4 == sub_lane + 2 * LANES, srow, 0.0))
-    a = jnp.sum(jnp.where(lane4 == sub_lane + 3 * LANES, srow, 0.0))
+    y = jnp.sum(jnp.where(lane4 == sub_lane, srow, 0.0))
+    sq = jnp.sum(jnp.where(lane4 == sub_lane + LANES, srow, 0.0))
+    a = jnp.sum(jnp.where(lane4 == sub_lane + 2 * LANES, srow, 0.0))
 
-    if frozen:
-        margin = m0
-    else:
-        margin = m0 + sig_eff * jnp.sum(x * dw_k)
+    margin = jnp.sum(x * w_k)
+    if not frozen:
+        margin = margin + sig_eff * jnp.sum(x * dw_k)
     # the dual coordinate update is pure scalar jnp — shared with the
     # fori_loop kernels via ops/losses.py (hinge = CoCoA.scala:166-178)
     new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor, lam_n,
                               smoothing=smoothing)
     coef = y * (new_a - a) / coef_div
-    wmask = lane4 == sub_lane + 3 * LANES
+    wmask = lane4 == sub_lane + 2 * LANES
     if live is not None:   # tail group past H (only when unroll ∤ H): inert
         coef = jnp.where(live, coef, 0.0)
         wmask = wmask & live
@@ -183,7 +193,7 @@ def _step_body(srow, sub_lane, live, x, dw_k, *, frozen, sig_eff,
 
 def _kernel(
     idxs_ref,        # scalar-prefetch: (K, H) int32 sampled rows
-    *refs,           # S row blocks, stacked vecs, 2 outs, 2 scratch (below)
+    *refs,           # S row blocks, w, stacked vecs, 2 outs, 2 scratch
     lam_n: float,
     coef_div: float,
     sig_eff: float,
@@ -197,14 +207,15 @@ def _kernel(
 ):
     # refs layout:
     #   x_refs[j]      (1, 1, 8, d8) VMEM: folded row of sample j
-    #   stacked_in     (1, n_blocks, 4·LANES) VMEM: shard k's lane-blocked
-    #                  [margins0 | labels | sq_norms | alpha] concatenation
+    #   w_ref          (8, d8) VMEM: the replicated w₀ (margin base)
+    #   stacked_in     (1, n_blocks, 3·LANES) VMEM: shard k's lane-blocked
+    #                  [labels | sq_norms | alpha] concatenation
     #   dw_ref         out (1, 8, d8) VMEM: shard k's Δw (flushed on k advance)
     #   alpha_ref      out (1, n_blocks, LANES) VMEM (flushed on k advance)
     #   dw_acc         scratch (8, d8) VMEM: this shard's Δw accumulator
-    #   stacked_sc     scratch (n_blocks, 4·LANES): the advancing state
+    #   stacked_sc     scratch (n_blocks, 3·LANES): the advancing state
     x_refs = refs[:unroll]
-    stacked_in, dw_ref, alpha_ref, dw_acc, stacked_sc = refs[unroll:]
+    w_ref, stacked_in, dw_ref, alpha_ref, dw_acc, stacked_sc = refs[unroll:]
     k_ = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -224,10 +235,11 @@ def _kernel(
         idx = idxs_ref[k_, step if exact else jnp.minimum(step, h - 1)]
         live = None if exact else step < h
         blk = idx // LANES
-        srow = stacked_sc[pl.ds(blk, 1)]      # (1, 4·LANES): one dyn read
+        srow = stacked_sc[pl.ds(blk, 1)]      # (1, 3·LANES): one dyn read
         x = x_refs[j][0, 0]                   # (8, d8): the folded row
         new_row, dws = _step_body(
-            srow, idx - blk * LANES, live, x, dw_acc[...], frozen=frozen,
+            srow, idx - blk * LANES, live, x, dw_acc[...], w_ref[...],
+            frozen=frozen,
             sig_eff=sig_eff, qii_factor=qii_factor, lam_n=lam_n,
             coef_div=coef_div, loss=loss, smoothing=smoothing,
         )
@@ -237,7 +249,7 @@ def _kernel(
     @pl.when(i == n_groups - 1)
     def _flush_shard():
         dw_ref[0] = dw_acc[...]
-        alpha_ref[0] = stacked_sc[:, 3 * LANES:]
+        alpha_ref[0] = stacked_sc[:, 2 * LANES:]
 
 
 def _kernel_interleaved(
@@ -263,10 +275,11 @@ def _kernel_interleaved(
     scale, where the chain latency, not bandwidth, is the bound).  Needs
     all K shards' stacked state VMEM-resident (interleave_vmem_estimate)."""
     x_refs = refs[:k * unroll]           # x_refs[j*k + kk]
-    stacked_in = refs[k * unroll]
-    dw_ref, alpha_ref = refs[k * unroll + 1:k * unroll + 3]
-    dw_accs = refs[k * unroll + 3:k * unroll + 3 + k]
-    st_scs = refs[k * unroll + 3 + k:]
+    w_ref = refs[k * unroll]
+    stacked_in = refs[k * unroll + 1]
+    dw_ref, alpha_ref = refs[k * unroll + 2:k * unroll + 4]
+    dw_accs = refs[k * unroll + 4:k * unroll + 4 + k]
+    st_scs = refs[k * unroll + 4 + k:]
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -287,6 +300,7 @@ def _kernel_interleaved(
             x = x_refs[j * k + kk][0, 0]
             new_row, dws = _step_body(
                 srow, idx - blk * LANES, live, x, dw_accs[kk][...],
+                w_ref[...],
                 frozen=frozen, sig_eff=sig_eff, qii_factor=qii_factor,
                 lam_n=lam_n, coef_div=coef_div, loss=loss,
                 smoothing=smoothing,
@@ -298,7 +312,7 @@ def _kernel_interleaved(
     def _flush():
         for kk in range(k):
             dw_ref[kk] = dw_accs[kk][...]
-            alpha_ref[kk] = st_scs[kk][:, 3 * LANES:]
+            alpha_ref[kk] = st_scs[kk][:, 2 * LANES:]
 
 
 @functools.partial(
@@ -307,7 +321,7 @@ def _kernel_interleaved(
                      "smoothing", "unroll", "interleave"),
 )
 def pallas_sdca_round(
-    w_margins0: jax.Array,   # (K, n_shard) precomputed X·w₀ per shard
+    w: jax.Array,            # (d,) the replicated primal vector w₀
     alpha: jax.Array,        # (K, n_shard)
     X: jax.Array,            # (K, n_shard, d) dense rows
     labels: jax.Array,       # (K, n_shard)
@@ -379,7 +393,7 @@ def pallas_sdca_round(
     sig_eff, qii_factor = mode_factors(mode, sigma)
 
     # lane-block the per-shard vectors and lane-concatenate them into the
-    # (K, n_blocks, 4·128) stacked state the kernel reads with ONE dynamic
+    # (K, n_blocks, 3·128) stacked state the kernel reads with ONE dynamic
     # slice per step (see _step_body).  Sampled indices never exceed the
     # shard's true row count, so zero padding is inert.
     n_pad = -(-n_shard // LANES) * LANES
@@ -387,9 +401,11 @@ def pallas_sdca_round(
     blocked = lambda v: jnp.pad(v, pad).reshape(k, n_pad // LANES, LANES)  # noqa: E731
     n_blocks = n_pad // LANES
     stacked = jnp.concatenate(
-        [blocked(w_margins0), blocked(labels), blocked(sq_norms),
-         blocked(alpha)], axis=-1,
+        [blocked(labels), blocked(sq_norms), blocked(alpha)], axis=-1,
     )  # (K, n_blocks, STACK*LANES)
+    # the replicated w₀, folded like the rows (free reshape: contiguous)
+    w_pad = jnp.pad(w.astype(dtype), (0, d - w.shape[0]))
+    w_folded = w_pad.reshape(SUBLANES, d8)
 
     def row_spec(j, kk=None):
         # sample j of group i: the folded row at [shard, idx, :, :].  Groups
@@ -432,6 +448,7 @@ def pallas_sdca_round(
             in_specs=[
                 *[row_spec(j, kk)
                   for j in range(unroll) for kk in range(k)],
+                pl.BlockSpec((SUBLANES, d8), lambda i_, idxs_: (0, 0)),
                 pl.BlockSpec((k, n_blocks, STACK * LANES),
                              lambda i_, idxs_: (0, 0, 0)),
             ],
@@ -456,6 +473,7 @@ def pallas_sdca_round(
             grid=(k, n_groups),
             in_specs=[
                 *[row_spec(j) for j in range(unroll)],
+                pl.BlockSpec((SUBLANES, d8), lambda k_, i_, idxs_: (0, 0)),
                 pl.BlockSpec((1, n_blocks, STACK * LANES),
                              lambda k_, i_, idxs_: (k_, 0, 0)),
             ],
@@ -484,6 +502,6 @@ def pallas_sdca_round(
             dimension_semantics=semantics,
         ),
         interpret=interpret,
-    )(idxs, *([X_folded] * n_row_ops), stacked)
+    )(idxs, *([X_folded] * n_row_ops), w_folded, stacked)
     alpha_inner = alpha_blocked.reshape(k, n_pad)[:, :n_shard]
     return dw.reshape(k, d)[:, :d_orig], alpha_inner

@@ -363,8 +363,7 @@ def drive_device_full(
         # is num_rounds × n ints — a memory cliff the chunked driver doesn't
         # have.  Split into super-blocks of at most ~256 MB of indices;
         # the early-stop test between blocks costs one host sync per block.
-        k = int(np.atleast_1d(sampler.counts).shape[0])
-        chunk_ints = c * k * sampler.h
+        chunk_ints = c * sampler.ints_per_round()
         max_block = max(1, MAX_IDX_TABLE_BYTES // (4 * chunk_ints))
         if ckpt_on:
             # a boundary (host sync + save opportunity) at least every
@@ -483,12 +482,14 @@ def check_shards(ds: ShardedDataset) -> None:
 class IndexSampler:
     """Per-round local-coordinate sampling, in one of three modes.
 
-    - ``reference``: host-side java.util.Random replay — identical draws to
-      the Scala code per (seed+t, n_local), correlated across equal-size
-      shards exactly as the reference is (CoCoA.scala:45,144).
-    - ``jax``: device-friendly ``jax.random`` folded per (seed, round, shard)
-      — decorrelated across shards (statistical improvement, not
-      reference-faithful).
+    - ``reference``: java.util.Random replay — identical draws to the Scala
+      code per (seed+t, n_local), correlated across equal-size shards
+      exactly as the reference is (CoCoA.scala:45,144).
+    - ``jax``: stateless counter-hash draws keyed per (seed, round, shard,
+      position) — decorrelated across shards (statistical improvement, not
+      reference-faithful).  NOT jax.random: batched-key threefry costs
+      ~100 ms per dispatch through this device path (utils/prng.py module
+      note); the mode's contract is decorrelation, not a specific stream.
     - ``permuted``: random reshuffling — each shard walks a fresh
       per-epoch permutation of its rows, so every coordinate is touched
       exactly once per n_local draws.  With-replacement sampling leaves
@@ -500,92 +501,138 @@ class IndexSampler:
       (CoCoA.scala:151); the duality-gap certificate is computed exactly
       from (w, α) and stays valid under ANY index stream, which is what
       makes this safe to flag-gate.
-    """
+
+    **Where the tables are generated** (``device`` attr): index draws are
+    data-independent, so generation can happen anywhere; what matters on a
+    tunneled TPU is that the tables NOT cross the host↔device link — with
+    multi-GB shards resident, h2d collapses to ~10 MB/s and the per-round
+    (K, H) table upload costs more than the entire fused kernel round
+    (measured round 4; the reference itself draws inside each partition's
+    task, CoCoA.scala:144).  With ``device=True`` (the production default —
+    solvers auto-enable it for the chunked/device-loop paths)
+    :meth:`chunk_indices` returns a tiny ``{"t": (C,) int32}`` spec and the
+    solver's jitted chunk generates the (C, K, H) tables in-jit via
+    :meth:`tables_from_ts` — bit-identical to the host tables for every
+    mode (reference replay validated in tests/test_device_sampling.py; jax
+    and permuted are the same jax.random ops either way, and the jax PRNG
+    is backend-invariant)."""
 
     MODES = ("reference", "jax", "permuted")
 
-    def __init__(self, mode: str, seed: int, h: int, counts: np.ndarray):
+    def __init__(self, mode: str, seed: int, h: int, counts: np.ndarray,
+                 device: bool = False):
         if mode not in self.MODES:
             raise ValueError(f"rng mode must be one of {self.MODES}, got {mode!r}")
         self.mode = mode
         self.seed = seed
         self.h = h
         self.counts = np.asarray(counts)
-        self._key = None
-        self._perm_cache: dict = {}
-        if mode == "jax":
-            self._key = jax.random.key(seed)
+        self.device = device
+        if np.any(self.counts <= 0):
+            raise ValueError(
+                f"all shards must be non-empty, got sizes {self.counts}")
+
+    def cache_token(self):
+        """Hashable identity of the in-jit generation closure (device mode
+        bakes the sampling configuration into the executable)."""
+        return (self.mode, self.seed, self.h, tuple(self.counts.tolist()),
+                self.device)
+
+    def device_capable(self, max_round: int) -> bool:
+        """Whether in-jit generation is exact for this run.  Permuted mode
+        walks global steps (t-1)·H..; int32 arithmetic bounds both it and
+        the host twin (one implementation), so an overflowing config is
+        rejected eagerly rather than degraded."""
+        from cocoa_tpu.utils.prng import device_replay_ok
+
+        if self.mode == "reference":
+            return device_replay_ok(self.seed, max_round)
+        if self.mode == "permuted":
+            return (max_round + 1) * self.h < (1 << 31)
+        return True
+
+    def ints_per_round(self) -> int:
+        """Index-table ints crossing the host↔device link per round — what
+        the device-loop driver sizes its super-blocks by."""
+        k = self.counts.shape[0]
+        return 1 if self.device else k * self.h
 
     def round_indices(self, t: int) -> jax.Array:
-        """(K, H) int32 index table for round t (1-based, as the reference)."""
-        return self.chunk_indices(t, 1)[0]
+        """(K, H) int32 index table for round t (1-based, as the reference).
+        Always concrete (host-stepped drivers)."""
+        return self._tables(t, 1)[0]
 
-    def chunk_indices(self, t0: int, c: int) -> jax.Array:
-        """(C, K, H) int32 tables for rounds t0..t0+c-1 (device-side scan
-        consumes one (K, H) slice per round)."""
+    def chunk_indices(self, t0: int, c: int):
+        """Tables for rounds t0..t0+c-1: a concrete (C, K, H) int32 array,
+        or — in device mode — the ``{"t": (C,) int32}`` spec the solver
+        kernels expand in-jit via :meth:`tables_from_ts`."""
+        import jax.numpy as jnp
+
+        if self.device:
+            return {"t": jnp.arange(t0, t0 + c, dtype=jnp.int32)}
+        return self._tables(t0, c)
+
+    def _tables(self, t0: int, c: int) -> jax.Array:
         import jax.numpy as jnp
 
         if self.mode == "reference":
+            # numpy replay (handles the full java long seed range)
             tab = sample_indices_per_shard(
                 self.seed, range(t0, t0 + c), self.h, self.counts
             )  # (K, C, H)
             return jnp.asarray(np.swapaxes(tab, 0, 1))
+        # jax/permuted: one implementation for host and device tables (the
+        # jax PRNG is backend-invariant, so eager-vs-jit agree bitwise)
+        return self.tables_from_ts(jnp.arange(t0, t0 + c, dtype=jnp.int32))
+
+    def tables_from_ts(self, ts) -> jax.Array:
+        """Traceable: (C,) int32 round numbers -> (C, K, H) int32 tables.
+        The in-jit twin of :meth:`_tables`; rounds must be consecutive
+        (chunk calls always are — the permuted stream slices on ts[0])."""
+        from cocoa_tpu.utils import prng
+
+        if self.mode == "reference":
+            return prng.device_sample_per_shard(self.seed, ts, self.h,
+                                                self.counts)
         if self.mode == "permuted":
-            return jnp.asarray(self._permuted_tables(t0, c))
-        k = self.counts.shape[0]
-        bounds = jnp.asarray(self.counts, dtype=jnp.int32)
-        keys = [jax.random.fold_in(self._key, t) for t in range(t0, t0 + c)]
-        return jnp.stack([
-            jax.random.randint(
-                key, (k, self.h), minval=0, maxval=bounds[:, None],
-                dtype=jnp.int32,
-            )
-            for key in keys
-        ])
+            return prng.permuted_tables(self.seed, ts, self.h, self.counts)
+        return prng.hash_tables(self.seed, ts, self.h, self.counts)
 
-    def _permuted_tables(self, t0: int, c: int) -> np.ndarray:
-        """Random-reshuffling tables: shard s's draws form one continuous
-        stream across rounds — global step g = (t-1)·H + j reads
-        perm_{g // n_s}[g % n_s], with a fresh deterministic permutation
-        per (seed, shard, epoch).  Epoch boundaries mid-round (or several
-        epochs per round when H > n_s) are exact: each epoch covers every
-        coordinate exactly once, resumable from any round."""
-        k = self.counts.shape[0]
-        out = np.empty((c, k, self.h), np.int32)
-        g0, g1 = (t0 - 1) * self.h, (t0 - 1 + c) * self.h
-        for s in range(k):
-            cnt = int(self.counts[s])
-            vals = np.empty(g1 - g0, np.int32)
-            # epochs cover contiguous global-step ranges — fill by slices
-            for e in range(g0 // cnt, (g1 - 1) // cnt + 1):
-                perm = self._epoch_perm(s, e, cnt)
-                lo, hi = max(g0, e * cnt), min(g1, (e + 1) * cnt)
-                vals[lo - g0:hi - g0] = perm[lo - e * cnt:hi - e * cnt]
-            out[:, s, :] = vals.reshape(c, self.h)
-        return out
 
-    def _epoch_perm(self, s: int, e: int, cnt: int) -> np.ndarray:
-        """Deterministic permutation for (seed, shard, epoch), memoized:
-        the host-stepped path consumes each epoch across up to cnt/H
-        chunk_indices calls, and regenerating an O(n_shard) shuffle per
-        call is pure rework.  One entry per shard suffices — streams are
-        consumed sequentially (chunks may straddle two epochs; the newer
-        one wins the cache slot and the older is a one-off regen)."""
-        key = (s, e)
-        perm = self._perm_cache.get(key)
-        if perm is None:
-            perm = np.random.default_rng(
-                # SeedSequence rejects negative entries; mask to the full
-                # 64-bit word so any int seed works (like the other modes)
-                # without collapsing seeds that differ above bit 31
-                np.random.SeedSequence(
-                    [self.seed & 0xFFFFFFFFFFFFFFFF, s, e])
-            ).permutation(cnt).astype(np.int32)
-            self._perm_cache[key] = perm
-            # evict this shard's older epochs (sequential consumption)
-            for old in [o for o in self._perm_cache if o[0] == s and o[1] < e]:
-                del self._perm_cache[old]
-        return perm
+def resolve_sampling(sampling: str, sampler: "IndexSampler",
+                     max_round: int) -> bool:
+    """Resolve the ``--sampling`` flag to the sampler's ``device`` switch.
+
+    ``auto`` (default) generates index tables in-jit on the device whenever
+    the mode's in-jit arithmetic is exact for this run — the production
+    choice: with multi-GB shards resident, a tunneled device moves index
+    tables at ~10 MB/s, costing more per round than the kernels themselves
+    (see IndexSampler).  ``host`` forces concrete host-side tables (the
+    validation/debug path); ``device`` asserts in-jit generation is usable.
+    """
+    if sampling not in ("auto", "device", "host"):
+        raise ValueError(
+            f"sampling must be auto|device|host, got {sampling!r}")
+    capable = sampler.device_capable(max_round)
+    if not capable and sampler.mode == "permuted":
+        # permuted has ONE implementation (host tables are the same int32
+        # jnp stream evaluated eagerly), so an overflowing config has no
+        # exact fallback — reject it eagerly rather than silently wrap
+        raise ValueError(
+            f"rng=permuted overflows int32 global-step arithmetic for "
+            f"num_rounds={max_round}, localIters={sampler.h} "
+            f"((rounds+1)*H must stay below 2^31); split the run via "
+            f"checkpoint/resume or lower localIterFrac"
+        )
+    if sampling == "host":
+        return False
+    if sampling == "device" and not capable:
+        raise ValueError(
+            f"device sampling is not exact for rng={sampler.mode!r} with "
+            f"seed={sampler.seed}, num_rounds={max_round} (int32 range); "
+            f"use --sampling=host"
+        )
+    return capable
 
 
 def drive_device_paths(
@@ -663,10 +710,36 @@ class TsSampler:
         self.h = sampler.h if sampler is not None else 1
         self.counts = sampler.counts if sampler is not None else np.asarray(counts)
 
+    @property
+    def device(self) -> bool:
+        return self.sampler is not None and self.sampler.device
+
+    def cache_token(self):
+        return None if self.sampler is None else self.sampler.cache_token()
+
+    def ints_per_round(self) -> int:
+        return 1 if self.sampler is None else self.sampler.ints_per_round()
+
     def chunk_indices(self, t0: int, c: int):
         import jax.numpy as jnp
 
         out = {"t": jnp.arange(t0, t0 + c, dtype=self.dtype)}
         if self.sampler is not None:
-            out["idxs"] = self.sampler.chunk_indices(t0, c)
+            if self.sampler.device:
+                # exact int32 round numbers for in-jit generation — the
+                # float ``t`` leaf rides the compute dtype for the η(t)
+                # schedules and cannot carry them (bf16 collapses integers
+                # past 256)
+                out["ti"] = jnp.arange(t0, t0 + c, dtype=jnp.int32)
+            else:
+                out["idxs"] = self.sampler.chunk_indices(t0, c)
         return out
+
+    def materialize(self, xs):
+        """Traceable: fill the ``idxs`` leaf from the int32 ``ti`` leaf
+        when the inner sampler generates on device (the chunk tables are
+        otherwise passed through untouched; the extra (C,) ``ti`` leaf
+        scans as an inert per-round scalar)."""
+        if self.sampler is None or "idxs" in xs:
+            return xs
+        return {**xs, "idxs": self.sampler.tables_from_ts(xs["ti"])}

@@ -44,12 +44,14 @@ def _pallas_batched(w, alpha, idxs_kh, shards, params, mode, sigma,
             params.lam, params.n, **common,
         )
     from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
-    from cocoa_tpu.ops.rows import shard_margins
 
-    m0 = shard_margins(w, shards)   # (K, n_shard): batched matvec
+    # margins are computed in-kernel against the VMEM-resident w (round 4;
+    # the sampled row is DMA'd for the axpy anyway — precomputing X·w read
+    # ALL of X per round, ~10x the rows the round touches at
+    # localIterFrac=0.1)
     Xf = shards.get("X_folded", shards["X"])
     return pallas_sdca_round(
-        m0, alpha, Xf, shards["labels"], shards["sq_norms"], idxs_kh,
+        w, alpha, Xf, shards["labels"], shards["sq_norms"], idxs_kh,
         params.lam, params.n, **common,
     )
 
@@ -203,13 +205,18 @@ def make_round_step(mesh, params: Params, k: int, alg, **parts_kw):
     return round_step
 
 
-def _make_chunk_kernel(mesh, params: Params, k: int, alg, **parts_kw):
+def _make_chunk_kernel(mesh, params: Params, k: int, alg, sampler=None,
+                       **parts_kw):
     """The un-jitted traceable chunk body shared by :func:`make_chunk_step`
     and the device-resident driver (so the two cannot diverge):
     (w, alpha, idxs_ckh, shard_arrays) -> (w', alpha'), C rounds as one
     ``lax.scan`` (parallel/fanout.py chunk_fanout).  On Pallas configs the
     caller (_run_sdca) pre-folds ``shard_arrays["X_folded"]`` once per run —
-    the kernel itself never folds, so no per-dispatch relayout."""
+    the kernel itself never folds, so no per-dispatch relayout.
+
+    ``idxs_ckh`` is a concrete (C, K, H) table, or — device-sampling mode —
+    the ``{"t": (C,)}`` spec expanded in-jit through ``sampler`` (index
+    draws stay on device; see base.IndexSampler)."""
     from cocoa_tpu.parallel.fanout import chunk_fanout
 
     per_shard, per_round_batched, apply_fn = _sdca_round_parts(
@@ -217,6 +224,8 @@ def _make_chunk_kernel(mesh, params: Params, k: int, alg, **parts_kw):
     )
 
     def chunk_kernel(w, alpha, idxs_ckh, shard_arrays):
+        if isinstance(idxs_ckh, dict):
+            idxs_ckh = sampler.tables_from_ts(idxs_ckh["t"])
         return chunk_fanout(
             mesh, per_shard, apply_fn, w, alpha, idxs_ckh, shard_arrays,
             per_round_batched=per_round_batched,
@@ -232,7 +241,8 @@ def _make_chunk_kernel(mesh, params: Params, k: int, alg, **parts_kw):
 _CHUNK_STEPS: dict = {}
 
 
-def make_chunk_step(mesh, params: Params, k: int, alg, **parts_kw):
+def make_chunk_step(mesh, params: Params, k: int, alg, sampler=None,
+                    **parts_kw):
     """Build the jitted chunked step: C rounds as one device-side lax.scan
     (see parallel/fanout.py chunk_fanout) — same math as make_round_step,
     one host dispatch per chunk instead of per round.  Executables are cached
@@ -240,11 +250,13 @@ def make_chunk_step(mesh, params: Params, k: int, alg, **parts_kw):
     key = (
         mesh, k, alg, params.lam, params.n, params.local_iters,
         params.beta, params.gamma, params.loss, params.smoothing,
+        None if sampler is None else sampler.cache_token(),
         tuple(sorted(parts_kw.items())),
     )
     step = _CHUNK_STEPS.get(key)
     if step is None:
-        kernel = _make_chunk_kernel(mesh, params, k, alg, **parts_kw)
+        kernel = _make_chunk_kernel(mesh, params, k, alg, sampler=sampler,
+                                    **parts_kw)
         step = jax.jit(kernel, donate_argnums=(0, 1))
         _CHUNK_STEPS[key] = step
     return step
@@ -272,6 +284,7 @@ def run_sdca_family(
     device_loop: bool = False,
     eval_fn=None,
     eval_kernel=None,
+    sampling: str = "auto",
 ):
     """Shared driver for the SDCA-family algorithms (CoCoA, CoCoA+,
     mini-batch CD — they differ only in their ``alg`` scaling triple, see
@@ -431,6 +444,8 @@ def run_sdca_family(
         scan_chunk = 1
 
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
+    sampler.device = base.resolve_sampling(sampling, sampler,
+                                           params.num_rounds)
     shard_arrays = ds.shard_arrays()
     if pallas and ds.layout == "dense":
         # fold X for the dense kernel ONCE per run, up front — folding
@@ -447,12 +462,14 @@ def run_sdca_family(
                 loss=params.loss, smoothing=params.smoothing)
 
     if device_loop or scan_chunk > 0:
-        raw_kernel = _make_chunk_kernel(mesh, params, k, alg, **parts_kw)
+        raw_kernel = _make_chunk_kernel(mesh, params, k, alg,
+                                        sampler=sampler, **parts_kw)
 
         def chunk_kernel(state, idxs_ckh, shard_arrays):
             return raw_kernel(state[0], state[1], idxs_ckh, shard_arrays)
 
-        chunk_step = make_chunk_step(mesh, params, k, alg, **parts_kw)
+        chunk_step = make_chunk_step(mesh, params, k, alg, sampler=sampler,
+                                     **parts_kw)
 
         def chunk_fn(t0, c, state):
             return chunk_step(state[0], state[1],
@@ -460,7 +477,7 @@ def run_sdca_family(
 
         cache_key = (
             "sdca", alg_name, alg, math, pallas, block_size, block_chain,
-            k, mesh,
+            sampler.cache_token(), k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,
             params.num_rounds, debug.debug_iter, start_round,

@@ -41,6 +41,7 @@ def run_minibatch_cd(
     block_size: int = 0,
     block_chain=None,
     device_loop: bool = False,
+    sampling: str = "auto",
 ):
     """Train; returns (w, alpha, Trajectory)."""
     alg = _alg_config(params, ds.k, None, mode="frozen")
@@ -50,5 +51,5 @@ def run_minibatch_cd(
         start_round=start_round, quiet=quiet, gap_target=gap_target,
         scan_chunk=scan_chunk, math=math, pallas=pallas,
         block_size=block_size, block_chain=block_chain,
-        device_loop=device_loop,
+        device_loop=device_loop, sampling=sampling,
     )

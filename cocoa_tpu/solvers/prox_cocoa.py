@@ -117,6 +117,7 @@ def run_prox_cocoa(
     block_size: int = 0,
     block_chain=None,
     device_loop: bool = False,
+    sampling: str = "auto",
 ):
     """Train; returns (x, r, Trajectory) with x (K, d_shard) the sharded
     coordinates and r = A·x − b the replicated residual (v = r + b).
@@ -170,6 +171,6 @@ def run_prox_cocoa(
         quiet=quiet, gap_target=gap_target, scan_chunk=scan_chunk,
         math=math, pallas=pallas, block_size=block_size,
         block_chain=block_chain, device_loop=device_loop,
-        eval_fn=eval_fn, eval_kernel=eval_kernel,
+        eval_fn=eval_fn, eval_kernel=eval_kernel, sampling=sampling,
     )
     return x, r, traj

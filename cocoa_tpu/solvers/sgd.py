@@ -85,14 +85,18 @@ def _rep(scalar, k):
 _CHUNK_STEPS: dict = {}
 
 
-def _make_chunk_kernel(mesh, params: Params, k: int, local: bool):
+def _make_chunk_kernel(mesh, params: Params, k: int, local: bool,
+                       ts_sampler=None):
     """(w, xs, shard_arrays) -> w', C rounds as one ``lax.scan``; xs is the
-    TsSampler table {"idxs": (C, K, H), "t": (C,)}."""
+    TsSampler table {"idxs": (C, K, H), "t": (C,)} — or just the ``t`` leaf
+    in device-sampling mode, with ``idxs`` generated in-jit."""
     from cocoa_tpu.parallel.fanout import chunk_fanout
 
     per_shard_round, apply_fn = _sgd_parts(params, k, local)
 
     def chunk_kernel(w, xs, shard_arrays):
+        if ts_sampler is not None:
+            xs = ts_sampler.materialize(xs)
         w2, _ = chunk_fanout(
             mesh, per_shard_round, apply_fn, w, (), xs, shard_arrays
         )
@@ -101,12 +105,15 @@ def _make_chunk_kernel(mesh, params: Params, k: int, local: bool):
     return chunk_kernel
 
 
-def make_chunk_step(mesh, params: Params, k: int, local: bool):
+def make_chunk_step(mesh, params: Params, k: int, local: bool,
+                    ts_sampler=None):
     key = ("sgd", mesh, k, local, params.lam, params.n, params.local_iters,
-           params.beta, params.loss, params.smoothing)
+           params.beta, params.loss, params.smoothing,
+           None if ts_sampler is None else ts_sampler.cache_token())
     step = _CHUNK_STEPS.get(key)
     if step is None:
-        step = jax.jit(_make_chunk_kernel(mesh, params, k, local),
+        step = jax.jit(_make_chunk_kernel(mesh, params, k, local,
+                                          ts_sampler=ts_sampler),
                        donate_argnums=(0,))
         _CHUNK_STEPS[key] = step
     return step
@@ -125,6 +132,7 @@ def run_sgd(
     quiet: bool = False,
     scan_chunk: int = 0,
     device_loop: bool = False,
+    sampling: str = "auto",
 ):
     """Train; returns (w, Trajectory).  ``scan_chunk > 0`` runs rounds
     device-side in blocks via ``lax.scan``; ``device_loop=True`` rides the
@@ -145,6 +153,8 @@ def run_sgd(
         w = jax.device_put(w, primal_sharding(mesh))
 
     sampler = base.IndexSampler(rng, debug.seed, params.local_iters, ds.counts)
+    sampler.device = base.resolve_sampling(sampling, sampler,
+                                           params.num_rounds)
     ts_sampler = base.TsSampler(sampler, dtype)
     shard_arrays = ds.shard_arrays()
     name = "Local SGD" if local else "Mini-batch SGD"
@@ -155,19 +165,22 @@ def run_sgd(
                                    loss=params.loss, smoothing=params.smoothing)
 
     if device_loop or scan_chunk > 0:
-        raw_kernel = _make_chunk_kernel(mesh, params, k, local)
+        raw_kernel = _make_chunk_kernel(mesh, params, k, local,
+                                        ts_sampler=ts_sampler)
 
         def chunk_kernel(state, xs, shard_arrays):
             return (raw_kernel(state[0], xs, shard_arrays),)
 
-        chunk_step = make_chunk_step(mesh, params, k, local)
+        chunk_step = make_chunk_step(mesh, params, k, local,
+                                     ts_sampler=ts_sampler)
 
         def chunk_fn(t0, c, state):
             return (chunk_step(state[0], ts_sampler.chunk_indices(t0, c),
                                shard_arrays),)
 
         cache_key = (
-            "sgd", local, k, mesh, params.lam, params.n, params.local_iters,
+            "sgd", local, ts_sampler.cache_token(), k, mesh,
+            params.lam, params.n, params.local_iters,
             params.beta, params.loss, params.smoothing, params.num_rounds,
             debug.debug_iter, start_round, ds.layout, str(dtype),
         )

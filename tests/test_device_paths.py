@@ -139,7 +139,12 @@ def test_device_loop_records_block_timestamps(tiny_data, monkeypatch):
     monkeypatch.setattr(base, "MAX_IDX_TABLE_BYTES",
                         4 * 1 * d.debug_iter * K * p.local_iters)
     base._DEVICE_RUNS.clear()
-    _, _, traj = run_cocoa(ds, p, d, plus=True, quiet=True, device_loop=True)
+    # sampling="host": the table-size cap (what this test shrinks to force
+    # block boundaries) only governs concrete host tables — device-sampling
+    # runs ship ~no table bytes and ride one block (their boundaries come
+    # from chkptIter alone)
+    _, _, traj = run_cocoa(ds, p, d, plus=True, quiet=True, device_loop=True,
+                           sampling="host")
     base._DEVICE_RUNS.clear()
     stamps = [r.wall_time for r in traj.records if r.wall_time is not None]
     assert len(stamps) >= 2, [r.wall_time for r in traj.records]

@@ -71,21 +71,23 @@ def test_pallas_interpret_matches_xla_fast(tiny_data, mode, sigma):
     idxs = jnp.asarray(
         sample_indices_per_shard(5, range(1, 2), 30, ds.counts)[:, 0, :]
     )
-    m0 = jnp.einsum("knd,d->kn", ds.X, w)
     dw_p, a_p = pallas_sdca_round(
-        m0, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
+        w, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
         mode=mode, sigma=sigma, interpret=True,
     )
+    m0 = jnp.einsum("knd,d->kn", ds.X, w)
     for s in range(k):
         shard = {kk: v[s] for kk, v in ds.shard_arrays().items()}
         da, dw = local_sdca_fast(
             m0[s], alpha[s], shard, idxs[s], 0.01, tiny_data.n,
             jnp.zeros(d, dtype=jnp.float64), mode=mode, sigma=sigma,
         )
+        # in-kernel margins reduce x·w in a different order than the
+        # einsum the fast path precomputes — x64 agreement to ~1e-13
         np.testing.assert_allclose(np.asarray(dw_p[s]), np.asarray(dw),
-                                   atol=1e-14)
+                                   atol=1e-12)
         np.testing.assert_allclose(np.asarray(a_p[s] - alpha[s]),
-                                   np.asarray(da), atol=1e-14)
+                                   np.asarray(da), atol=1e-12)
 
 
 @pytest.mark.slow
@@ -158,14 +160,13 @@ def test_pallas_unroll_invariant(tiny_data, unroll):
     idxs = jnp.asarray(
         sample_indices_per_shard(9, range(1, 2), h, ds.counts)[:, 0, :]
     )
-    m0 = jnp.einsum("knd,d->kn", ds.X, w)
     kw = dict(mode="plus", sigma=2.0, interpret=True)
     dw_1, a_1 = pallas_sdca_round(
-        m0, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
+        w, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
         unroll=1, **kw,
     )
     dw_s, a_s = pallas_sdca_round(
-        m0, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
+        w, alpha, ds.X, ds.labels, ds.sq_norms, idxs, 0.01, tiny_data.n,
         unroll=unroll, **kw,
     )
     np.testing.assert_allclose(np.asarray(dw_s), np.asarray(dw_1),
