@@ -126,8 +126,8 @@ def run_tpu() -> tuple[float, float, float, int]:
         p = Params(n=data.n, num_rounds=nr, local_iters=H, lam=LAM)
         return lambda: run_cocoa(ds, p, debug, **kw)
 
-    steady, fixed = slope_time(make_run, rounds, min_span_s=1.0, reps=5)
-    return steady, fixed, raw, rounds
+    sr = slope_time(make_run, rounds, min_span_s=1.0, reps=5)
+    return sr.steady_s, sr.fixed_s, raw, rounds
 
 
 def run_oracle_baseline() -> float:

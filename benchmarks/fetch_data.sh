@@ -42,9 +42,33 @@ fetch() {
     echo "ready: $out  (the .bz2 may be deleted; the digest covers $out)"
 }
 
+# Minimum plausible decompressed sizes — a first-use defense independent
+# of the download being honest (ADVICE r3: TOFU alone trusts a
+# compromised first fetch).  These are deliberately lower bounds, not
+# exact pins: this machine is air-gapped, so an exact published byte count
+# cannot be confirmed here, and a wrong exact pin would reject good files.
+# Truncated/partial downloads (the realistic corruption) fall far below
+# these; a same-size wrong file is caught by run.py's (n, d, nnz/row)
+# pins at load time.
+size_pin() {
+    local name="$1" bytes="$2"
+    local min=0
+    case "$name" in
+        rcv1_train.binary)   min=8000000    ;;  # full file is tens of MB
+        epsilon_normalized)  min=8000000000 ;;  # full file is ~12 GB
+    esac
+    if (( min > 0 && bytes < min )); then
+        echo "size MISMATCH for $name: got $bytes bytes, expected at" \
+             "least $min — truncated or wrong file" >&2
+        exit 1
+    fi
+    echo "size ok: $name ($bytes bytes >= $min)"
+}
+
 verify() {
     local name="$1"           # decompressed file name
     local got
+    size_pin "$name" "$(stat -c%s "$DATA/$name")"
     got="$(sha256sum "$DATA/$name" | cut -d' ' -f1)"
     if grep -q " $name\$" "$SUMS" 2>/dev/null; then
         local want

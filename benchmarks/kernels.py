@@ -52,6 +52,10 @@ def measure(ds, params, k, *, c_lo=50, c_hi=200, reps=3, **kw):
         from cocoa_tpu.ops.pallas_sdca import fold_rows
 
         sa = {**sa, "X_folded": fold_rows(sa["X"])}
+    if kw.get("pallas") and ds.layout == "sparse":
+        from cocoa_tpu.ops.pallas_sparse import row_lengths
+
+        sa = {**sa, "sp_row_len": row_lengths(sa["sp_values"])}
     step = make_chunk_step(None, params, k, alg, math="fast", **kw)
     d = ds.num_features
 

@@ -63,31 +63,38 @@ DEMO_TEST = "/root/reference/data/small_test.dat"
 DEMO_D = 9947
 
 # published shapes of the real datasets (the integrity pin the air-gapped
-# build CAN carry — see benchmarks/fetch_data.sh for the sha256 story)
+# build CAN carry — see benchmarks/fetch_data.sh for the sha256 story).
+# (n, d, nnz/row range): epsilon is dense (exactly d per row); rcv1's
+# published average is ~73.2 cosine-normalized tf-idf terms per document
+# (ADVICE r3: shape alone passes for any same-line-count file — also pin
+# density, which a corrupted/wrong file of the same n would not match).
 REAL_SHAPES = {
-    "rcv1_train.binary": (20_242, 47_236),
-    "epsilon_normalized": (400_000, 2_000),
+    "rcv1_train.binary": (20_242, 47_236, (60.0, 90.0)),
+    "epsilon_normalized": (400_000, 2_000, (2000.0, 2000.0)),
 }
 
 
 def _maybe_real(data_dir, fname):
     """Load benchmarks/data/<fname> when present (fetched by
-    fetch_data.sh), validating the published (n, d) shape; None when
-    absent (the synthetic stand-in is used and labeled as such)."""
+    fetch_data.sh), validating the published (n, d) shape and nnz/row
+    density; None when absent (the synthetic stand-in is used and labeled
+    as such)."""
     path = os.path.join(data_dir, fname)
     if not os.path.exists(path):
         return None
     from cocoa_tpu.data import load_libsvm
 
-    n_want, d_want = REAL_SHAPES[fname]
+    n_want, d_want, (nz_lo, nz_hi) = REAL_SHAPES[fname]
     data = load_libsvm(path, d_want)
-    if data.n != n_want:
+    nnz_row = len(data.values) / max(1, data.n)
+    if data.n != n_want or not (nz_lo <= nnz_row <= nz_hi):
         raise ValueError(
-            f"{path}: expected the published shape n={n_want} "
-            f"(d={d_want}), parsed n={data.n} — corrupt or wrong file"
+            f"{path}: expected the published shape n={n_want} (d={d_want}) "
+            f"with {nz_lo}-{nz_hi} nnz/row, parsed n={data.n} "
+            f"nnz/row={nnz_row:.1f} — corrupt or wrong file"
         )
     print(f"using real dataset {fname}: n={data.n} d={d_want} "
-          f"nnz/row={len(data.values) / data.n:.1f}")
+          f"nnz/row={nnz_row:.1f}")
     return data
 
 
@@ -101,6 +108,17 @@ def _dense_subsample(data, n_sub):
 
 
 from slope import slope_time as _slope_time  # noqa: E402
+
+
+def _timed(make_run, rounds, **kw):
+    """(steady_s, fixed_s, quality-dict) — rows carry ``noisy``/``span_s``
+    when the slope escalation exited without the span dominating the
+    tunnel jitter (ADVICE r3: a degraded measurement must not look like a
+    clean one; the round-3 rcv1-permuted anomaly had that signature)."""
+    sr = _slope_time(make_run, rounds, **kw)
+    q = ({"noisy": True, "span_s": round(sr.span_s, 3)}
+         if sr.degraded else {})
+    return sr.steady_s, sr.fixed_s, q
 
 
 def _perf(tag, secs, rounds, *, n, d, k, h, layout="dense", nnz=None,
@@ -308,14 +326,14 @@ def bench_demo(results, perf_rows):
 
     w, a, traj = gap_run()
     rec = traj.records[-1]
-    secs, fixed = _slope_time(make_run, rec.round)
+    secs, fixed, q = _timed(make_run, rec.round)
     rate = _oracle_rounds_per_s(
         (data.to_dense(), data.labels), 1e-3, 50, 4, data.n
     )
     results.append(dict(
         config="demo-cocoa+", n=data.n, d=DEMO_D, k=4, h=50,
         lam=1e-3, gap_target=1e-4, rounds=rec.round, gap=float(rec.gap),
-        wallclock_s=round(secs, 3), fixed_s=round(fixed, 3),
+        wallclock_s=round(secs, 3), fixed_s=round(fixed, 3), **q,
         vs_oracle=round(rec.round / rate / secs, 1),
         oracle_basis="measured (3 rounds)",
     ))
@@ -326,13 +344,13 @@ def bench_demo(results, perf_rows):
     # certified gap — the certificate is exact under any index stream
     w_p, a_p, traj_p = gap_run("permuted")
     rec_p = traj_p.records[-1]
-    secs_p, fixed_p = _slope_time(
+    secs_p, fixed_p, q_p = _timed(
         lambda nr: make_run(nr, "permuted"), rec_p.round)
     results.append(dict(
         config="demo-cocoa+(permuted)", n=data.n, d=DEMO_D, k=4, h=50,
         lam=1e-3, gap_target=1e-4, rounds=rec_p.round,
         gap=float(rec_p.gap), wallclock_s=round(secs_p, 3),
-        fixed_s=round(fixed_p, 3),
+        fixed_s=round(fixed_p, 3), **q_p,
         vs_oracle_same_gap=round(rec.round / rate / secs_p, 1),
         oracle_basis="same-gap: oracle at reference-mode rounds",
     ))
@@ -374,7 +392,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
 
     w, a, traj = gap_run()
     rec = traj.records[-1]
-    secs, fixed = _slope_time(make_run, rec.round)
+    secs, fixed, q = _timed(make_run, rec.round)
     # oracle rate on a small same-d subsample, scaled by n (per-round work
     # is O(H·d) per shard with H ∝ n — linear in n at fixed d, k)
     n_sub = min(n, 20_000)
@@ -391,7 +409,7 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
     results.append(dict(
         config=f"{tag}-cocoa+", n=n, d=d, k=k, h=h,
         lam=1e-3, gap_target=1e-4, rounds=rec.round, gap=float(rec.gap),
-        wallclock_s=round(secs, 3), fixed_s=round(fixed, 3),
+        wallclock_s=round(secs, 3), fixed_s=round(fixed, 3), **q,
         vs_oracle=round(rec.round / rate / secs, 1), oracle_basis=basis,
     ))
     perf_rows.append(_perf(f"{tag}-cocoa+", secs, rec.round, n=n, d=d,
@@ -402,13 +420,13 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
     # kernel (ops/pallas_chain.fused_block)
     w_b, a_b, traj_b = gap_run(block=128)
     rec_b = traj_b.records[-1]
-    secs_b, fixed_b = _slope_time(lambda nr: make_run(nr, block=128),
+    secs_b, fixed_b, q_b = _timed(lambda nr: make_run(nr, block=128),
                                   rec_b.round)
     results.append(dict(
         config=f"{tag}-cocoa+(block128)", n=n, d=d, k=k, h=h,
         lam=1e-3, gap_target=1e-4, rounds=rec_b.round,
         gap=float(rec_b.gap), wallclock_s=round(secs_b, 3),
-        fixed_s=round(fixed_b, 3),
+        fixed_s=round(fixed_b, 3), **q_b,
         vs_oracle=round(rec_b.round / rate / secs_b, 1), oracle_basis=basis,
     ))
     perf_rows.append(_perf(f"{tag}-cocoa+(block128)", secs_b, rec_b.round,
@@ -418,13 +436,13 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
     # certified 1e-4 gap in ~5x fewer comm-rounds (see tests/test_permuted)
     w_pb, a_pb, traj_pb = gap_run("permuted", block=128)
     rec_pb = traj_pb.records[-1]
-    secs_pb, fixed_pb = _slope_time(
+    secs_pb, fixed_pb, q_pb = _timed(
         lambda nr: make_run(nr, "permuted", block=128), rec_pb.round)
     results.append(dict(
         config=f"{tag}-cocoa+(permuted+block128)", n=n, d=d, k=k, h=h,
         lam=1e-3, gap_target=1e-4, rounds=rec_pb.round,
         gap=float(rec_pb.gap), wallclock_s=round(secs_pb, 3),
-        fixed_s=round(fixed_pb, 3),
+        fixed_s=round(fixed_pb, 3), **q_pb,
         vs_oracle_same_gap=round(rec.round / rate / secs_pb, 1),
         oracle_basis="same-gap: oracle at reference-mode rounds",
     ))
@@ -439,13 +457,13 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
 
     w2, traj2 = make_sgd(100)()
     rec2 = traj2.records[-1]
-    secs2, fixed2 = _slope_time(make_sgd, 100)
+    secs2, fixed2, q2 = _timed(make_sgd, 100)
     rate_lsgd = _oracle_rounds_per_s_sgd((Xs, ys), 1e-3, n_sub // k // 10,
                                          k, local=True) * n_sub / n
     results.append(dict(
         config=f"{tag}-localsgd", n=n, d=d, k=k, h=h, lam=1e-3,
         rounds=rec2.round, primal=float(rec2.primal),
-        wallclock_s=round(secs2, 3), fixed_s=round(fixed2, 3),
+        wallclock_s=round(secs2, 3), fixed_s=round(fixed2, 3), **q2,
         vs_oracle=round(100 / rate_lsgd / secs2, 1), oracle_basis=basis,
     ))
     # SGD.scala:117-129 per step: O(d) rescale + conditional axpy — the
@@ -456,13 +474,13 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
     # Mini-batch SGD (SGD.scala local=false; fixed 100 rounds)
     w3, traj3 = make_sgd(100, local=False)()
     rec3 = traj3.records[-1]
-    secs3, fixed3 = _slope_time(lambda nr: make_sgd(nr, local=False), 100)
+    secs3, fixed3, q3 = _timed(lambda nr: make_sgd(nr, local=False), 100)
     rate_mbsgd = _oracle_rounds_per_s_sgd((Xs, ys), 1e-3, n_sub // k // 10,
                                           k, local=False) * n_sub / n
     results.append(dict(
         config=f"{tag}-mbsgd", n=n, d=d, k=k, h=h, lam=1e-3,
         rounds=rec3.round, primal=float(rec3.primal),
-        wallclock_s=round(secs3, 3), fixed_s=round(fixed3, 3),
+        wallclock_s=round(secs3, 3), fixed_s=round(fixed3, 3), **q3,
         vs_oracle=round(100 / rate_mbsgd / secs3, 1), oracle_basis=basis,
     ))
     perf_rows.append(_perf(f"{tag}-mbsgd", secs3, rec3.round, n=n, d=d,
@@ -480,13 +498,13 @@ def bench_epsilon(results, perf_rows, quick, data_dir=""):
 
     w4, traj4 = make_dgd(50)()
     rec4 = traj4.records[-1]
-    secs4, fixed4 = _slope_time(make_dgd, 50)
+    secs4, fixed4, q4 = _timed(make_dgd, 50)
     # per-round cost is one full shard pass: rate scales 1/n at fixed d, k
     rate_dgd = _oracle_rounds_per_s_distgd((Xs, ys), 1e-3, k) * n_sub / n
     results.append(dict(
         config=f"{tag}-distgd", n=n, d=d, k=k, h="n/K",
         lam=1e-3, rounds=rec4.round, primal=float(rec4.primal),
-        wallclock_s=round(secs4, 3), fixed_s=round(fixed4, 3),
+        wallclock_s=round(secs4, 3), fixed_s=round(fixed4, 3), **q4,
         vs_oracle=round(50 / rate_dgd / secs4, 1), oracle_basis=basis,
     ))
     # DistGD reads every row once per round: model it as one "margins
@@ -538,12 +556,12 @@ def bench_rcv1(results, perf_rows, quick, data_dir=""):
 
         w, a, traj = gap_run()
         rec = traj.records[-1]
-        secs, fixed = _slope_time(make_run, rec.round)
+        secs, fixed, q = _timed(make_run, rec.round)
         results.append(dict(
             config=f"{rtag}-cocoa+({gap_target:g})", n=n, d=d, k=k, h=h,
             lam=1e-4, gap_target=gap_target, rounds=rec.round,
             gap=float(rec.gap), wallclock_s=round(secs, 3),
-            fixed_s=round(fixed, 3),
+            fixed_s=round(fixed, 3), **q,
             vs_oracle=round(rec.round / rate_plus / secs, 1),
             oracle_basis="measured (2 rounds, CSR)",
         ))
@@ -554,13 +572,13 @@ def bench_rcv1(results, perf_rows, quick, data_dir=""):
 
         w_p, a_p, traj_p = gap_run("permuted")
         rec_p = traj_p.records[-1]
-        secs_p, fixed_p = _slope_time(
+        secs_p, fixed_p, q_p = _timed(
             lambda nr: make_run(nr, "permuted"), rec_p.round)
         results.append(dict(
             config=f"{rtag}-cocoa+({gap_target:g}, permuted)", n=n, d=d,
             k=k, h=h, lam=1e-4, gap_target=gap_target,
             rounds=rec_p.round, gap=float(rec_p.gap),
-            wallclock_s=round(secs_p, 3), fixed_s=round(fixed_p, 3),
+            wallclock_s=round(secs_p, 3), fixed_s=round(fixed_p, 3), **q_p,
             vs_oracle_same_gap=round(rec.round / rate_plus / secs_p, 1),
             oracle_basis="same-gap: oracle at reference-mode rounds",
         ))
@@ -577,18 +595,132 @@ def bench_rcv1(results, perf_rows, quick, data_dir=""):
 
     w2, a2, traj2 = make_mbcd(100)()
     rec2 = traj2.records[-1]
-    secs2, fixed2 = _slope_time(make_mbcd, 100)
+    secs2, fixed2, q2 = _timed(make_mbcd, 100)
     rate_f = _oracle_rounds_per_s_csr(data, 1e-4, h, k, n, mode="frozen")
     results.append(dict(
         config=f"{rtag}-mbcd", n=n, d=d, k=k, h=h, lam=1e-4,
         rounds=rec2.round, gap=float(rec2.gap), primal=float(rec2.primal),
-        wallclock_s=round(secs2, 3), fixed_s=round(fixed2, 3),
+        wallclock_s=round(secs2, 3), fixed_s=round(fixed2, 3), **q2,
         vs_oracle=round(rec2.round / rate_f / secs2, 1),
         oracle_basis="measured (2 rounds, CSR)",
     ))
     perf_rows.append(_perf(f"{rtag}-mbcd", secs2, rec2.round, n=n, d=d, k=k,
                            h=h, layout="sparse", nnz=nnz, path="pallas",
                            debug_iter=100))
+
+def _np_alpha_step(loss, a, z, qii, lam_n, smoothing):
+    """NumPy twin of ops/losses.alpha_step (scalar), for the loss-variant
+    oracle rates."""
+    if loss == "smooth_hinge":
+        s = smoothing
+        grad = (z - 1.0 + s * a) * lam_n
+        return min(max(a - grad / (qii + s * lam_n), 0.0), 1.0)
+    if loss == "logistic":
+        ac = min(max(a, 1e-12), 1.0 - 1e-12)
+        q = qii / lam_n
+        u = min(max(np.log(ac / (1.0 - ac)), -35.0), 35.0)
+        for _ in range(10):
+            sig = 1.0 / (1.0 + np.exp(-u))
+            g = u + z + q * (sig - ac)
+            gp = 1.0 + q * sig * (1.0 - sig)
+            u = min(max(u - g / gp, -35.0), 35.0)
+        return 1.0 / (1.0 + np.exp(-u))
+    raise ValueError(loss)
+
+
+def _oracle_rounds_per_s_loss(ds_like, lam, h, k, n, loss, smoothing,
+                              rounds=3):
+    """Single-thread oracle round rate for the non-hinge dual-ascent
+    losses (CoCoA+ additive): the same per-step structure as
+    oracle.local_sdca with the loss's coordinate update."""
+    from cocoa_tpu.utils.prng import sample_indices
+
+    X, y = ds_like
+    sizes = np.full(k, X.shape[0] // k)
+    sizes[: X.shape[0] % k] += 1
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    shards = [
+        (X[offs[i]:offs[i + 1]], y[offs[i]:offs[i + 1]]) for i in range(k)
+    ]
+    w = np.zeros(X.shape[1])
+    alphas = [np.zeros(Xk.shape[0]) for Xk, _ in shards]
+    sigma = float(k)
+    lam_n = lam * n
+
+    def step(t):
+        nonlocal w
+        dw_sum = np.zeros_like(w)
+        for s, (Xk, yk) in enumerate(shards):
+            idxs = sample_indices(0, range(t, t + 1), h, Xk.shape[0])[0]
+            a = alphas[s]
+            dw = np.zeros_like(w)
+            for li in idxs:
+                x = Xk[li]
+                z = yk[li] * (x @ w + sigma * (x @ dw))
+                qii = sigma * float(x @ x)
+                new_a = _np_alpha_step(loss, a[li], z, qii, lam_n, smoothing)
+                coef = yk[li] * (new_a - a[li]) / lam_n
+                dw += coef * x
+                a[li] = new_a
+            dw_sum += dw
+        w = w + dw_sum
+
+    return _round_rate(step, rounds)
+
+
+def bench_losses(results, perf_rows, quick):
+    """The fifth BASELINE.json config (VERDICT r3 item 2): the
+    smoothed-hinge and logistic local-solver variants — the reference's
+    explicit extensibility promise (README.md:14, CoCoA.scala:13-14) —
+    measured gap-targeted at epsilon scale through the fused block kernel,
+    exercising the non-hinge chain (smooth-hinge's shifted clip, the
+    10-iteration unrolled Newton for logistic) at scale."""
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data.synth import synth_dense_sharded
+    from cocoa_tpu.solvers import run_cocoa
+
+    n, d, k = (40_000, 2000, 8) if quick else (400_000, 2000, 8)
+    ds = synth_dense_sharded(n, d, k, seed=0)
+    h = n // k // 10
+    debug = DebugParams(debug_iter=10, seed=0)
+    n_sub = min(n, 20_000)
+    rng = np.random.default_rng(0)
+    Xs = rng.standard_normal((n_sub, d))
+    Xs /= np.linalg.norm(Xs, axis=1, keepdims=True)
+    ys = np.where(Xs @ rng.standard_normal(d) >= 0, 1.0, -1.0)
+
+    for loss, smoothing, gap_target in (
+        ("smooth_hinge", 1.0, 1e-4),
+        ("logistic", 1.0, 1e-4),
+    ):
+        def make_run(nr, loss=loss, smoothing=smoothing):
+            p = Params(n=n, num_rounds=nr, local_iters=h, lam=1e-3,
+                       loss=loss, smoothing=smoothing)
+            return lambda: run_cocoa(ds, p, debug, plus=True, quiet=True,
+                                     math="fast", device_loop=True,
+                                     block_size=128)
+
+        p = Params(n=n, num_rounds=400, local_iters=h, lam=1e-3,
+                   loss=loss, smoothing=smoothing)
+        w, a, traj = run_cocoa(ds, p, debug, plus=True, quiet=True,
+                               math="fast", device_loop=True,
+                               gap_target=gap_target, block_size=128)
+        rec = traj.records[-1]
+        secs, fixed, q = _timed(make_run, rec.round)
+        rate = _oracle_rounds_per_s_loss(
+            (Xs, ys), 1e-3, n_sub // k // 10, k, n_sub, loss, smoothing
+        ) * n_sub / n
+        results.append(dict(
+            config=f"epsilon-{loss}(block128)", n=n, d=d, k=k, h=h,
+            lam=1e-3, gap_target=gap_target, rounds=rec.round,
+            gap=float(rec.gap), wallclock_s=round(secs, 3),
+            fixed_s=round(fixed, 3), **q,
+            vs_oracle=round(rec.round / rate / secs, 1),
+            oracle_basis=f"extrapolated from n={n_sub} subsample",
+        ))
+        perf_rows.append(_perf(f"epsilon-{loss}(block128)", secs, rec.round,
+                               n=n, d=d, k=k, h=h, path="block", block=128))
+
 
 def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2, l2=0.0):
     """Single-thread literal prox-CD oracle round rate (ProxCoCoA+ lasso /
@@ -680,13 +812,13 @@ def bench_lasso(results, perf_rows, quick):
 
         x, r, traj = gap_run()
         rec = traj.records[-1]
-        secs, fixed = _slope_time(make_run, rec.round)
+        secs, fixed, q = _timed(make_run, rec.round)
         rate = _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, l2=l2)
         results.append(dict(
             config=tag, n=n, d=d, k=k, h=h,
             lam=round(lam, 5), l2=l2, gap_target="1e-3 relative",
             rounds=rec.round, gap=float(rec.gap),
-            wallclock_s=round(secs, 3), fixed_s=round(fixed, 3),
+            wallclock_s=round(secs, 3), fixed_s=round(fixed, 3), **q,
             vs_oracle=round(rec.round / rate / secs, 1),
             oracle_basis="measured (2 rounds)",
         ))
@@ -698,13 +830,13 @@ def bench_lasso(results, perf_rows, quick):
         if l2 == 0.0:
             x_p, r_p, traj_p = gap_run("permuted")
             rec_p = traj_p.records[-1]
-            secs_p, fixed_p = _slope_time(
+            secs_p, fixed_p, q_p = _timed(
                 lambda nr: make_run(nr, "permuted"), rec_p.round)
             results.append(dict(
                 config="lasso-proxcocoa+(permuted)", n=n, d=d, k=k, h=h,
                 lam=round(lam, 5), gap_target="1e-3 relative",
                 rounds=rec_p.round, gap=float(rec_p.gap),
-                wallclock_s=round(secs_p, 3), fixed_s=round(fixed_p, 3),
+                wallclock_s=round(secs_p, 3), fixed_s=round(fixed_p, 3), **q_p,
                 vs_oracle_same_gap=round(rec.round / rate / secs_p, 1),
                 oracle_basis="same-gap: oracle at reference-mode rounds",
             ))
@@ -924,7 +1056,7 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="~10x smaller synthetic sizes (smoke test)")
     ap.add_argument("--only", default="",
-                    help="comma-separated subset: demo,epsilon,rcv1,lasso")
+                    help="comma-separated subset: demo,epsilon,rcv1,losses,lasso")
     ap.add_argument("--data-dir",
                     default=os.path.join(os.path.dirname(
                         os.path.abspath(__file__)), "data"),
@@ -946,6 +1078,10 @@ def main():
     if only is None or "rcv1" in only:
         bench_rcv1(results, perf_rows, args.quick, args.data_dir)
         for r in results[-3:]:
+            print(json.dumps(r))
+    if only is None or "losses" in only:
+        bench_losses(results, perf_rows, args.quick)
+        for r in results[-2:]:
             print(json.dumps(r))
     if only is None or "lasso" in only:
         bench_lasso(results, perf_rows, args.quick)

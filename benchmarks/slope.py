@@ -17,7 +17,19 @@ dominates T(R) (the regime the method exists for).
 from __future__ import annotations
 
 import time
-from typing import Callable
+from typing import Callable, NamedTuple
+
+
+class SlopeResult(NamedTuple):
+    steady_s: float
+    fixed_s: float
+    # measurement-quality telemetry (ADVICE r3): when escalation exits at
+    # max_mult with span < min_span_s, the estimate may still be dominated
+    # by tunnel jitter — callers should mark such rows as noisy instead of
+    # recording them silently (the round-3 rcv1-permuted row's clamped
+    # fixed_s=0 had exactly this signature)
+    span_s: float = 0.0
+    degraded: bool = False
 
 
 def slope_time(
@@ -26,10 +38,11 @@ def slope_time(
     min_span_s: float = 1.0,
     reps: int = 3,
     max_mult: int = 32,
-) -> tuple[float, float]:
-    """(steady_s for ``rounds``, fixed_s).  ``make_run(nr)`` returns a
-    0-arg callable executing exactly ``nr`` rounds (compiled on first
-    call; each point is best-of-``reps`` warm runs)."""
+) -> SlopeResult:
+    """SlopeResult(steady_s for ``rounds``, fixed_s, span_s, degraded).
+    ``make_run(nr)`` returns a 0-arg callable executing exactly ``nr``
+    rounds (compiled on first call; each point is best-of-``reps`` warm
+    runs)."""
 
     def best(fn):
         fn()  # compile / warm
@@ -45,9 +58,11 @@ def slope_time(
     m = 4
     while True:
         t_hi = best(make_run(m * rounds))
-        if t_hi - t_lo >= min_span_s or m >= max_mult:
+        span = t_hi - t_lo
+        if span >= min_span_s or m >= max_mult:
             break
         m *= 2
-    per_round = max(0.0, (t_hi - t_lo) / ((m - 1) * rounds))
+    per_round = max(0.0, span / ((m - 1) * rounds))
     steady = per_round * rounds
-    return steady, max(0.0, t_lo - steady)
+    return SlopeResult(steady, max(0.0, t_lo - steady), span,
+                       degraded=span < min_span_s)

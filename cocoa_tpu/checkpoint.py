@@ -40,6 +40,16 @@ def save(
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
     meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
+    if (isinstance(alpha, jax.Array) and not alpha.is_fully_addressable):
+        # multi-host run: each process holds only its dp shards of alpha.
+        # Gather the full array on every host so each writes a complete,
+        # independently-restorable checkpoint (the elastic supervisor
+        # restarts the whole gang from ONE file; per-shard files would
+        # couple restore to the old process layout).  Alpha is (K, n_shard)
+        # — MBs, not model-scale — so the allgather is cheap.
+        from jax.experimental import multihost_utils
+
+        alpha = multihost_utils.process_allgather(alpha, tiled=True)
     # meta travels INSIDE the .npz (a unicode array — no pickling), so the
     # archive is self-describing and a stale same-named .npz from an
     # earlier run in a reused directory can never be paired with a fresh

@@ -51,7 +51,8 @@ _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
               "smoothing", "sampling")  # same-named RunConfig fields
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
-                "profile", "objective", "l2", "blockSize")  # run-level
+                "profile", "objective", "l2", "blockSize",
+                "elastic")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -131,6 +132,44 @@ def main(argv=None) -> int:
         print(f"error: --math must be exact|fast, got {cfg.math!r}",
               file=sys.stderr)
         return 2
+
+    if extras["elastic"]:
+        # --elastic=N: this process becomes the SUPERVISOR — it launches N
+        # worker copies of this command line (each with its own processId
+        # and a supervisor-chosen coordinator port) and gang-restarts them
+        # from the latest checkpoint when any worker dies.  The Spark-
+        # lineage-recovery analogue for an all-reduce runtime
+        # (cocoa_tpu/elastic.py).
+        from cocoa_tpu import elastic
+
+        try:
+            n_workers = int(extras["elastic"])
+        except ValueError:
+            print("error: --elastic must be an integer worker count",
+                  file=sys.stderr)
+            return 2
+        if n_workers < 1:
+            print("error: --elastic needs at least 1 worker", file=sys.stderr)
+            return 2
+        if not cfg.chkpt_dir:
+            print("warning: --elastic without --chkptDir restarts from "
+                  "round 1 on failure (no checkpoints to resume from)",
+                  file=sys.stderr)
+
+        def progress_token():
+            # the restart budget bounds CONSECUTIVE failures: any new or
+            # renamed checkpoint file since the last generation means the
+            # run advanced, so the streak resets
+            if not cfg.chkpt_dir or not os.path.isdir(cfg.chkpt_dir):
+                return None
+            return tuple(sorted(
+                f for f in os.listdir(cfg.chkpt_dir) if f.endswith(".npz")
+            ))
+
+        return elastic.supervise(
+            elastic.strip_elastic_flags(argv), n_workers,
+            resume=bool(cfg.chkpt_dir), progress_token=progress_token,
+        )
 
     # multi-host: --master=host:port connects this process to the pod's
     # coordinator (the Spark-master analogue) BEFORE any backend use, so

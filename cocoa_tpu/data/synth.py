@@ -75,21 +75,48 @@ def synth_sparse(
     nnz_mean: int = 75,
     seed: int = 0,
     flip: float = 0.02,
+    nnz_sigma: float = 0.7,
 ) -> LibsvmData:
-    """rcv1-like sparse data: ~``nnz_mean`` nnz/row, Zipf-ish column
-    popularity (a few very common features, a long tail — the tf-idf
-    signature), positive log-normal values, unit-normalized rows."""
+    """rcv1-like sparse data, distribution-faithful where the kernels and
+    the gap trajectory are sensitive (VERDICT r3 item 5 — round 3 was
+    shape-faithful only):
+
+    - **nnz/row ~ log-normal** with log-sd ``nnz_sigma`` and mean
+      ``nnz_mean`` — document lengths are heavy-tailed (RCV1-v2's token
+      counts famously so), where round 3's Poisson was nearly constant
+      (sd ~sqrt(75) vs the real spread of ~0.7 in the log).  The padded-CSR
+      layout pads every row to the MAX row nnz, so this tail is exactly
+      what that kernel pays for.
+    - **tf-idf values**: tf = the column's repeat count within the row's
+      token draws (popular columns repeat — that IS term frequency),
+      value = (1 + log tf) * idf(df(col)) with Zipf column popularity
+      (df ∝ 1/rank), then L2-normalized rows — RCV1-v2's published ltc
+      weighting (Lewis et al. 2004), matching both the value distribution
+      and the value↔popularity correlation (common words carry small
+      weights) that round 3's iid log-normal values lacked.
+
+    ``nnz_mean`` targets the post-dedup (unique terms per row) mean — the
+    token draws are inflated by the empirical dedup factor at rcv1 scale.
+    """
     rng = np.random.default_rng(seed)
     # column popularity ~ 1/rank: sample columns by inverse-CDF of a Zipf-ish
     # weight vector so low feature ids are hot, mimicking sorted-by-df tf-idf
     weights = 1.0 / np.arange(1, d + 1)
-    cdf = np.cumsum(weights / weights.sum())
+    probs = weights / weights.sum()
+    cdf = np.cumsum(probs)
+    # log-normal TOKEN counts whose post-dedup unique mean lands on
+    # nnz_mean: mu = ln(mean·inflate) - sigma^2/2, inflate = the measured
+    # dedup shrinkage of Zipf draws at rcv1 scale (~0.79 unique/draw)
+    mu = np.log(nnz_mean * 1.27) - 0.5 * nnz_sigma ** 2
     row_nnz = np.clip(
-        rng.poisson(nnz_mean, size=n), 1, min(d, 8 * nnz_mean)
+        np.round(rng.lognormal(mu, nnz_sigma, size=n)), 1,
+        min(d, 12 * nnz_mean),
     ).astype(np.int64)
     indptr = np.concatenate([[0], np.cumsum(row_nnz)])
     total = int(indptr[-1])
     cols = np.searchsorted(cdf, rng.random(total)).astype(np.int32)
+    # idf against the sampling distribution itself: df(col) = n * p(col)
+    idf = np.log(1.0 / np.maximum(probs, 1.0 / (50.0 * n)))
     # dedupe within each row (duplicate idx:val pairs are legal LIBSVM-wise
     # but the dense/padded layouts would sum them differently than last-wins)
     indices_list = []
@@ -98,9 +125,11 @@ def synth_sparse(
     labels = np.empty(n)
     out_ptr = [0]
     for i in range(n):
-        c = np.unique(cols[indptr[i]:indptr[i + 1]])
-        v = np.exp(rng.standard_normal(c.size) * 0.5)
-        v /= np.linalg.norm(v)
+        c, tf = np.unique(cols[indptr[i]:indptr[i + 1]],
+                          return_counts=True)
+        v = (1.0 + np.log(tf)) * idf[c]
+        nrm = np.linalg.norm(v)
+        v = v / (nrm if nrm > 0 else 1.0)
         indices_list.append(c)
         values_list.append(v)
         out_ptr.append(out_ptr[-1] + c.size)

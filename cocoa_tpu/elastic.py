@@ -1,0 +1,149 @@
+"""Elastic multi-process supervision: gang restart + checkpoint resume.
+
+The reference inherits implicit fault recovery from Spark — a lost task is
+recomputed from RDD lineage (OptClasses.scala:36 "ensure persistence and
+shorter dependencies", hinge/CoCoA.scala:59-62 checkpoint truncation).
+That model does not transplant to a multi-controller all-reduce runtime:
+when one process of a JAX gang dies, the surviving processes are wedged
+inside a collective — there is no per-task granularity to recompute.  The
+honest equivalent is **gang restart from the last checkpoint**: a
+supervisor launches the N worker processes, watches them, and on any
+worker death kills the survivors and relaunches the whole gang with
+``--resume``.  Round-keyed sampling makes the resumed trajectory identical
+to an uninterrupted run (tests/test_crash_resume.py), so the only cost of
+a failure is the rounds since the last ``--chkptIter`` save — the same
+bound Spark's lineage recomputation gives, without keeping every round's
+lineage alive.
+
+Activated by ``--elastic=N`` on the CLI: the invoking process becomes the
+supervisor and re-executes its own command line N times with
+``--master=127.0.0.1:<port> --processId=i --numProcesses=N --resume``.
+A fresh coordinator port is chosen per generation (a dying coordinator can
+leave the old port lingering in TIME_WAIT).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(worker_argv, i, n, port, python, module, quiet_tail, resume):
+    argv = [
+        python, "-m", module, *worker_argv,
+        f"--master=127.0.0.1:{port}",
+        f"--processId={i}", f"--numProcesses={n}",
+        *(["--resume"] if resume else []),
+    ]
+    out = None if (i == 0 or not quiet_tail) else subprocess.DEVNULL
+    return subprocess.Popen(argv, stdout=out, stderr=out)
+
+
+def supervise(
+    worker_argv: list,
+    num_processes: int,
+    max_restarts: int = 5,
+    poll_s: float = 0.25,
+    python: Optional[str] = None,
+    module: str = "cocoa_tpu.cli",
+    quiet_tail: bool = True,
+    on_generation=None,   # hook(gen_index, procs) after each gang spawn —
+                          # fault-injection handle for tests
+    resume: bool = True,  # pass --resume to workers (False when there is
+                          # no --chkptDir: the CLI rejects --resume
+                          # without one, and there is nothing to resume)
+    progress_token=None,  # 0-arg callable capturing run progress (e.g. the
+                          # checkpoint-directory state); when it CHANGES
+                          # between generations the restart budget resets —
+                          # "max_restarts" bounds CONSECUTIVE failed
+                          # generations, not lifetime failures of a long
+                          # run that keeps advancing
+) -> int:
+    """Run the gang to completion, restarting it (from the latest
+    checkpoint, via the workers' ``--resume``) whenever any member dies.
+    Returns the final exit code (0 on success; the failing worker's code
+    after ``max_restarts`` consecutive failed generations).
+
+    ``worker_argv`` is the user's flag list WITHOUT --master/--processId/
+    --numProcesses/--elastic (the supervisor owns those).  Worker 0
+    inherits stdout (the reference prints from the driver); other workers
+    are silenced unless ``quiet_tail=False``.
+    """
+    python = python or sys.executable
+    restarts = 0
+    gen = 0
+    last_token = progress_token() if progress_token else None
+    while True:
+        port = free_port()
+        procs = [
+            _spawn(worker_argv, i, num_processes, port, python, module,
+                   quiet_tail, resume)
+            for i in range(num_processes)
+        ]
+        if on_generation is not None:
+            on_generation(gen, procs)
+        gen += 1
+        failed = None
+        try:
+            while True:
+                codes = [p.poll() for p in procs]
+                bad = [c for c in codes if c not in (None, 0)]
+                if bad:
+                    failed = bad[0]
+                    break
+                if all(c == 0 for c in codes):
+                    return 0
+                time.sleep(poll_s)
+        finally:
+            # any survivors are wedged inside a collective whose peer died
+            # (or we are unwinding on KeyboardInterrupt) — kill the gang
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        p.send_signal(signal.SIGKILL)
+                    except OSError:
+                        pass
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        if progress_token is not None:
+            token = progress_token()
+            if token != last_token:
+                restarts = 0      # the dead generation still advanced the
+                last_token = token  # run — the failure streak is broken
+        restarts += 1
+        if restarts > max_restarts:
+            print(f"elastic: giving up after {max_restarts} consecutive "
+                  f"failed generations (last exit code {failed})",
+                  file=sys.stderr)
+            return int(failed or 1)
+        print(f"elastic: worker died (exit {failed}); restarting gang "
+              f"(attempt {restarts}/{max_restarts}) from the latest "
+              f"checkpoint", file=sys.stderr, flush=True)
+
+
+def strip_elastic_flags(argv: list) -> list:
+    """The worker command line = the user's line minus the flags the
+    supervisor owns (it re-adds its own --master/--processId/...)."""
+    own = ("elastic", "master", "processId", "numProcesses", "resume")
+    out = []
+    for a in argv:
+        key = a.lstrip("-").split("=", 1)[0]
+        if key not in own:
+            out.append(a)
+    return out

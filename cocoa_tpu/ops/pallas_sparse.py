@@ -30,15 +30,33 @@ case) degenerates to the plain sequential kernel.
 
 Addressing constraint: Mosaic has no vector→scalar extraction, so every
 dynamic address must come from SMEM.  The sampled rows' **feature
-indices** are gathered device-side outside the kernel into a
-(K, H_seg, max_nnz) int32 table and scalar-prefetched (SMEM); the row
-**values** stay in VMEM — the value of nonzero j is picked vectorially
-with a static lane-j mask (j is a Python unroll index), never needed as a
-scalar address.
+indices** AND **values** are gathered device-side outside the kernel into
+(K, H_seg, max_nnz) tables and scalar-prefetched (SMEM holds f32 scalars
+fine).  Round 3 kept the values in VMEM and picked nonzero j's value with
+a max_nnz-wide lane mask; at heavy-tailed widths that one pick was the
+widest op in the loop AND scaled with the PADDED width — an SMEM scalar
+read costs O(1) regardless of W and removed the kernel's value
+blocks/DMAs entirely.
 
 Padded nonzero slots carry index 0 / value 0 and contribute exactly 0 to
 every pick and scatter — no masking needed (same inertness trick as the
 XLA path, ops/rows.py:10-11).
+
+**Heavy-tailed rows (round 4).**  The padded width W is the MAX row nnz
+across the dataset; real rcv1-like data is heavy-tailed (log-normal
+document lengths), so W (~550) is ~7x the mean (~73) — and a flat unroll
+over W slots per step both wastes ~85% of the per-nonzero work on padding
+and blows Mosaic compile time up superlinearly in the unrolled-slot count
+(measured: W=548 flat → 7 min compile; a pl.when-group-early-exit variant
+kept the unroll and still compiled for minutes).  The per-nonzero loop is
+therefore a **dynamic-trip ``fori_loop`` over GROUP-slot bodies**: the
+trip count is ceil(row_nnz / GROUP) from a scalar-prefetched per-row
+count, the body unrolls GROUP slots (values and indices are SMEM scalar
+reads at dynamic group offsets), and the round-3 dead-end — ~200 ns of
+scalar-branch overhead per dynamic iteration — amortizes to ~6 ns per
+nonzero at GROUP=32.  Per step the cost tracks ceil(nnz/32)·32 slots
+instead of W, compile size is ONE group body per pass per shard, and any
+padded width works with no special tail.
 
 Size guards: the SMEM index table is K·H_seg·max_nnz ints and must stay
 under ``SMEM_IDX_BUDGET`` (512 KB — the 712 KB full-round rcv1 table
@@ -74,9 +92,8 @@ def sparse_vmem_estimate(n_shard: int, d: int, max_nnz: int, itemsize: int,
     double-buffered (8, max_nnz) value blocks."""
     n_pad = -(-n_shard // LANES) * LANES
     d_pad = -(-d // LANES) * LANES
-    return itemsize * k * (
-        6 * d_pad + 9 * n_pad + 2 * ROW_BLOCK * max_nnz
-    )
+    del max_nnz  # values ride SMEM now (module docstring)
+    return itemsize * k * (6 * d_pad + 9 * n_pad)
 
 
 def sparse_kernel_fits(k: int, n_shard: int, d: int, max_nnz: int, h: int,
@@ -92,15 +109,20 @@ def sparse_kernel_fits(k: int, n_shard: int, d: int, max_nnz: int, h: int,
 
 
 def segment_len(k: int, max_nnz: int) -> int:
-    """Steps per kernel invocation so the (K, H_seg, max_nnz) int32 SMEM
-    feature-index table stays inside the budget."""
-    return SMEM_IDX_BUDGET // (4 * k * max(1, max_nnz))
+    """Steps per kernel invocation so the two (K, H_seg, max_nnz) SMEM
+    tables (int32 feature indices + f32 values) stay inside the budget."""
+    return SMEM_IDX_BUDGET // (8 * k * max(1, max_nnz))
+
+
+GROUP = 32             # slots per dynamic-loop body (one branch per GROUP)
 
 
 def _kernel(
     idxs_ref,        # scalar-prefetch: (K, H_seg) int32 sampled rows
     gidx_ref,        # scalar-prefetch: (K, H_seg, W) int32 feature indices
-    *refs,           # K val blocks, wd_in, st_in, 2 outs, 2K scratch
+    svals_ref,       # scalar-prefetch: (K, H_seg, W) f32 nonzero values
+    cnts_ref,        # scalar-prefetch: (K, H_seg) int32 per-row nnz counts
+    *refs,           # wd_in, st_in, 2 outs, 2K+1 scratch
     lam_n: float,
     coef_div: float,
     sig_eff: float,
@@ -113,17 +135,15 @@ def _kernel(
     k: int,
 ):
     # refs layout (see module docstring for the concatenated layouts):
-    #   val_refs[kk]  (1, ROW_BLOCK, W) VMEM: aligned block holding the row
     #   wd_in         (K, n_dblk, 2·LANES): [w | Δw_carried] per shard
     #   st_in         (K, n_blocks, 3·LANES): [labels | ‖x‖² | α] per shard
     #   wd_out, st_out — same shapes (flushed at segment end; Δw and α
     #                    carry to the next segment through them)
     #   wd_scs[kk], st_scs[kk] — per-shard scratch (separate refs: chains
     #                    must not alias)
-    val_refs = refs[:k]
-    wd_in, st_in, wd_out, st_out = refs[k:k + 4]
-    wd_scs = refs[k + 4:k + 4 + k]
-    st_scs = refs[k + 4 + k:]
+    wd_in, st_in, wd_out, st_out = refs[:4]
+    wd_scs = refs[4:4 + k]
+    st_scs = refs[4 + k:4 + 2 * k]
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -135,54 +155,73 @@ def _kernel(
     lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * LANES), 1)
     lane3 = jax.lax.broadcasted_iota(jnp.int32, (1, 3 * LANES), 1)
 
+    group = min(GROUP, w_nnz)
+
     for kk in range(k):
         idx = idxs_ref[kk, i]
+        cnt = cnts_ref[kk, i]
+        n_trips = (cnt + (group - 1)) // group
         blk = idx // LANES
         sub_lane = idx - blk * LANES
         srow = st_scs[kk][pl.ds(blk, 1)]          # (1, 3·LANES)
         y = jnp.sum(jnp.where(lane3 == sub_lane, srow, 0.0))
         sq = jnp.sum(jnp.where(lane3 == sub_lane + LANES, srow, 0.0))
         a = jnp.sum(jnp.where(lane3 == sub_lane + 2 * LANES, srow, 0.0))
+        dtype = srow.dtype
 
-        # the sampled row's values: sublane idx % 8 of the aligned block
-        sub = idx - (idx // ROW_BLOCK) * ROW_BLOCK
-        val_row = val_refs[kk][0, pl.ds(sub, 1), :]          # (1, W)
-        vlane = jax.lax.broadcasted_iota(jnp.int32, val_row.shape, 1)
-
-        # margin = x·w + sig_eff·(x·Δw) in one pass over the nonzeros: ONE
-        # dynamic slice per nonzero serves both the w and Δw picks (they
-        # share the concatenated row).  Padded slots contribute exactly 0.
-        margin = jnp.asarray(0.0, val_row.dtype)
-        fblk = []
-        fl = []
-        vals = []
-        for j in range(w_nnz):
+        def slot_margin(j):
+            # one nonzero's margin contribution: the value/index are O(1)
+            # SMEM scalar reads, and ONE dynamic slice serves both the w
+            # and Δw picks (they share the concatenated row); slots past
+            # the row's count carry index 0 / value 0 and contribute
+            # exactly 0 (the trip count rounds up to the group size)
             f = gidx_ref[kk, i, j]
             fb = f // LANES
             fls = f - fb * LANES
-            vj = jnp.sum(jnp.where(vlane == j, val_row, 0.0))
-            fblk.append(fb)
-            fl.append(fls)
-            vals.append(vj)
+            vj = svals_ref[kk, i, j]
             wrow = wd_scs[kk][pl.ds(fb, 1)]       # (1, 2·LANES)
             coord = jnp.sum(jnp.where(lane2 == fls, wrow, 0.0))
             if not frozen:
                 coord = coord + sig_eff * jnp.sum(
                     jnp.where(lane2 == fls + LANES, wrow, 0.0)
                 )
-            margin = margin + vj * coord
+            return vj * coord
+
+        # margin = x·w + sig_eff·(x·Δw), ceil(cnt/GROUP) dynamic trips of
+        # a GROUP-slot unrolled body (module docstring: the dynamic-loop
+        # branch overhead amortizes over the group; padding groups never
+        # run)
+        def margin_body(g, acc):
+            base = g * group
+            for u in range(group):
+                acc = acc + slot_margin(base + u)
+            return acc
+
+        margin = jax.lax.fori_loop(0, n_trips, margin_body,
+                                   jnp.asarray(0.0, dtype))
 
         new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor,
                                   lam_n, smoothing=smoothing)
         coef = y * (new_a - a) / coef_div
 
-        # scatter-add coef·x into the Δw lanes: one masked row update per
-        # nonzero (fresh read — nonzeros may share a 128-lane block)
-        for j in range(w_nnz):
-            wrow = wd_scs[kk][pl.ds(fblk[j], 1)]
-            wd_scs[kk][pl.ds(fblk[j], 1)] = jnp.where(
-                lane2 == fl[j] + LANES, wrow + coef * vals[j], wrow
-            )
+        def scatter_body(g, carry):
+            # scatter-add coef·x into the Δw lanes: one masked row update
+            # per nonzero (fresh read — nonzeros may share a lane block);
+            # padded slots add exactly 0
+            base = g * group
+            for u in range(group):
+                f = gidx_ref[kk, i, base + u]
+                fb = f // LANES
+                fls = f - fb * LANES
+                vj = svals_ref[kk, i, base + u]
+                wrow = wd_scs[kk][pl.ds(fb, 1)]
+                wd_scs[kk][pl.ds(fb, 1)] = jnp.where(
+                    lane2 == fls + LANES, wrow + coef * vj, wrow
+                )
+            return carry
+
+        jax.lax.fori_loop(0, n_trips, scatter_body, jnp.int32(0))
+
         st_scs[kk][pl.ds(blk, 1)] = jnp.where(
             lane3 == sub_lane + 2 * LANES, new_a, srow
         )
@@ -192,6 +231,19 @@ def _kernel(
         for kk in range(k):
             wd_out[kk] = wd_scs[kk][...]
             st_out[kk] = st_scs[kk][...]
+
+
+def row_lengths(sp_values: jax.Array) -> jax.Array:
+    """(K, n_shard) int32 per-row nonzero-prefix lengths — 1 + the last
+    slot holding a nonzero value (interior explicit zeros count; trailing
+    padding does not).  Drives the kernel's group early exit; hot paths
+    compute this ONCE per run (run_sdca_family attaches it to
+    shard_arrays as ``sp_row_len``) — per round it would re-read the whole
+    values array."""
+    w = sp_values.shape[-1]
+    iota = jnp.arange(1, w + 1, dtype=jnp.int32)
+    return jnp.max(jnp.where(sp_values != 0, iota, 0), axis=-1) \
+        .astype(jnp.int32)
 
 
 @functools.partial(
@@ -214,6 +266,7 @@ def pallas_sparse_sdca_round(
     interpret: bool = False,
     loss: str = "hinge",
     smoothing: float = 1.0,
+    row_len: jax.Array = None,   # (K, n_shard) int32, see row_lengths
 ):
     """One sparse SDCA round for K shards on this chip.  Returns
     (dw, alpha_inner): dw (K, d) unreduced per-shard updates (dense — Δw is
@@ -242,7 +295,10 @@ def pallas_sparse_sdca_round(
             f"(shard_dataset pads to 16)"
         )
     sig_eff, qii_factor = mode_factors(mode, sigma)
-    h_seg = max(1, segment_len(k, w_nnz))
+    # segment sizing must use the GROUP-rounded width the SMEM tables are
+    # actually padded to, or the budget overruns by up to one group
+    w_round = -(-w_nnz // min(GROUP, w_nnz)) * min(GROUP, w_nnz)
+    h_seg = max(1, segment_len(k, w_round))
 
     # lane-block and lane-concatenate the state (module docstring layouts)
     n_pad = -(-n_shard // LANES) * LANES
@@ -262,32 +318,40 @@ def pallas_sparse_sdca_round(
         [blocked(labels), blocked(sq_norms), blocked(alpha)], axis=-1
     )
     idxs = idxs.astype(jnp.int32)
-
-    def val_spec(kk):
-        # the sampled row's values: 8-row aligned block at idx//8*8
-        return pl.BlockSpec(
-            (1, ROW_BLOCK, w_nnz),
-            lambda i_, idxs_, gidx_, kk=kk: (
-                kk, idxs_[kk, i_] // ROW_BLOCK, 0
-            ),
-        )
+    if row_len is None:
+        row_len = row_lengths(sp_values)
 
     full_wd = pl.BlockSpec(
-        (k, n_dblk, 2 * LANES), lambda i_, idxs_, gidx_: (0, 0, 0)
+        (k, n_dblk, 2 * LANES),
+        lambda i_, idxs_, gidx_, svals_, cnts_: (0, 0, 0)
     )
     full_st = pl.BlockSpec(
-        (k, n_blocks, 3 * LANES), lambda i_, idxs_, gidx_: (0, 0, 0)
+        (k, n_blocks, 3 * LANES),
+        lambda i_, idxs_, gidx_, svals_, cnts_: (0, 0, 0)
     )
 
     for lo in range(0, h, h_seg):
         seg = idxs[:, lo:lo + h_seg]
         h_this = seg.shape[1]
-        # the segment's feature indices, gathered into the SMEM prefetch
-        # table (addresses must be scalars; Mosaic cannot read them from
-        # VMEM)
+        # the segment's feature indices AND values, gathered into the SMEM
+        # prefetch tables (addresses must be scalars; Mosaic cannot read
+        # them from VMEM — and the SMEM value read is O(1) in W where the
+        # old VMEM lane-mask pick was O(W)), plus the rows' nnz counts for
+        # the group early exit
         gidx = jnp.take_along_axis(
             sp_indices, seg[:, :, None], axis=1
         )  # (K, h_this, W)
+        svals = jnp.take_along_axis(
+            sp_values, seg[:, :, None], axis=1
+        ).astype(dtype)  # (K, h_this, W)
+        cnts = jnp.take_along_axis(row_len, seg, axis=1)  # (K, h_this)
+        # pad the slot axis to the GROUP-rounded width (computed once
+        # above): the kernel's trip count rounds the row's nnz up to whole
+        # groups, and the last group may read past W otherwise (zero slots
+        # are inert)
+        if w_round != w_nnz:
+            gidx = jnp.pad(gidx, ((0, 0), (0, 0), (0, w_round - w_nnz)))
+            svals = jnp.pad(svals, ((0, 0), (0, 0), (0, w_round - w_nnz)))
 
         kernel = functools.partial(
             _kernel,
@@ -303,10 +367,9 @@ def pallas_sparse_sdca_round(
             k=k,
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=4,
             grid=(h_this,),
             in_specs=[
-                *[val_spec(kk) for kk in range(k)],
                 full_wd,   # [w | Δw] (Δw carried between segments)
                 full_st,   # [labels | ‖x‖² | α]
             ],
@@ -327,7 +390,7 @@ def pallas_sparse_sdca_round(
                 dimension_semantics=("arbitrary",),
             ),
             interpret=interpret,
-        )(seg, gidx, *([sp_values] * k), wd, st)
+        )(seg, gidx, svals, cnts, wd, st)
 
     dw = wd[:, :, LANES:].reshape(k, d_pad)[:, :d]
     alpha_inner = st[:, :, 2 * LANES:].reshape(k, n_pad)[:, :n_shard]

@@ -41,7 +41,8 @@ def _pallas_batched(w, alpha, idxs_kh, shards, params, mode, sigma,
         return pallas_sparse_sdca_round(
             w, alpha, shards["sp_indices"], shards["sp_values"],
             shards["labels"], shards["sq_norms"], idxs_kh,
-            params.lam, params.n, **common,
+            params.lam, params.n, row_len=shards.get("sp_row_len"),
+            **common,
         )
     from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
 
@@ -453,6 +454,13 @@ def run_sdca_family(
         from cocoa_tpu.ops.pallas_sdca import fold_rows
 
         shard_arrays = {**shard_arrays, "X_folded": fold_rows(shard_arrays["X"])}
+    if pallas and ds.layout == "sparse":
+        # per-row nnz counts for the kernel's group early exit, ONCE per
+        # run (per round it would re-read the whole values array)
+        from cocoa_tpu.ops.pallas_sparse import row_lengths
+
+        shard_arrays = {**shard_arrays,
+                        "sp_row_len": row_lengths(shard_arrays["sp_values"])}
 
     if eval_fn is None:
         def eval_fn(state):

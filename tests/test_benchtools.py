@@ -40,9 +40,10 @@ def test_slope_time_cancels_fixed_cost(monkeypatch):
     fixed, per_round = 0.37, 0.004
     log = []
     make_run = _fake_clock(monkeypatch, fixed, per_round, log)
-    steady, fx = slope_time(make_run, 100, min_span_s=1.0, reps=2)
-    np.testing.assert_allclose(steady, 100 * per_round, rtol=1e-9)
-    np.testing.assert_allclose(fx, fixed, rtol=1e-9)
+    sr = slope_time(make_run, 100, min_span_s=1.0, reps=2)
+    np.testing.assert_allclose(sr.steady_s, 100 * per_round, rtol=1e-9)
+    np.testing.assert_allclose(sr.fixed_s, fixed, rtol=1e-9)
+    assert not sr.degraded and sr.span_s >= 1.0
     # no escalation needed: at m=4 the span is 300*0.004 = 1.2 >= 1.0
     assert max(log) == 400, log
 
@@ -53,11 +54,22 @@ def test_slope_time_escalates_when_fixed_dominates(monkeypatch):
     fixed, per_round = 2.0, 0.0004   # tiny workload under huge fixed cost
     log = []
     make_run = _fake_clock(monkeypatch, fixed, per_round, log)
-    steady, fx = slope_time(make_run, 100, min_span_s=1.0, reps=2)
-    np.testing.assert_allclose(steady, 100 * per_round, rtol=1e-9)
-    np.testing.assert_allclose(fx, fixed, rtol=1e-9)
+    sr = slope_time(make_run, 100, min_span_s=1.0, reps=2)
+    np.testing.assert_allclose(sr.steady_s, 100 * per_round, rtol=1e-9)
+    np.testing.assert_allclose(sr.fixed_s, fixed, rtol=1e-9)
     # span at m: (m-1)*100*0.0004 >= 1.0 needs m >= 26 -> escalates to 32
     assert max(log) == 3200, log
+
+
+def test_slope_time_flags_degraded_measurement(monkeypatch):
+    """ADVICE r3: escalation that exits at max_mult without the span
+    dominating the jitter must be flagged, not recorded silently."""
+    from slope import slope_time
+
+    log = []
+    make_run = _fake_clock(monkeypatch, 2.0, 0.000001, log)
+    sr = slope_time(make_run, 100, min_span_s=1.0, reps=2, max_mult=8)
+    assert sr.degraded and sr.span_s < 1.0
 
 
 def test_sync_doc_block_replaces_only_marked_region(tmp_path):

@@ -171,6 +171,70 @@ print("RESULT " + json.dumps({"delta": delta, "full": full,
 
 
 @pytest.mark.slow
+def test_elastic_supervisor_recovers_from_sigkill(tmp_path, monkeypatch):
+    """VERDICT r3 item 7 (coverage row 23): the --elastic supervisor is the
+    all-reduce-runtime analogue of Spark's implicit lineage recovery — a
+    SIGKILLed worker brings the gang down, the supervisor relaunches it
+    with --resume, and the run completes to the final round with the same
+    state an uninterrupted run reaches (resume exactness is pinned by
+    tests/test_crash_resume.py; this test pins the supervision mechanics:
+    detection, gang teardown, restart, completion)."""
+    import signal
+    import threading
+    import time as _time
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu import elastic
+    from cocoa_tpu.data.synth import synth_sparse, write_libsvm
+
+    data = synth_sparse(96, 64, nnz_mean=8, seed=2)
+    train = tmp_path / "train.dat"
+    write_libsvm(data, str(train))
+    ckdir = tmp_path / "ck"
+    rounds = 300
+    argv = [
+        f"--trainFile={train}", "--numFeatures=64", f"--numRounds={rounds}",
+        "--localIterFrac=0.2", "--numSplits=2", "--lambda=.01",
+        "--justCoCoA=true", "--debugIter=10", f"--chkptDir={ckdir}",
+        "--chkptIter=10", "--dtype=float64",
+    ]
+    # each worker gets ONE cpu device (2-device global mesh over Gloo)
+    monkeypatch.setenv("XLA_FLAGS", " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    ))
+
+    gens = []
+
+    def on_generation(gen, procs):
+        gens.append(gen)
+        if gen == 0:
+            def killer():
+                # wait for the run to be demonstrably mid-flight (a first
+                # checkpoint exists), then SIGKILL one worker
+                for _ in range(600):
+                    if ckpt_lib.latest(str(ckdir), "CoCoA+"):
+                        break
+                    _time.sleep(0.25)
+                if procs[1].poll() is None:
+                    procs[1].send_signal(signal.SIGKILL)
+            threading.Thread(target=killer, daemon=True).start()
+
+    rc = elastic.supervise(argv, 2, max_restarts=3,
+                           on_generation=on_generation, quiet_tail=True)
+    assert rc == 0
+    assert len(gens) >= 2, "the gang was never restarted"
+    # the second CoCoA+ pass (justCoCoA runs CoCoA+ then CoCoA) finished:
+    # a final-round checkpoint exists for both algorithms
+    for alg in ("CoCoA+", "CoCoA"):
+        path = ckpt_lib.latest(str(ckdir), alg)
+        assert path is not None
+        meta, w, a = ckpt_lib.load(path)
+        assert meta["round"] == rounds
+        assert w.shape == (64,) and a is not None
+
+
+@pytest.mark.slow
 def test_two_process_loading_materializes_only_local_shard(tmp_path):
     """VERDICT r1 item 5: per-process memory stays ~1/K of the dense
     matrix — each process builds only its own shard's host slab and device

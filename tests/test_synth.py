@@ -144,7 +144,10 @@ def test_synth_sparse_solvable_via_shard_dataset():
 
     data = synth_sparse(240, 300, nnz_mean=20, seed=3)
     ds = shard_dataset(data, k=4, layout="sparse", dtype=jnp.float64)
-    params = Params(n=data.n, num_rounds=150, local_iters=60, lam=1e-3)
+    # 400 rounds: the round-4 tf-idf value distribution (heavier value
+    # skew) conditions this tiny planted problem a bit worse than the
+    # round-3 iid values — the property under test is convergence
+    params = Params(n=data.n, num_rounds=400, local_iters=60, lam=1e-3)
     _, _, traj = run_cocoa(ds, params, DebugParams(debug_iter=25, seed=0),
                            plus=True, quiet=True, gap_target=5e-3)
     assert traj.records[-1].gap <= 5e-3
