@@ -48,7 +48,7 @@ from cocoa_tpu.parallel import make_mesh
 from cocoa_tpu.solvers import run_cocoa, run_dist_gd, run_minibatch_cd, run_sgd
 
 _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
-              "smoothing", "sampling")  # same-named RunConfig fields
+              "smoothing", "sampling", "sigma")  # same-named RunConfig fields
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize",
@@ -57,7 +57,8 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
                "debug_iter", "seed"}
-_FLOAT_FIELDS = {"lam", "local_iter_frac", "beta", "gamma", "smoothing"}
+_FLOAT_FIELDS = {"lam", "local_iter_frac", "beta", "gamma", "smoothing",
+                 "sigma"}
 
 
 def parse_args(argv: list[str]):

@@ -222,9 +222,14 @@ def _kernel(
 
         jax.lax.fori_loop(0, n_trips, scatter_body, jnp.int32(0))
 
-        st_scs[kk][pl.ds(blk, 1)] = jnp.where(
-            lane3 == sub_lane + 2 * LANES, new_a, srow
-        )
+        # cnt < 0 marks a padding step (the segment scan pads the round to
+        # whole segments): its margin/scatter loops already ran 0 trips,
+        # and the alpha write is gated off so the step is a true no-op
+        @pl.when(cnt >= 0)
+        def _write_alpha():
+            st_scs[kk][pl.ds(blk, 1)] = jnp.where(
+                lane3 == sub_lane + 2 * LANES, new_a, srow
+            )
 
     @pl.when(i == h - 1)
     def _flush():
@@ -298,7 +303,9 @@ def pallas_sparse_sdca_round(
     # segment sizing must use the GROUP-rounded width the SMEM tables are
     # actually padded to, or the budget overruns by up to one group
     w_round = -(-w_nnz // min(GROUP, w_nnz)) * min(GROUP, w_nnz)
-    h_seg = max(1, segment_len(k, w_round))
+    # capped at h: a small round must not pad up to a full budget-sized
+    # grid of no-op steps
+    h_seg = max(1, min(segment_len(k, w_round), h))
 
     # lane-block and lane-concatenate the state (module docstring layouts)
     n_pad = -(-n_shard // LANES) * LANES
@@ -330,67 +337,87 @@ def pallas_sparse_sdca_round(
         lambda i_, idxs_, gidx_, svals_, cnts_: (0, 0, 0)
     )
 
-    for lo in range(0, h, h_seg):
-        seg = idxs[:, lo:lo + h_seg]
-        h_this = seg.shape[1]
-        # the segment's feature indices AND values, gathered into the SMEM
-        # prefetch tables (addresses must be scalars; Mosaic cannot read
-        # them from VMEM — and the SMEM value read is O(1) in W where the
-        # old VMEM lane-mask pick was O(W)), plus the rows' nnz counts for
-        # the group early exit
-        gidx = jnp.take_along_axis(
-            sp_indices, seg[:, :, None], axis=1
-        )  # (K, h_this, W)
-        svals = jnp.take_along_axis(
-            sp_values, seg[:, :, None], axis=1
-        ).astype(dtype)  # (K, h_this, W)
-        cnts = jnp.take_along_axis(row_len, seg, axis=1)  # (K, h_this)
-        # pad the slot axis to the GROUP-rounded width (computed once
-        # above): the kernel's trip count rounds the row's nnz up to whole
-        # groups, and the last group may read past W otherwise (zero slots
-        # are inert)
-        if w_round != w_nnz:
-            gidx = jnp.pad(gidx, ((0, 0), (0, 0), (0, w_round - w_nnz)))
-            svals = jnp.pad(svals, ((0, 0), (0, 0), (0, w_round - w_nnz)))
+    # The round's per-step feature indices AND values, gathered into SMEM
+    # prefetch tables (addresses must be scalars; Mosaic cannot read them
+    # from VMEM — and an SMEM value read is O(1) in W where a VMEM
+    # lane-mask pick is O(W)), plus the rows' nnz counts for the
+    # dynamic-trip loop.  The round pads to whole segments (padding steps
+    # carry cnt = -1 → a kernel no-op) and runs as ONE ``lax.scan`` over
+    # segments with a single pallas_call in the body: with localIterFrac=1
+    # the round spans ~200 segments, and the round-3 unrolled-segment form
+    # built ~200 pallas call sites into the graph — minutes of
+    # trace/compile before the first step ran.
+    n_seg = -(-h // h_seg)
+    h_pad = n_seg * h_seg
+    idxs_p = jnp.pad(idxs, ((0, 0), (0, h_pad - h)))
+    gidx = jnp.take_along_axis(sp_indices, idxs_p[:, :, None], axis=1)
+    svals = jnp.take_along_axis(
+        sp_values, idxs_p[:, :, None], axis=1).astype(dtype)
+    cnts = jnp.pad(
+        jnp.take_along_axis(row_len, idxs_p, axis=1)[:, :h],
+        ((0, 0), (0, h_pad - h)), constant_values=-1,
+    )
+    # pad the slot axis to the GROUP-rounded width (computed once above):
+    # the kernel's trip count rounds the row's nnz up to whole groups, and
+    # the last group may read past W otherwise (zero slots are inert)
+    if w_round != w_nnz:
+        gidx = jnp.pad(gidx, ((0, 0), (0, 0), (0, w_round - w_nnz)))
+        svals = jnp.pad(svals, ((0, 0), (0, 0), (0, w_round - w_nnz)))
+    # (n_seg, K, h_seg[, W]) scan leaves
+    seg_shape = lambda a: a.reshape(k, n_seg, h_seg, *a.shape[2:]) \
+        .swapaxes(0, 1)  # noqa: E731
+    xs = (seg_shape(idxs_p), seg_shape(gidx), seg_shape(svals),
+          seg_shape(cnts))
 
-        kernel = functools.partial(
-            _kernel,
-            lam_n=float(lam * n),
-            coef_div=float(coef_divisor(mode, lam * n)),
-            sig_eff=float(sig_eff),
-            qii_factor=float(qii_factor),
-            frozen=(mode == "frozen"),
-            h=h_this,
-            w_nnz=w_nnz,
-            loss=losses.validate(loss, smoothing),
-            smoothing=float(smoothing),
-            k=k,
-        )
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
-            grid=(h_this,),
-            in_specs=[
-                full_wd,   # [w | Δw] (Δw carried between segments)
-                full_st,   # [labels | ‖x‖² | α]
-            ],
-            out_specs=[full_wd, full_st],
-            scratch_shapes=(
-                [pltpu.VMEM((n_dblk, 2 * LANES), dtype)] * k
-                + [pltpu.VMEM((n_blocks, 3 * LANES), dtype)] * k
-            ),
-        )
-        wd, st = pl.pallas_call(
-            kernel,
-            grid_spec=grid_spec,
-            out_shape=[
-                jax.ShapeDtypeStruct((k, n_dblk, 2 * LANES), dtype),
-                jax.ShapeDtypeStruct((k, n_blocks, 3 * LANES), dtype),
-            ],
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("arbitrary",),
-            ),
-            interpret=interpret,
-        )(seg, gidx, svals, cnts, wd, st)
+    kernel = functools.partial(
+        _kernel,
+        lam_n=float(lam * n),
+        coef_div=float(coef_divisor(mode, lam * n)),
+        sig_eff=float(sig_eff),
+        qii_factor=float(qii_factor),
+        frozen=(mode == "frozen"),
+        h=h_seg,
+        w_nnz=w_nnz,
+        loss=losses.validate(loss, smoothing),
+        smoothing=float(smoothing),
+        k=k,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(h_seg,),
+        in_specs=[
+            full_wd,   # [w | Δw] (Δw carried between segments)
+            full_st,   # [labels | ‖x‖² | α]
+        ],
+        out_specs=[full_wd, full_st],
+        scratch_shapes=(
+            [pltpu.VMEM((n_dblk, 2 * LANES), dtype)] * k
+            + [pltpu.VMEM((n_blocks, 3 * LANES), dtype)] * k
+        ),
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((k, n_dblk, 2 * LANES), dtype),
+            jax.ShapeDtypeStruct((k, n_blocks, 3 * LANES), dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+
+    def seg_body(carry, seg_xs):
+        wd_c, st_c = carry
+        si, sg, sv, sc = seg_xs
+        wd_c, st_c = call(si, sg, sv, sc, wd_c, st_c)
+        return (wd_c, st_c), None
+
+    if n_seg == 1:
+        (wd, st), _ = seg_body((wd, st), jax.tree.map(lambda a: a[0], xs))
+    else:
+        (wd, st), _ = jax.lax.scan(seg_body, (wd, st), xs)
 
     dw = wd[:, :, LANES:].reshape(k, d_pad)[:, :d]
     alpha_inner = st[:, :, 2 * LANES:].reshape(k, n_pad)[:, :n_shard]

@@ -62,12 +62,20 @@ def _alg_config(params: Params, k: int, plus: Optional[bool], mode=None):
 
     scaling law: γ (CoCoA+, additive) | β/K (CoCoA, averaging) —
     CoCoA.scala:37, with σ′ = K·γ (CoCoA.scala:45); β/(K·H) for
-    mini-batch CD (MinibatchCD.scala:32, w frozen so σ is unused)."""
+    mini-batch CD (MinibatchCD.scala:32, w frozen so σ is unused).
+
+    ``params.sigma`` overrides σ′ (extension, --sigma): K·γ is the paper's
+    safe bound for ADVERSARIAL shard coherence; randomly-partitioned data
+    tolerates a smaller σ′ = bigger effective local steps, and the exact
+    duality-gap certificate reports divergence if pushed too far
+    (measured: σ′=K/2 halves rcv1's certified comm-rounds; anything below
+    K/2 — already σ′=3.5 at K=8 — diverges visibly)."""
+    sig = k * params.gamma if params.sigma is None else float(params.sigma)
     if mode == "frozen":
         return "frozen", params.beta / (k * params.local_iters), 1.0
     if plus:
-        return "plus", params.gamma, k * params.gamma
-    return "cocoa", params.beta / k, k * params.gamma
+        return "plus", params.gamma, sig
+    return "cocoa", params.beta / k, sig
 
 
 def _sdca_round_parts(
