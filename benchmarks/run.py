@@ -1095,29 +1095,34 @@ def main():
 
     results = []
     perf_rows = []
+    out_dir = os.path.dirname(os.path.abspath(__file__))
+    partial = args.quick or only is not None
+
+    def flush(new=1):
+        # write after EVERY section: a tunnel hang mid-suite (it happens —
+        # round 4 lost a 47-minute run to one) must not lose the sections
+        # already measured
+        for r in results[-new:]:
+            print(json.dumps(r))
+        write_results(results, perf_rows, out_dir, partial=partial)
+
     if only is None or "demo" in only:
         bench_demo(results, perf_rows)
-        print(json.dumps(results[-1]))
+        flush(2)
     if only is None or "epsilon" in only:
         bench_epsilon(results, perf_rows, args.quick, args.data_dir)
-        for r in results[-3:]:
-            print(json.dumps(r))
+        flush(3)
     if only is None or "rcv1" in only:
         bench_rcv1(results, perf_rows, args.quick, args.data_dir)
-        for r in results[-3:]:
-            print(json.dumps(r))
+        flush(3)
     if only is None or "losses" in only:
         bench_losses(results, perf_rows, args.quick)
-        for r in results[-2:]:
-            print(json.dumps(r))
+        flush(2)
     if only is None or "lasso" in only:
         bench_lasso(results, perf_rows, args.quick)
-        print(json.dumps(results[-1]))
+        flush(3)
     for r in perf_rows:
         print(json.dumps({"type": "perf", **r}))
-    write_results(results, perf_rows,
-                  os.path.dirname(os.path.abspath(__file__)),
-                  partial=args.quick or only is not None)
     return 0
 
 
