@@ -326,7 +326,10 @@ def bench_demo(results, perf_rows):
 
     w, a, traj = gap_run()
     rec = traj.records[-1]
-    secs, fixed, q = _timed(make_run, rec.round)
+    # the demo workload is tiny (~0.03 ms/round after the round-4 kernels);
+    # the default escalation cap cannot build a jitter-dominating span, so
+    # raise it for the demo rows rather than record them as noisy
+    secs, fixed, q = _timed(make_run, rec.round, max_mult=256)
     rate = _oracle_rounds_per_s(
         (data.to_dense(), data.labels), 1e-3, 50, 4, data.n
     )
@@ -345,7 +348,7 @@ def bench_demo(results, perf_rows):
     w_p, a_p, traj_p = gap_run("permuted")
     rec_p = traj_p.records[-1]
     secs_p, fixed_p, q_p = _timed(
-        lambda nr: make_run(nr, "permuted"), rec_p.round)
+        lambda nr: make_run(nr, "permuted"), rec_p.round, max_mult=256)
     results.append(dict(
         config="demo-cocoa+(permuted)", n=data.n, d=DEMO_D, k=4, h=50,
         lam=1e-3, gap_target=1e-4, rounds=rec_p.round,
