@@ -1,0 +1,98 @@
+"""Unit tests for the elastic supervisor's plumbing (the end-to-end
+SIGKILL/gang-restart behavior is tests/test_multihost.py's slow test)."""
+
+import sys
+
+from cocoa_tpu import elastic
+
+
+def test_strip_elastic_flags():
+    argv = ["--trainFile=x", "--elastic=2", "--master=h:1", "--resume",
+            "--processId=0", "--numProcesses=2", "--lambda=.01"]
+    assert elastic.strip_elastic_flags(argv) == [
+        "--trainFile=x", "--lambda=.01"]
+
+
+def test_supervise_worker_argv_and_resume_flag(monkeypatch):
+    """The spawned worker command carries the user flags, the supervisor's
+    --master/--processId/--numProcesses — and --resume exactly when
+    requested."""
+    spawned = []
+    real_spawn = elastic._spawn
+
+    def spy(worker_argv, i, n, port, python, module, quiet_tail, resume):
+        p = real_spawn(["-c", "pass"], i, n, port, sys.executable,
+                       "timeit", True, False)  # harmless real process
+        spawned.append(
+            [python, "-m", module, *worker_argv,
+             f"--master=127.0.0.1:{port}",
+             f"--processId={i}", f"--numProcesses={n}",
+             *(["--resume"] if resume else [])]
+        )
+        return p
+
+    monkeypatch.setattr(elastic, "_spawn", spy)
+    for resume in (True, False):
+        spawned.clear()
+        elastic.supervise(["--lambda=.01"], 2, python="py", module="m",
+                          resume=resume, poll_s=0.05, max_restarts=0)
+        assert len(spawned) == 2
+        for i, argv in enumerate(spawned):
+            assert argv[:2] == ["py", "-m"] and argv[2] == "m"
+            assert "--lambda=.01" in argv
+            assert f"--processId={i}" in argv
+            assert "--numProcesses=2" in argv
+            assert any(a.startswith("--master=127.0.0.1:") for a in argv)
+            assert ("--resume" in argv) == resume
+
+
+def test_supervise_gives_up_after_consecutive_failures():
+    rc = elastic.supervise(
+        ["-c", "import sys; sys.exit(3)"], 1, python=sys.executable,
+        module="timeit", max_restarts=1, poll_s=0.05, resume=False,
+    )
+    assert rc != 0
+
+
+def test_supervise_progress_resets_budget(monkeypatch):
+    """When progress_token changes between generations the restart streak
+    resets; without progress it gives up after max_restarts."""
+    calls = {"n": 0}
+
+    class FakeProc:
+        def __init__(self):
+            calls["n"] += 1
+
+        def poll(self):
+            return 3  # always dead
+
+        def send_signal(self, sig):
+            pass
+
+        def wait(self, timeout=None):
+            return 3
+
+    monkeypatch.setattr(elastic, "_spawn",
+                        lambda *a, **k: FakeProc())
+    tokens = iter(range(100))  # changes every generation -> streak resets
+    stop = {"gen": 0}
+
+    def token():
+        stop["gen"] += 1
+        if stop["gen"] > 7:
+            raise KeyboardInterrupt  # escape the would-be-infinite loop
+        return next(tokens)
+
+    try:
+        elastic.supervise([], 1, max_restarts=1, poll_s=0.0,
+                          resume=False, progress_token=token)
+    except KeyboardInterrupt:
+        pass
+    assert stop["gen"] > 3  # survived past max_restarts because of progress
+
+    # constant token: gives up after max_restarts+1 generations
+    calls["n"] = 0
+    rc = elastic.supervise([], 1, max_restarts=2, poll_s=0.0,
+                           resume=False, progress_token=lambda: 42)
+    assert rc == 3
+    assert calls["n"] == 3  # initial + 2 restarts
