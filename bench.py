@@ -168,7 +168,25 @@ def run_oracle_baseline() -> float:
     return time.perf_counter() - t0
 
 
+def _arm_deadline(minutes: float = 25.0) -> None:
+    """Hard exit if the run wedges: the tunneled device can die mid-session
+    (observed round 4 — backend init then blocks forever), and an infinite
+    hang is strictly worse for the caller than a clean nonzero exit."""
+    import threading
+
+    def boom():
+        print(f"bench: exceeded the {minutes:.0f}-minute deadline — "
+              f"device/tunnel likely unreachable; aborting", file=sys.stderr,
+              flush=True)
+        os._exit(3)
+
+    t = threading.Timer(minutes * 60.0, boom)
+    t.daemon = True
+    t.start()
+
+
 def main() -> int:
+    _arm_deadline(float(os.environ.get("COCOA_BENCH_DEADLINE_MIN", "25")))
     mode = os.environ.get("COCOA_BENCH_BASELINE", "")
     elapsed, fixed, raw, rounds = run_tpu()
     fpr = machine_fingerprint()
