@@ -19,7 +19,6 @@ from __future__ import annotations
 from typing import NamedTuple, Optional
 
 import jax
-import jax.numpy as jnp
 
 
 class Row(NamedTuple):
