@@ -4,9 +4,14 @@
 # on-device while_loop (one dispatch, one host fetch), random-reshuffling
 # sampling (~25% fewer comm-rounds here, ~5x at epsilon scale; the duality
 # gap certificate is exact under any index stream), stopping at the
-# certified 1e-4 gap instead of a fixed round budget.  Append --blockSize=128
+# certified 1e-4 gap instead of a fixed round budget.  Index tables are
+# generated in-jit on the device (--sampling=auto).  Append --blockSize=128
 # on large dense problems (H >= a few hundred) for the fused block-
-# coordinate MXU kernel (2.3x faster epsilon rounds, benchmarks/KERNELS.md).
+# coordinate MXU kernel (2.3x faster epsilon rounds, benchmarks/KERNELS.md),
+# and consider --sigma=<K/2> on randomly-partitioned data: the reference's
+# sigma'=K aggregation bound is worst-case, and K/2 halved the certified
+# comm-rounds on the rcv1 config (divergence, if pushed further, is
+# reported exactly by the gap certificate; benchmarks/SWEEPS.md).
 cd "$(dirname "$0")"
 exec python -m cocoa_tpu.cli \
   --trainFile=data/small_train.dat \
