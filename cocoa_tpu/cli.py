@@ -52,7 +52,7 @@ _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize",
-                "elastic")  # run-level
+                "elastic", "stallTimeout")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -134,6 +134,15 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    if extras["stallTimeout"] and not extras["elastic"]:
+        # without a supervisor there is no watchdog to act on the timeout —
+        # silently ignoring it would leave the user believing stall
+        # protection is active on a run that can still wedge forever
+        print("error: --stallTimeout only acts under --elastic=N (the "
+              "supervisor is what kills and restarts a wedged gang)",
+              file=sys.stderr)
+        return 2
+
     if extras["elastic"]:
         # --elastic=N: this process becomes the SUPERVISOR — it launches N
         # worker copies of this command line (each with its own processId
@@ -167,9 +176,31 @@ def main(argv=None) -> int:
                 f for f in os.listdir(cfg.chkpt_dir) if f.endswith(".npz")
             ))
 
+        stall = None
+        if extras["stallTimeout"]:
+            # --stallTimeout=SECONDS: also restart a gang that WEDGES
+            # without any process dying (dead device tunnel, one worker
+            # exiting 0 while peers block in a collective).  Progress =
+            # new round-stamped checkpoint files, so it needs --chkptDir
+            # and a sensible --chkptIter cadence.
+            try:
+                stall = float(extras["stallTimeout"])
+            except ValueError:
+                print("error: --stallTimeout must be seconds (float), got "
+                      f"{extras['stallTimeout']!r}", file=sys.stderr)
+                return 2
+            if stall <= 0:
+                print("error: --stallTimeout must be > 0", file=sys.stderr)
+                return 2
+            if not cfg.chkpt_dir:
+                print("error: --stallTimeout watches checkpoint progress "
+                      "— it needs --chkptDir", file=sys.stderr)
+                return 2
+
         return elastic.supervise(
             elastic.strip_elastic_flags(argv), n_workers,
             resume=bool(cfg.chkpt_dir), progress_token=progress_token,
+            stall_timeout_s=stall,
         )
 
     # multi-host: --master=host:port connects this process to the pod's

@@ -71,9 +71,23 @@ def supervise(
                           # "max_restarts" bounds CONSECUTIVE failed
                           # generations, not lifetime failures of a long
                           # run that keeps advancing
+    stall_timeout_s: Optional[float] = None,
+                          # no-progress watchdog (ADVICE r4): a gang can
+                          # wedge with every process still alive — a dead
+                          # device tunnel hangs the dispatch (the failure
+                          # mode that cost round 4 its benchmark artifact),
+                          # or one worker exits 0 while its peers block in
+                          # a collective that will never complete.  With
+                          # ``progress_token`` set, a generation whose
+                          # token has not changed for this many seconds is
+                          # killed and restarted exactly like a death
+                          # (counting against the consecutive-failure
+                          # budget — a stalled generation made no
+                          # progress, so the budget must not reset).
 ) -> int:
     """Run the gang to completion, restarting it (from the latest
-    checkpoint, via the workers' ``--resume``) whenever any member dies.
+    checkpoint, via the workers' ``--resume``) whenever any member dies —
+    or, with ``stall_timeout_s``, whenever it stops making progress.
     Returns the final exit code (0 on success; the failing worker's code
     after ``max_restarts`` consecutive failed generations).
 
@@ -83,6 +97,9 @@ def supervise(
     are silenced unless ``quiet_tail=False``.
     """
     python = python or sys.executable
+    if stall_timeout_s is not None and progress_token is None:
+        raise ValueError("stall_timeout_s needs progress_token — without "
+                         "a token there is no progress signal to watch")
     restarts = 0
     gen = 0
     last_token = progress_token() if progress_token else None
@@ -97,6 +114,8 @@ def supervise(
             on_generation(gen, procs)
         gen += 1
         failed = None
+        stalled = False
+        last_change = time.monotonic()
         try:
             while True:
                 codes = [p.poll() for p in procs]
@@ -106,6 +125,15 @@ def supervise(
                     break
                 if all(c == 0 for c in codes):
                     return 0
+                if stall_timeout_s is not None:
+                    token = progress_token()
+                    if token != last_token:
+                        last_token = token
+                        last_change = time.monotonic()
+                        restarts = 0   # live progress breaks the streak
+                    elif time.monotonic() - last_change > stall_timeout_s:
+                        stalled = True
+                        break
                 time.sleep(poll_s)
         finally:
             # any survivors are wedged inside a collective whose peer died
@@ -128,11 +156,14 @@ def supervise(
                 last_token = token  # run — the failure streak is broken
         restarts += 1
         if restarts > max_restarts:
+            why = ("stalled" if stalled
+                   else f"failed (last exit code {failed})")
             print(f"elastic: giving up after {max_restarts} consecutive "
-                  f"failed generations (last exit code {failed})",
-                  file=sys.stderr)
+                  f"{why} generations", file=sys.stderr)
             return int(failed or 1)
-        print(f"elastic: worker died (exit {failed}); restarting gang "
+        what = (f"gang made no progress for {stall_timeout_s:g}s"
+                if stalled else f"worker died (exit {failed})")
+        print(f"elastic: {what}; restarting gang "
               f"(attempt {restarts}/{max_restarts}) from the latest "
               f"checkpoint", file=sys.stderr, flush=True)
 
@@ -140,7 +171,8 @@ def supervise(
 def strip_elastic_flags(argv: list) -> list:
     """The worker command line = the user's line minus the flags the
     supervisor owns (it re-adds its own --master/--processId/...)."""
-    own = ("elastic", "master", "processId", "numProcesses", "resume")
+    own = ("elastic", "master", "processId", "numProcesses", "resume",
+           "stallTimeout")
     out = []
     for a in argv:
         key = a.lstrip("-").split("=", 1)[0]

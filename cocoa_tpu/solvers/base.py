@@ -21,6 +21,7 @@ Every algorithm's round has the same communication shape (the reference's
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Callable, Optional
 
 import jax
@@ -124,7 +125,32 @@ def drive_chunked(
     return state, traj
 
 
-_DEVICE_RUNS: dict = {}
+class ExecutableCache(OrderedDict):
+    """Bounded LRU for jitted executables (VERDICT r4: the per-config
+    caches grew forever in the long-lived bench process, which sweeps
+    dozens of configs).  Eviction drops the Python reference; XLA frees
+    the underlying executable when the last reference dies.  The cap is
+    sized so no realistic single run ever evicts (a run touches a handful
+    of configs) while a sweep stays bounded."""
+
+    def __init__(self, cap: int = 64):
+        super().__init__()
+        self.cap = cap
+
+    def get(self, key, default=None):
+        v = super().get(key, default)
+        if key in self:
+            self.move_to_end(key)
+        return v
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.cap:
+            self.popitem(last=False)
+
+
+_DEVICE_RUNS: dict = ExecutableCache()
 
 # cap on the resident (n_chunks, C, K, H) int32 index table per device-loop
 # dispatch; runs needing more split into super-blocks (tests shrink this)
@@ -514,8 +540,10 @@ class IndexSampler:
     solver's jitted chunk generates the (C, K, H) tables in-jit via
     :meth:`tables_from_ts` — bit-identical to the host tables for every
     mode (reference replay validated in tests/test_device_sampling.py; jax
-    and permuted are the same jax.random ops either way, and the jax PRNG
-    is backend-invariant)."""
+    and permuted draw from the same counter-hash / Feistel-bijection
+    streams (utils/prng.py) whether expanded on host or in-jit — host ≡
+    device because it is literally one integer-arithmetic implementation,
+    not because any PRNG library is backend-invariant)."""
 
     MODES = ("reference", "jax", "permuted")
 
@@ -581,8 +609,8 @@ class IndexSampler:
                 self.seed, range(t0, t0 + c), self.h, self.counts
             )  # (K, C, H)
             return jnp.asarray(np.swapaxes(tab, 0, 1))
-        # jax/permuted: one implementation for host and device tables (the
-        # jax PRNG is backend-invariant, so eager-vs-jit agree bitwise)
+        # jax/permuted: one counter-hash/Feistel implementation for host
+        # and device tables, so eager-vs-jit agree bitwise by construction
         return self.tables_from_ts(jnp.arange(t0, t0 + c, dtype=jnp.int32))
 
     def tables_from_ts(self, ts) -> jax.Array:

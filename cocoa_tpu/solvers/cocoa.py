@@ -247,7 +247,7 @@ def _make_chunk_kernel(mesh, params: Params, k: int, alg, sampler=None,
     return chunk_kernel
 
 
-_CHUNK_STEPS: dict = {}
+_CHUNK_STEPS: dict = base.ExecutableCache()
 
 
 def make_chunk_step(mesh, params: Params, k: int, alg, sampler=None,

@@ -60,7 +60,7 @@ def make_round_step(mesh, params: Params, k: int):
     return round_step
 
 
-_CHUNK_STEPS: dict = {}
+_CHUNK_STEPS: dict = base.ExecutableCache()
 
 
 def _make_chunk_kernel(mesh, params: Params, k: int):

@@ -82,7 +82,7 @@ def _rep(scalar, k):
     return jnp.broadcast_to(scalar, (k,))
 
 
-_CHUNK_STEPS: dict = {}
+_CHUNK_STEPS: dict = base.ExecutableCache()
 
 
 def _make_chunk_kernel(mesh, params: Params, k: int, local: bool,

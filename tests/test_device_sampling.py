@@ -12,10 +12,13 @@ the device tables to the host tables bit-for-bit:
   limbs, including the modulo-rejection filtering (exercised here with
   bounds just above a power of two, where ~half of all draws reject —
   far harsher than any real shard size).
-- ``jax``: same jax.random ops either way.
-- ``permuted``: same per-(seed, shard, epoch) jax PRNG permutations either
-  way; also re-pins the reshuffling invariants (coverage, chunk
-  invariance, continuity) on the jax-PRNG stream.
+- ``jax``: the same counter-hash stream (utils/prng.py) expanded host-side
+  or in-jit — one integer-arithmetic implementation, so host ≡ device by
+  construction (jax.random's batched-key threefry was abandoned for this
+  path: ~100 ms per dispatch through the tunnel).
+- ``permuted``: the same per-(seed, shard, epoch) Feistel-bijection
+  permutations either way; also re-pins the reshuffling invariants
+  (coverage, chunk invariance, continuity) on that stream.
 """
 
 import numpy as np

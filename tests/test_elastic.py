@@ -96,3 +96,74 @@ def test_supervise_progress_resets_budget(monkeypatch):
                            resume=False, progress_token=lambda: 42)
     assert rc == 3
     assert calls["n"] == 3  # initial + 2 restarts
+
+
+def test_supervise_stall_timeout_requires_token():
+    import pytest
+
+    with pytest.raises(ValueError):
+        elastic.supervise([], 1, stall_timeout_s=1.0, resume=False)
+
+
+def test_supervise_stall_watchdog_restarts_wedged_gang(monkeypatch):
+    """A gang that never exits and never advances its progress token is
+    killed and restarted by the watchdog, and gives up after the
+    consecutive-failure budget (ADVICE r4: death-only supervision polls a
+    wedged gang forever)."""
+    spawned = {"n": 0}
+    killed = {"n": 0}
+
+    class WedgedProc:
+        def __init__(self):
+            spawned["n"] += 1
+
+        def poll(self):
+            return None  # alive forever, making no progress
+
+        def send_signal(self, sig):
+            killed["n"] += 1
+
+        def wait(self, timeout=None):
+            return -9
+
+    monkeypatch.setattr(elastic, "_spawn", lambda *a, **k: WedgedProc())
+    rc = elastic.supervise(
+        [], 2, max_restarts=1, poll_s=0.0, resume=False,
+        progress_token=lambda: 42, stall_timeout_s=0.05,
+    )
+    assert rc == 1              # no exit code to report -> generic failure
+    assert spawned["n"] == 4    # 2 workers x (initial + 1 restart)
+    assert killed["n"] == 4     # every wedged worker was killed
+
+
+def test_supervise_stall_watchdog_progress_keeps_gang_alive(monkeypatch):
+    """A live gang whose token keeps changing is never restarted: the
+    watchdog clock resets on every change (and the failure streak too)."""
+    spawned = {"n": 0}
+    ticks = {"n": 0}
+
+    class Proc:
+        def __init__(self):
+            spawned["n"] += 1
+
+        def poll(self):
+            # finish cleanly after enough watchdog polls
+            return 0 if ticks["n"] > 20 else None
+
+        def send_signal(self, sig):
+            pass
+
+        def wait(self, timeout=None):
+            return 0
+
+    def token():
+        ticks["n"] += 1
+        return ticks["n"]  # changes every poll -> never stalls
+
+    monkeypatch.setattr(elastic, "_spawn", lambda *a, **k: Proc())
+    rc = elastic.supervise(
+        [], 1, max_restarts=0, poll_s=0.0, resume=False,
+        progress_token=token, stall_timeout_s=0.05,
+    )
+    assert rc == 0
+    assert spawned["n"] == 1  # one generation, zero restarts
