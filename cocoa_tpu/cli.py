@@ -92,7 +92,12 @@ def parse_args(argv: list[str]):
         elif field in _INT_FIELDS:
             setattr(cfg, field, int(val))
         elif field in _FLOAT_FIELDS:
-            setattr(cfg, field, float(val))
+            if field == "sigma" and val == "auto":
+                # σ′ auto-tuning: try the aggressive K·γ/2, fall back to
+                # the safe K·γ if the divergence guard fires (run_cocoa)
+                setattr(cfg, field, "auto")
+            else:
+                setattr(cfg, field, float(val))
         else:
             setattr(cfg, field, val)
     return cfg, extras
@@ -132,6 +137,14 @@ def main(argv=None) -> int:
     if cfg.math not in ("exact", "fast"):
         print(f"error: --math must be exact|fast, got {cfg.math!r}",
               file=sys.stderr)
+        return 2
+
+    if cfg.sigma == "auto" and not extras["gapTarget"]:
+        # fail at the CLI boundary with the standard message/exit-code —
+        # run_cocoa would raise the same requirement later as a traceback
+        print("error: --sigma=auto requires --gapTarget (the σ′ fallback "
+              "triggers on the divergence guard, which runs on the "
+              "gap-target path)", file=sys.stderr)
         return 2
 
     if extras["stallTimeout"] and not extras["elastic"]:
@@ -238,11 +251,13 @@ def main(argv=None) -> int:
     n = data.n
     k = cfg.num_splits
 
-    # mesh selection: K shards need a K-device dp mesh; anything else runs
-    # the single-chip vmap path (all K logical shards on one device).  An
+    # mesh selection: K shards ride a D-device dp mesh whenever D divides K
+    # (m = K/D logical shards multiplex per device — the Spark ``coalesce``
+    # analogue, OptUtils.scala:14); K=D is the 1:1 case, D=1 runs the
+    # single-chip vmap path (all K logical shards on one device).  An
     # explicit --mesh that can't be honored is an error; inferred sizes
-    # fall back silently.  --fp=F adds a feature axis: a (K, F) mesh over
-    # K*F devices, w and X columns split over fp.
+    # fall back silently.  --fp=F adds a feature axis: a (D, F) mesh over
+    # D*F devices, w and X columns split over fp.
     mesh = None
     try:
         fp = int(extras["fp"]) if extras["fp"] else 1
@@ -254,17 +269,25 @@ def main(argv=None) -> int:
         print(f"error: --fp must be >= 1, got {fp}", file=sys.stderr)
         return 2
     explicit = extras["mesh"] is not None
-    try:
-        mesh_size = int(extras["mesh"]) if explicit else min(k, len(jax.devices()) // fp)
-    except ValueError:
-        print(f"error: --mesh must be an integer, got {extras['mesh']!r}",
-              file=sys.stderr)
-        return 2
+    if explicit:
+        try:
+            mesh_size = int(extras["mesh"])
+        except ValueError:
+            print(f"error: --mesh must be an integer, got {extras['mesh']!r}",
+                  file=sys.stderr)
+            return 2
+    else:
+        # largest divisor of K that fits the device budget
+        mesh_size = max(
+            (d for d in range(1, min(k, len(jax.devices()) // fp) + 1)
+             if k % d == 0), default=1,
+        )
     if explicit and (mesh_size * fp > len(jax.devices())
-                     or (mesh_size > 1 and mesh_size != k)):
-        print(f"error: --mesh={mesh_size} (x fp={fp}) needs exactly "
-              f"numSplits={k} x fp devices (have {len(jax.devices())}); "
-              f"use --mesh=1 for the single-chip path", file=sys.stderr)
+                     or (mesh_size > 1 and k % mesh_size != 0)):
+        print(f"error: --mesh={mesh_size} (x fp={fp}) needs a divisor of "
+              f"numSplits={k} and mesh x fp devices (have "
+              f"{len(jax.devices())}); use --mesh=1 for the single-chip "
+              f"path", file=sys.stderr)
         return 2
     if fp > 1 and explicit and mesh_size == 1 and k > 1:
         print(f"error: --fp={fp} needs a device mesh and is incompatible "
@@ -273,11 +296,11 @@ def main(argv=None) -> int:
         return 2
     if fp > 1 and mesh_size != k:
         print(f"error: --fp={fp} requires a {k}x{fp}-device mesh "
-              f"(numSplits x fp; have {len(jax.devices())} devices)",
-              file=sys.stderr)
+              f"(numSplits x fp; shard multiplexing is dp-only; have "
+              f"{len(jax.devices())} devices)", file=sys.stderr)
         return 2
-    if mesh_size == k and (k > 1 or fp > 1):
-        mesh = make_mesh(k, fp=fp)
+    if mesh_size > 1 or fp > 1:
+        mesh = make_mesh(mesh_size, fp=fp)
 
     objective = (extras["objective"] or "svm").lower()
     if objective not in ("svm", "lasso"):

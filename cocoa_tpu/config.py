@@ -31,7 +31,10 @@ class Params:
                                 # by the other losses)
     sigma: Optional[float] = None  # σ′ subproblem-coupling override (extension;
                                 # None = the reference's safe bound K·γ,
-                                # CoCoA.scala:45).  K·γ assumes worst-case
+                                # CoCoA.scala:45; the string "auto" = try
+                                # the aggressive K·γ/2 and fall back to
+                                # K·γ when the divergence guard fires —
+                                # solvers/cocoa.run_cocoa).  K·γ assumes worst-case
                                 # cross-shard coherence; random shards
                                 # tolerate less — measured on the rcv1
                                 # config, σ′=K/2 HALVES the certified
@@ -101,8 +104,8 @@ class RunConfig:
     mesh_shape: Optional[tuple] = None  # (dp,) or (dp, fp); None = (num_splits,)
     loss: str = "hinge"
     smoothing: float = 1.0
-    sigma: float = 0.0           # σ′ override (0 = the safe K·γ default);
-                                 # see Params.sigma
+    sigma: object = 0.0          # σ′ override (0 = the safe K·γ default;
+                                 # a float, or "auto"); see Params.sigma
 
     def to_params(self, n: int, k: int) -> Params:
         """H = max(1, localIterFrac * n / K) as in hingeDriver.scala:70-71."""
@@ -116,7 +119,8 @@ class RunConfig:
             gamma=self.gamma,
             loss=self.loss,
             smoothing=self.smoothing,
-            sigma=(self.sigma if self.sigma > 0 else None),
+            sigma=("auto" if self.sigma == "auto"
+                   else self.sigma if self.sigma > 0 else None),
         )
 
     def to_debug(self, num_rounds: Optional[int] = None) -> DebugParams:

@@ -30,6 +30,23 @@ def _to_varying(x):
     return lax.pvary(x, DP_AXIS)  # older jax
 
 
+def shards_per_device(mesh: Optional[Mesh], k: int) -> int:
+    """m = logical shards per mesh position (Spark multiplexes K partitions
+    onto fewer executors via ``coalesce``, OptUtils.scala:14; the mesh
+    analogue stacks m = K/D shards per device and runs them under an inner
+    vmap/batched kernel inside the shard_map body).  1:1 when mesh is None
+    (the local path IS the all-shards-on-one-device case)."""
+    if mesh is None:
+        return 1
+    d = mesh.shape[DP_AXIS]
+    if k % d != 0:
+        raise ValueError(
+            f"{k} shards cannot multiplex evenly onto the {d}-device dp "
+            f"axis; K must be a multiple of the mesh size"
+        )
+    return k // d
+
+
 def fanout(
     per_shard: Callable,
     mesh: Optional[Mesh],
@@ -42,18 +59,34 @@ def fanout(
     output of ``per_shard`` is sum-reduced across shards (any shape — a Δw
     vector or a scalar partial sum); each aux output keeps its leading K dim
     (shard-local state, e.g. updated alpha).
+
+    K may be a multiple m·D of the dp mesh size D (shard multiplexing —
+    see :func:`shards_per_device`): each device then runs its m local
+    shards under an inner vmap, sums their contributions in-device, and
+    the cross-device combine stays ONE psum per call either way.
     """
     if mesh is not None:
+        k = jax.tree.leaves(sharded)[0].shape[0]
+        m = shards_per_device(mesh, k)
+
         def wrapped(w, *slices):
             # w arrives replicated (unvarying); the local solvers mix it into
             # shard-varying state, so cast it to device-varying up front to
             # keep loop-carry VMA types consistent.
             w = _to_varying(w)
-            slices = jax.tree.map(lambda a: a[0], slices)
-            out = per_shard(w, *slices)
+            if m == 1:
+                slices = jax.tree.map(lambda a: a[0], slices)
+                out = per_shard(w, *slices)
+                red, aux = out[0], out[1:]
+                return (lax.psum(red, DP_AXIS), *(a[None] for a in aux))
+            # multiplexed: the local (m, ...) block is the single-chip
+            # "m logical shards on one device" case — vmap it, sum the
+            # reduced outputs in-device, then the same single psum
+            out = jax.vmap(per_shard, in_axes=(None, *([0] * len(slices))))(
+                w, *slices
+            )
             red, aux = out[0], out[1:]
-            red_sum = lax.psum(red, DP_AXIS)
-            return (red_sum, *(a[None] for a in aux))
+            return (lax.psum(red.sum(axis=0), DP_AXIS), *aux)
 
         in_specs = (P(), *(jax.tree.map(lambda _: P(DP_AXIS), s) for s in sharded))
         # probe output structure abstractly to build out_specs: first output
@@ -121,24 +154,53 @@ def chunk_fanout(
         return P(None) if a.ndim == 1 else P(None, DP_AXIS)
 
     if mesh is not None:
+        # K from the static shard arrays — the carry can be empty (the
+        # mini-batch SGD chunk carries no per-shard state)
+        k = jax.tree.leaves((static_sharded, carry_sharded))[0].shape[0]
+        m = shards_per_device(mesh, k)
+
         def wrapped(w, carry, xs, static):
             w = _to_varying(w)
-            carry = jax.tree.map(lambda a: a[0], carry)
-            # (C, 1, ...) → (C, ...); (C,) scalar leaves pass through
-            xs = jax.tree.map(
-                lambda a: a if a.ndim == 1 else a[:, 0], xs
-            )
-            static = jax.tree.map(lambda a: a[0], static)
+            if m == 1:
+                carry = jax.tree.map(lambda a: a[0], carry)
+                # (C, 1, ...) → (C, ...); (C,) scalar leaves pass through
+                xs = jax.tree.map(
+                    lambda a: a if a.ndim == 1 else a[:, 0], xs
+                )
+                static = jax.tree.map(lambda a: a[0], static)
 
-            def body(c, x):
-                w, carry_k = c
-                dw, carry2 = per_round(w, carry_k, x, static)
-                w2 = apply_fn(w, lax.psum(dw, DP_AXIS), x)
-                return (w2, carry2), None
+                def body(c, x):
+                    w, carry_k = c
+                    dw, carry2 = per_round(w, carry_k, x, static)
+                    w2 = apply_fn(w, lax.psum(dw, DP_AXIS), x)
+                    return (w2, carry2), None
+            else:
+                # multiplexed (m shards per device): the local (m, ...)
+                # block runs exactly like the single-chip path — batched
+                # kernel or vmap — with the in-device shard sum folded
+                # into the same single psum per round
+                def body(c, x):
+                    w, carry_k = c
+                    if per_round_batched is not None:
+                        dw_local, carry2 = per_round_batched(
+                            w, carry_k, x, static
+                        )
+                    else:
+                        x_axes = jax.tree.map(
+                            lambda a: None if a.ndim == 0 else 0, x
+                        )
+                        dw, carry2 = jax.vmap(
+                            per_round, in_axes=(None, 0, x_axes, 0)
+                        )(w, carry_k, x, static)
+                        dw_local = dw.sum(axis=0)
+                    w2 = apply_fn(w, lax.psum(dw_local, DP_AXIS), x)
+                    return (w2, carry2), None
 
             (w, carry), _ = lax.scan(body, (w, carry), xs)
             w_inv = invariant_from_varying(w)
-            return w_inv, jax.tree.map(lambda a: a[None], carry)
+            if m == 1:
+                carry = jax.tree.map(lambda a: a[None], carry)
+            return w_inv, carry
 
         in_specs = (
             P(),

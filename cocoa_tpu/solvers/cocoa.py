@@ -70,9 +70,15 @@ def _alg_config(params: Params, k: int, plus: Optional[bool], mode=None):
     duality-gap certificate reports divergence if pushed too far
     (measured: σ′=K/2 halves rcv1's certified comm-rounds; anything below
     K/2 — already σ′=3.5 at K=8 — diverges visibly)."""
-    sig = k * params.gamma if params.sigma is None else float(params.sigma)
     if mode == "frozen":
+        # σ is unused by the frozen subproblem (MinibatchCD.scala:104 reads
+        # only the frozen w), so even sigma="auto" is fine to ignore here —
+        # the reference driver runs mini-batch CD from the same flag set
         return "frozen", params.beta / (k * params.local_iters), 1.0
+    if params.sigma == "auto":
+        raise ValueError("sigma='auto' is resolved by run_cocoa (it needs "
+                         "the retry loop); it cannot reach _alg_config")
+    sig = k * params.gamma if params.sigma is None else float(params.sigma)
     if plus:
         return "plus", params.gamma, sig
     return "cocoa", params.beta / k, sig
@@ -358,8 +364,12 @@ def run_sdca_family(
         w = jax.device_put(w, primal_sharding(mesh))
         alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
 
-    from cocoa_tpu.parallel.mesh import has_fp
+    from cocoa_tpu.parallel.mesh import DP_AXIS, has_fp
+    from cocoa_tpu.parallel.fanout import shards_per_device
 
+    # logical shards resident per device: k on the single-chip path, K/D on
+    # a (possibly multiplexed) dp mesh — the unit the VMEM fit checks see
+    m_local = shards_per_device(mesh, k) if mesh is not None else k
     platform = jax.devices()[0].platform
     if pallas is None and block_size > 0:
         # the block-coordinate kernel is an alternative inner loop — it and
@@ -388,7 +398,7 @@ def run_sdca_family(
             # sparse kernel: the SMEM feature-index table and the
             # lane-blocked d-vectors must fit (pallas_sparse docstring)
             fits = sparse_kernel_fits(
-                k, ds.n_shard, ds.num_features,
+                m_local, ds.n_shard, ds.num_features,
                 int(ds.sp_indices.shape[-1]), params.local_iters, itemsize,
             )
         pallas = (
@@ -436,9 +446,9 @@ def run_sdca_family(
             and platform in ("tpu", "axon")
             # the kernel assumes the full d per device
             and not has_fp(mesh)
-            # VMEM working set: one shard per device on the mesh path,
-            # all K logical shards in one instance on the single-chip path
-            and chain_fits(1 if mesh is not None else k, block_size, 4)
+            # VMEM working set: K/D shards per device on the mesh path
+            # (1 when 1:1), all K logical shards on the single-chip path
+            and chain_fits(m_local, block_size, 4)
         ):
             block_chain = "pallas"
     parts_kw = dict(
@@ -533,7 +543,54 @@ def run_cocoa(
     additive, scaling γ with σ′ = K·γ) — CoCoA.scala:22-66.  Train; returns
     (w, alpha, Trajectory).  See :func:`run_sdca_family` for the keyword
     options (mesh, rng, gap_target, scan_chunk, math, pallas, device_loop,
-    checkpoint/resume)."""
+    checkpoint/resume).
+
+    ``params.sigma="auto"`` (flag ``--sigma=auto``): first try the
+    aggressive σ′ = K·γ/2 — measured to HALVE the certified comm-rounds on
+    randomly partitioned data (benchmarks/SWEEPS.md) — and, if the
+    divergence guard fires (the best gap stalls for base.STALL_EVALS consecutive
+    evals), restart from scratch with the paper-safe σ′ = K·γ.  The cost
+    of a wrong guess is bounded by the guard, not the round budget."""
+    import dataclasses as _dc
+
+    if params.sigma == "auto":
+        if not plus:
+            # σ′ only enters the plus-mode subproblem (CoCoA.scala:158-160);
+            # plain CoCoA ignores it, so auto degenerates to the default —
+            # important because the reference driver runs BOTH algorithms
+            # from one flag set (hingeDriver.scala:84-89)
+            return run_cocoa(ds, _dc.replace(params, sigma=None), debug,
+                             plus, **kw)
+        if kw.get("gap_target") is None:
+            # the divergence guard rides the gap-target early-stop path; a
+            # fixed-round auto run could burn its whole budget diverged
+            # and never trigger the fallback
+            raise ValueError("--sigma=auto requires --gapTarget (the "
+                             "σ′ fallback triggers on the divergence "
+                             "guard, which runs on the gap-target path)")
+        quiet = kw.get("quiet", False)
+        import os as _os
+
+        ckpt_dir = debug.chkpt_dir if debug.chkpt_iter > 0 else ""
+        before = (set(_os.listdir(ckpt_dir))
+                  if ckpt_dir and _os.path.isdir(ckpt_dir) else set())
+        trial = _dc.replace(params, sigma=ds.k * params.gamma / 2.0)
+        w, alpha, traj = run_cocoa(ds, trial, debug, plus, **kw)
+        if traj.stopped != "diverged":
+            return w, alpha, traj
+        if ckpt_dir and _os.path.isdir(ckpt_dir):
+            # the diverged trial's checkpoints must not survive: the safe
+            # rerun restarts from round 1, and a later --resume would
+            # otherwise pick the trial's (higher-round, diverged) state
+            for f in sorted(set(_os.listdir(ckpt_dir)) - before):
+                if f.startswith("CoCoA"):
+                    _os.remove(_os.path.join(ckpt_dir, f))
+        if not quiet:
+            print(f"sigma=auto: σ′=K·γ/2={trial.sigma:g} diverged; "
+                  f"restarting with the safe σ′=K·γ={ds.k * params.gamma:g}")
+        safe = _dc.replace(params, sigma=None)
+        return run_cocoa(ds, safe, debug, plus, **kw)
+
     alg = _alg_config(params, ds.k, plus)
     return run_sdca_family(
         ds, params, debug, "CoCoA+" if plus else "CoCoA", alg, **kw

@@ -35,7 +35,21 @@ class Trajectory:
         self.algorithm = algorithm
         self.records: list[RoundRecord] = []
         self.quiet = quiet
+        # why the run ended: None = ran its full round budget;
+        # "target" = duality gap reached the gap_target early stop;
+        # "diverged" = the gap stopped improving for STALL_EVALS straight
+        # evals (the σ′-override guardrail — solvers/base.py)
+        self.stopped: Optional[str] = None
         self._t0 = time.perf_counter()
+
+    def mark_diverged(self, t: int, n_evals: int):
+        """Record (and report) a divergence/stall bail-out at round ``t``."""
+        self.stopped = "diverged"
+        if not self.quiet:
+            print(f"{self.algorithm}: DIVERGED — best duality gap made no "
+                  f"material progress over {n_evals} consecutive "
+                  f"evaluations; stopped at round {t} "
+                  f"(σ′ set below the safe K·γ bound? see --sigma)")
 
     def elapsed(self) -> float:
         return time.perf_counter() - self._t0
