@@ -1,0 +1,73 @@
+"""Measure the fp (feature-parallel) axis overhead on the virtual CPU mesh.
+
+fp is documented as a CAPACITY axis (fit d/F of w + the matching X column
+block per device when d forces it), not a speed axis: the sequential SDCA
+inner loop pays one fp-reduction per coordinate step (SURVEY.md §2.2;
+parallel/mesh.py module note).  This script puts a number on that claim —
+the only place an fp mesh exists in this environment is the virtual CPU
+backend (the attached TPU is one chip), so the measured RATIO between a
+(K,) dp mesh and a (K, 2) dp×fp mesh on identical work is the artifact,
+not the absolute times.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      JAX_PLATFORMS=cpu python benchmarks/fp_bench.py
+Writes a paragraph-ready line to stdout; recorded in benchmarks/SWEEPS.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data.synth import synth_dense
+    from cocoa_tpu.data.sharding import shard_dataset
+    from cocoa_tpu.parallel import make_mesh
+    from cocoa_tpu.solvers import run_cocoa
+
+    n, d, k = 8192, 2048, 4
+    data = synth_dense(n, d, seed=0)
+    debug = DebugParams(debug_iter=100, seed=0)
+    h = n // k // 10
+    rounds = 30
+
+    def ms_per_round(fp):
+        mesh = make_mesh(k, fp=fp)
+        ds = shard_dataset(data, k=k, layout="dense", dtype=jnp.float32,
+                           mesh=mesh)
+        p = Params(n=n, num_rounds=rounds, local_iters=h, lam=1e-3)
+        kw = dict(plus=True, quiet=True, math="fast", mesh=mesh,
+                  scan_chunk=10)
+        jax.block_until_ready(run_cocoa(ds, p, debug, **kw)[0])  # warm
+        t0 = time.perf_counter()
+        w, a, traj = run_cocoa(ds, p, debug, **kw)
+        jax.block_until_ready(w)   # async dispatch: sync before the clock
+        dt = (time.perf_counter() - t0) / rounds * 1e3
+        return dt, float(jnp.linalg.norm(w))
+
+    dp_ms, dp_norm = ms_per_round(1)
+    fp_ms, fp_norm = ms_per_round(2)
+    assert abs(dp_norm - fp_norm) < 1e-3 * max(1.0, dp_norm), \
+        (dp_norm, fp_norm)   # same math on both meshes
+    print(f"fp overhead (CPU mesh, n={n} d={d} K={k} H={h}, "
+          f"{rounds} rounds, fori fast path): "
+          f"dp(4)={dp_ms:.1f} ms/round vs dp4xfp2={fp_ms:.1f} ms/round "
+          f"-> {fp_ms / dp_ms:.2f}x per round ("
+          f"||w|| match {dp_norm:.6f})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,9 +69,17 @@ def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
     ``nnz`` is the mean nonzeros per example for the sparse layout (dense ⇒
     nnz = d).  ``path``:
 
-    - ``"fast"`` / ``"pallas"`` — margins decomposition: one whole-shard
-      X·w matvec (2·n·nnz) + per step one row·Δw dot and one axpy (4·nnz).
-      HBM: the margins pass reads all of X once; each step reads its row.
+    - ``"fast"`` — XLA margins decomposition: one whole-shard X·w matvec
+      (2·n·nnz) + per step one row·Δw dot and one axpy (4·nnz).  HBM: the
+      margins pass reads all of X once; each step reads its row.
+    - ``"pallas"`` — the round-4+ kernels compute margins IN-KERNEL from
+      the sampled row against the VMEM-resident w/Δw
+      (ops/pallas_sdca.py, ops/pallas_sparse.py) — there is NO whole-X
+      pass; per step one margin dot (2·nnz), one row·Δw/axpy pair
+      (4·nnz).  HBM: each step reads its sampled row, nothing else scales
+      with n.  (Before round 4 this path shared the "fast" formula, which
+      overcounted HBM by the retired full-X margins pass — the floors
+      read impossibly above the measured times.)
     - ``"block"`` — no whole-shard pass; per step one row·(w+σΔw) dot, one
       axpy, and the B·nnz Gram work that buys the MXU formulation
       (physical only).  HBM: each step reads its row once (margins and
@@ -83,17 +91,22 @@ def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
     row_bytes = (2 * itemsize if layout == "sparse" else itemsize) * nnz
     steps = k * h
     useful = 4.0 * nnz * steps          # CoCoA.scala:157-185: dot + axpy
-    if path in ("fast", "pallas"):
+    if path == "fast":
         margins = 2.0 * n * nnz
         physical = useful + margins
-        if path == "pallas" and layout == "sparse":
-            # the lane-blocked sparse kernel touches a 128-lane block per
-            # nonzero (ops/pallas_sparse.py) — physical VPU work is 128x
-            # the useful scalar work of each dot/axpy lane
-            physical = margins + 4.0 * nnz * steps * 128
         hbm = n * row_bytes + steps * row_bytes
         return dict(useful_flops=useful + margins, physical_flops=physical,
                     hbm_bytes=hbm)
+    if path == "pallas":
+        margins = 2.0 * nnz * steps     # in-kernel, from the sampled row
+        physical = useful + margins
+        if layout == "sparse":
+            # the lane-blocked sparse kernel touches a 128-lane block per
+            # nonzero (ops/pallas_sparse.py) — physical VPU work is 128x
+            # the useful scalar work of each dot/axpy lane
+            physical = (useful + margins) * 128
+        return dict(useful_flops=useful + margins, physical_flops=physical,
+                    hbm_bytes=steps * row_bytes)
     if path == "block":
         b = max(1, block)
         gram = 2.0 * b * nnz * steps    # B x B Gram per B steps: B·nnz/step

@@ -923,7 +923,13 @@ def write_results(results, perf_rows, out_dir, partial=False, final=False):
                 "mirror, so the real rcv1/epsilon files cannot be "
                 "fetched.  Real files dropped into benchmarks/data/ are "
                 "picked up automatically and validated against the "
-                "published (n, d, nnz/row) pins.\n\n")
+                "published (n, d, nnz/row) pins.  The fp "
+                "(feature-parallel) capacity axis has no row here — it "
+                "needs a multi-device mesh, and the attached TPU is one "
+                "chip; its measured CPU-mesh per-round overhead ratio "
+                "(one collective per coordinate step vs the dp path's "
+                "one per round) is recorded in benchmarks/SWEEPS.md "
+                "(benchmarks/fp_bench.py regenerates it).\n\n")
         f.write("| " + " | ".join(cols) + " |\n")
         f.write("|" + "---|" * len(cols) + "\n")
         for r in results:
