@@ -37,14 +37,15 @@ sys.path.insert(0, ROOT)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def measure(ds, params, k, *, c_lo=50, c_hi=200, reps=3, **kw):
+def measure(ds, params, k, *, c_lo=50, c_hi=200, reps=3, rng="reference",
+            **kw):
     import jax.numpy as jnp
 
     from cocoa_tpu.solvers.base import IndexSampler
     from cocoa_tpu.solvers.cocoa import _alg_config, make_chunk_step
 
     alg = _alg_config(params, k, True)
-    sampler = IndexSampler("reference", 0, params.local_iters, ds.counts)
+    sampler = IndexSampler(rng, 0, params.local_iters, ds.counts)
     i_lo = sampler.chunk_indices(1, c_lo)
     i_hi = sampler.chunk_indices(1, c_hi)
     sa = ds.shard_arrays()
@@ -115,6 +116,14 @@ def main():
         add("epsilon", f"block-{b}", eps, p_eps, k, layout="dense",
             nnz=None, path="block", block=b, pallas=False,
             block_chain="pallas")
+    # round 5: the distinctness-licensed glue elimination (permuted
+    # sampling, one α scatter + one merged (y,q,α₀) gather per round —
+    # docs/DESIGN.md §3b-iii).  Same math; the index stream differs from
+    # the reference-rng rows above, but the kernels are value- and
+    # index-independent in time, so the per-round comparison holds.
+    add("epsilon", "block-128-distinct", eps, p_eps, k, layout="dense",
+        nnz=None, path="block", block=128, pallas=False,
+        block_chain="pallas", rng="permuted", block_distinct=True)
 
     n2, d2 = 20242, 47236
     data = synth_sparse(n2, d2, nnz_mean=75, seed=0)

@@ -318,6 +318,7 @@ def local_sdca_block_batched(
     smoothing: float = 1.0,
     block: int = 128,
     interpret: bool = False,
+    distinct: bool = False,
 ):
     """All-K-shards block-coordinate round on one chip — the TPU-native
     shape of :func:`local_sdca_block`, and the ``--blockSize`` hot path.
@@ -349,6 +350,21 @@ def local_sdca_block_batched(
     ``w = (1/λn)·Σyαx`` the gap certificate rests on stays tight over
     thousands of accumulated blocks.  Returns (delta_alpha (K, n_shard),
     delta_w (K, d)).
+
+    ``distinct=True`` asserts the round's H indices are pairwise distinct
+    within every shard (the caller's obligation — true for permuted
+    sampling whenever n_local % H == 0, because each round then sits
+    inside one epoch's permutation).  That license removes the hottest
+    XLA glue around the fused kernel (measured round 5: the per-block α
+    scatter was 23% of device time, more than half the kernel itself):
+    the α₀ gather hoists to ONE (K, H) gather per round, the per-block
+    scatters collapse to ONE batched scatter-add after the scan, and the
+    scan carry drops α entirely.  Bit-identical to the per-block path
+    under the distinctness precondition: no earlier block of the same
+    round can have touched a later block's coordinates, so every chain
+    reads exactly the values it would have read, and each coordinate
+    receives exactly one add.  Fused path only (the split fallback keeps
+    the per-block scatter).
     """
     from cocoa_tpu.ops.pallas_chain import (
         chain_block_batched, fused_block, fused_fits,
@@ -395,31 +411,69 @@ def local_sdca_block_batched(
         flat = idxs_b.transpose(1, 0, 2).reshape(k, nb * block)
         per_block = lambda v: gat(v, flat) \
             .reshape(k, nb, block).transpose(1, 0, 2)  # noqa: E731
-        yb_all = per_block(labels)
-        qb_all = per_block(sq_norms) * qf
         idxf_all = idxs_b.astype(dtype)
         live_all = jnp.broadcast_to(
             mask_b[:, None, :].astype(dtype), (nb, k, block))
+        dw0 = jnp.zeros((k, d), dtype) + 0.0 * w[None]
 
-        def block_step(carry, inp):
-            dw, a_vec = carry            # (K, d), (K, n_shard)
-            bidx, yb, qb, idxf, live = inp
+        def fused_call(dw, bidx, yb, qb, idxf, live, a0b):
             xb = gather_rows(bidx)
             if mode == "frozen":
                 v = jnp.broadcast_to(w[None], (k, d)).astype(dtype)
             else:
                 v = w[None] + sig_c * dw
-            delta, dwu = fused_block(
-                xb, idxf, yb, qb, gat(a_vec, bidx), live, v,
+            return fused_block(
+                xb, idxf, yb, qb, a0b, live, v,
                 lam_n=float(lam * n),
                 coef_div=float(coef_divisor(mode, lam * n)),
                 sig_eff=float(sig_eff), frozen=(mode == "frozen"),
                 loss=loss, smoothing=smoothing, interpret=interpret,
             )
+
+        if distinct:
+            # pairwise-distinct indices (caller-checked): α₀ for every
+            # block comes from ONE hoisted gather, the per-step deltas
+            # ride out as scan outputs, and α takes ONE batched
+            # scatter-add per round — the per-block α gather/scatter
+            # (the hottest glue in the round-5 trace) vanishes.
+            # The y/q/α₀ gathers also merge into ONE width-3 row gather:
+            # TPU scalar gathers pay per index fetched, and three (K, H)
+            # fetches from the same index vector are pure waste.  The
+            # (K, ns, 3) stack costs one streaming write per round
+            # (~2 µs at epsilon scale) against a saved ~0.6 ms of gather.
+            yqa = jnp.stack([labels, sq_norms * qf, alpha], axis=-1)
+            yqa_all = jnp.take_along_axis(
+                yqa, flat[:, :, None], axis=1
+            ).reshape(k, nb, block, 3).transpose(1, 0, 2, 3)   # (nb,K,B,3)
+            yb_all = yqa_all[..., 0]
+            qb_all = yqa_all[..., 1]
+            a0_all = yqa_all[..., 2]
+
+            def block_step(dw, inp):
+                bidx, yb, qb, idxf, live, a0b = inp
+                delta, dwu = fused_call(dw, bidx, yb, qb, idxf, live, a0b)
+                return dw + dwu, delta
+
+            dw, deltas = lax.scan(
+                block_step, dw0,
+                (idxs_b, yb_all, qb_all, idxf_all, live_all, a0_all),
+            )                                     # deltas: (nb, K, B)
+            delta_flat = deltas.transpose(1, 0, 2).reshape(k, nb * block)
+            alpha_final = alpha.at[
+                jnp.arange(k)[:, None], flat].add(delta_flat)
+            return alpha_final - alpha, dw
+
+        yb_all = per_block(labels)
+        qb_all = per_block(sq_norms) * qf
+
+        def block_step(carry, inp):
+            dw, a_vec = carry            # (K, d), (K, n_shard)
+            bidx, yb, qb, idxf, live = inp
+            delta, dwu = fused_call(dw, bidx, yb, qb, idxf, live,
+                                    gat(a_vec, bidx))
             a_vec = a_vec.at[jnp.arange(k)[:, None], bidx].add(delta)
             return (dw + dwu, a_vec), None
 
-        dw0 = jnp.zeros((k, d), dtype) + 0.0 * w[None]
         (dw, alpha_final), _ = lax.scan(
             block_step, (dw0, alpha),
             (idxs_b, yb_all, qb_all, idxf_all, live_all),

@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cocoa_tpu.config import DebugParams, Params
 from cocoa_tpu.data.sharding import ShardedDataset
@@ -95,6 +96,7 @@ def _sdca_round_parts(
     pallas_interpret: bool = False,
     block: int = 0,
     block_chain: str = "xla",
+    block_distinct: bool = False,
 ):
     """The per-shard local update and driver-side apply shared by the
     per-round and chunked builders (so the two paths cannot diverge), for
@@ -150,6 +152,7 @@ def _sdca_round_parts(
             w, alpha, shards, idxs_kh, params.lam, params.n, mode=mode,
             sigma=sigma, loss=params.loss, smoothing=params.smoothing,
             block=block, interpret=(block_chain == "pallas_interpret"),
+            distinct=block_distinct,
         )
 
     def per_shard(w, alpha_k, idxs_k, shard_k):
@@ -455,6 +458,15 @@ def run_sdca_family(
         math=math, pallas=pallas,
         pallas_interpret=(pallas and platform == "cpu"),
         block=block_size, block_chain=block_chain,
+        # permuted sampling with n_local % H == 0 keeps every round inside
+        # one epoch's permutation, so the round's H draws are pairwise
+        # distinct per shard — the license for the block kernel's
+        # one-scatter-per-round α update (local_sdca_block_batched)
+        block_distinct=(
+            block_size > 0
+            and rng == "permuted"
+            and bool(np.all(np.asarray(ds.counts) % params.local_iters == 0))
+        ),
     )
     # the Pallas kernels (sequential and block-chain) own the shard axis
     # themselves, which neither the per-round driver's vmap path nor its

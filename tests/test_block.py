@@ -482,3 +482,74 @@ def test_fused_block_kernel_generic_losses(tiny_data, loss, smoothing):
                                    rtol=2e-4, atol=1e-6)
         np.testing.assert_allclose(np.asarray(dw_b[s]), np.asarray(dw_f),
                                    rtol=2e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
+                                        ("frozen", 1.0)])
+@pytest.mark.parametrize("h", [20, 200])
+def test_batched_chain_distinct_matches_per_block(tiny_data, mode, sigma, h):
+    """``distinct=True`` (the permuted-mode one-scatter-per-round α update
+    — round 5's glue elimination) must be BIT-identical to the per-block
+    path when the round's indices really are pairwise distinct per shard:
+    the hoisted α₀ gather reads values no earlier block of the round could
+    have touched, and each coordinate receives exactly one add.  h=20 is
+    the single-block case (masked tail); h=200 > B=128 spans TWO blocks —
+    the only case where the distinct path's cross-block structure (hoisted
+    α₀ for block 2, deltas-as-scan-outputs ordering, the single post-scan
+    scatter) differs from the per-block path at all."""
+    from cocoa_tpu.data.synth import synth_dense
+    from cocoa_tpu.ops.local_sdca import local_sdca_block_batched
+
+    k = 2
+    if h > 20:
+        # cross-block coverage needs shards with >= h rows (distinct draws)
+        data = synth_dense(640, 32, seed=3)
+    else:
+        data = tiny_data
+    ds = shard_dataset(data, k=k, layout="dense", dtype=jnp.float64)
+    sa = ds.shard_arrays()
+    rng = np.random.default_rng(11)
+    d = data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(k, ds.n_shard)) * 0.3 + 0.3, 0, 1)
+    )
+    # pairwise-distinct draws: a fresh permutation prefix per shard
+    idxs = jnp.asarray(np.stack([
+        rng.permutation(int(c))[:h] for c in ds.counts
+    ]).astype(np.int32))
+    kw = dict(mode=mode, sigma=sigma, block=128, interpret=True)
+    da_p, dw_p = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, data.n, **kw)
+    da_d, dw_d = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, data.n, distinct=True, **kw)
+    np.testing.assert_array_equal(np.asarray(da_d), np.asarray(da_p))
+    np.testing.assert_array_equal(np.asarray(dw_d), np.asarray(dw_p))
+
+
+def test_block_distinct_through_driver_permuted(tiny_data):
+    """End-to-end: the driver auto-enables the distinct α update for
+    permuted sampling when n_local % H == 0, and the trajectory matches
+    the same run with reference sampling semantics of the per-block path
+    — compared against the NON-distinct (H chosen so counts % H != 0)
+    permuted run's own path selection, both certified by the exact gap."""
+    from cocoa_tpu.solvers import run_cocoa
+
+    ds = shard_dataset(tiny_data, k=4, layout="dense", dtype=jnp.float64)
+    # counts = 24 per shard; H=8 divides -> distinct ON; H=7 -> OFF
+    for h in (8, 7):
+        p = Params(n=tiny_data.n, num_rounds=6, local_iters=h, lam=0.01)
+        w_b, a_b, _ = run_cocoa(ds, p, DebugParams(debug_iter=3, seed=0),
+                                plus=True, quiet=True, math="fast",
+                                rng="permuted", block_size=128,
+                                block_chain="pallas_interpret",
+                                scan_chunk=2)
+        # the fast path (no blocks) is the ground truth for the same
+        # permuted index stream
+        w_f, a_f, _ = run_cocoa(ds, p, DebugParams(debug_iter=3, seed=0),
+                                plus=True, quiet=True, math="fast",
+                                rng="permuted", scan_chunk=2)
+        np.testing.assert_allclose(np.asarray(w_b), np.asarray(w_f),
+                                   rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(a_b), np.asarray(a_f),
+                                   rtol=1e-9, atol=1e-12)
