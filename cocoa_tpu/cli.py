@@ -13,18 +13,28 @@ multi-controller runtime via ``jax.distributed.initialize`` (with
 TPU-native additions (no reference analogue): ``--dtype``, ``--layout``,
 ``--rng`` (reference | jax | permuted — permuted is random reshuffling,
 ~5x fewer comm-rounds to the same certified gap at epsilon scale; see
-solvers/base.IndexSampler), ``--mesh`` (dp size; defaults to
-min(numSplits, device count);
+solvers/base.IndexSampler), ``--mesh`` (dp size; defaults to the largest
+divisor of numSplits that fits the device count — K shards multiplex
+m = K/D per device when D < K, the Spark coalesce analogue;
 ``--mesh=1`` forces the single-chip vmap path), ``--trajOut`` (JSONL
-trajectory dump), ``--gapTarget`` (early stop on duality gap), ``--math``
-(exact | fast: margins-decomposition inner loop with auto-Pallas on TPU,
-CoCoA/CoCoA+ only), ``--deviceLoop`` (whole train loop as one on-device
-while_loop; incompatible with checkpointing), ``--loss``
-(hinge | smooth_hinge | logistic — all solvers and the duality-gap
-certificate generalize; see ops/losses.py), ``--smoothing`` (the
-smooth_hinge parameter s), and ``--blockSize`` (block-coordinate MXU inner
-loop for the SDCA family — same index stream and math as --math=fast via
-cached block Gram matrices; see ops/local_sdca.local_sdca_block).
+trajectory dump), ``--gapTarget`` (early stop on duality gap — with a
+divergence guard: the run bails out and reports DIVERGED when the best
+gap stalls for 12 straight evals, see solvers/base.STALL_EVALS),
+``--math`` (exact | fast: margins-decomposition inner loop with
+auto-Pallas on TPU, CoCoA/CoCoA+ only), ``--deviceLoop`` (whole train
+loop as one on-device while_loop; incompatible with checkpointing),
+``--loss`` (hinge | smooth_hinge | logistic — all solvers and the
+duality-gap certificate generalize; see ops/losses.py), ``--smoothing``
+(the smooth_hinge parameter s), ``--blockSize`` (block-coordinate MXU
+inner loop for the SDCA family — same index stream and math as
+--math=fast via cached block Gram matrices; see
+ops/local_sdca.local_sdca_block), ``--sigma`` (σ′ override — below the
+safe K·γ it buys comm-rounds on randomly partitioned data; ``auto``
+tries K·γ/2 and falls back to K·γ when the divergence guard fires,
+needs --gapTarget), ``--elastic=N`` (gang supervisor: N worker
+processes, restart-from-checkpoint on any death), and
+``--stallTimeout=S`` (with --elastic: also restart a gang that stops
+making checkpoint progress for S seconds without any process dying).
 
 ``--objective=lasso`` switches to the ProxCoCoA+ L1 family
 (solvers/prox_cocoa.py): labels become the regression target b,
