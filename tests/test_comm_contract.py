@@ -74,15 +74,22 @@ def test_sdca_chunk_round_has_exactly_one_psum(tiny_data, math, alg_key):
 
 @pytest.mark.parametrize("chain", ["xla", "pallas_interpret"])
 @pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32])
-def test_block_chunk_round_has_exactly_one_psum(tiny_data, chain, dtype):
+@pytest.mark.parametrize("distinct", [False, True])
+def test_block_chunk_round_has_exactly_one_psum(tiny_data, chain, dtype,
+                                                distinct):
     """The block-coordinate inner loop (--blockSize) must not change the
     census: its gathers, Gram einsums, Pallas chain, and additive alpha
     scatter are all shard-local — still ONE Δw psum per round.  The f32
     parametrization lowers the FUSED per-block kernel (fused_fits needs
-    itemsize 4); f64 lowers the legacy split path."""
+    itemsize 4); f64 lowers the legacy split path.  ``distinct`` adds the
+    round-5 one-scatter-per-round α update (merged (y,q,α₀) gather) —
+    shard-local too, same census."""
     from cocoa_tpu.ops.pallas_chain import fused_fits
     from cocoa_tpu.solvers.cocoa import _alg_config, _make_chunk_kernel
 
+    if distinct and not (chain == "pallas_interpret"
+                         and dtype == jnp.float32):
+        pytest.skip("distinct lives on the fused (f32 pallas) path only")
     mesh = make_mesh(K)
     ds, w, alpha = _mesh_state(tiny_data, mesh, dtype=dtype)
     p = _params(tiny_data)
@@ -92,7 +99,30 @@ def test_block_chunk_round_has_exactly_one_psum(tiny_data, chain, dtype):
         assert fused_fits(1, block, tiny_data.num_features, 4), \
             "f32 config must exercise the fused kernel"
     kernel = _make_chunk_kernel(mesh, p, K, alg, math="fast",
-                                block=block, block_chain=chain)
+                                block=block, block_chain=chain,
+                                block_distinct=distinct)
+    idxs = jnp.zeros((C, K, H), dtype=jnp.int32)
+    txt = jax.jit(kernel).lower(w, alpha, idxs, ds.shard_arrays()).as_text()
+    assert _census(txt) == {"all_reduce": 2}, _census(txt)
+
+
+def test_multiplexed_mesh_same_census(tiny_data):
+    """Shard multiplexing (K = m·D logical shards on a D-device mesh,
+    round 5) must not change the communication contract: the m local
+    shards combine IN-DEVICE and the cross-device combine stays the one
+    Δw psum per round."""
+    from cocoa_tpu.solvers.cocoa import _alg_config, _make_chunk_kernel
+
+    mesh = make_mesh(2)        # K=4 shards on 2 devices -> m=2
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64,
+                       mesh=mesh)
+    w = jax.device_put(jnp.zeros(tiny_data.num_features, jnp.float64),
+                       primal_sharding(mesh))
+    alpha = jax.device_put(jnp.zeros((K, ds.n_shard), jnp.float64),
+                           sharded_rows(mesh, extra_dims=1))
+    p = _params(tiny_data)
+    kernel = _make_chunk_kernel(mesh, p, K, _alg_config(p, K, True),
+                                math="fast")
     idxs = jnp.zeros((C, K, H), dtype=jnp.int32)
     txt = jax.jit(kernel).lower(w, alpha, idxs, ds.shard_arrays()).as_text()
     assert _census(txt) == {"all_reduce": 2}, _census(txt)
