@@ -62,7 +62,7 @@ _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize",
-                "elastic", "stallTimeout")  # run-level
+                "elastic", "stallTimeout", "evalDense")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -318,15 +318,24 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    # same bare-flag/boolean convention as --deviceLoop: present (or any
+    # value except "false") enables it
+    eval_dense = (extras["evalDense"] is not None
+                  and str(extras["evalDense"]).lower() != "false")
     try:
         ds = test_ds = None
         if objective == "svm":
+            # --evalDense: dense eval twin for sparse layouts — the
+            # duality-gap certificate's full margins pass as one MXU
+            # matvec instead of an every-nonzero w-gather (31% of the
+            # rcv1 production round); costs K*n_shard*d*itemsize HBM
             ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype,
-                               mesh=mesh)
+                               mesh=mesh, eval_dense=eval_dense)
             if cfg.test_file:
                 test_data = load_libsvm(cfg.test_file, cfg.num_features)
                 test_ds = shard_dataset(test_data, k=k, layout=cfg.layout,
-                                        dtype=dtype, mesh=mesh)
+                                        dtype=dtype, mesh=mesh,
+                                        eval_dense=eval_dense)
     except (OSError, ValueError) as e:  # e.g. --layout=sparse with --fp>1
         print(f"error: {e}", file=sys.stderr)
         return 2

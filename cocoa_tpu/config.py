@@ -104,7 +104,7 @@ class RunConfig:
     mesh_shape: Optional[tuple] = None  # (dp,) or (dp, fp); None = (num_splits,)
     loss: str = "hinge"
     smoothing: float = 1.0
-    sigma: object = 0.0          # σ′ override (0 = the safe K·γ default;
+    sigma: "float | str" = 0.0   # σ′ override (0 = the safe K·γ default;
                                  # a float, or "auto"); see Params.sigma
 
     def to_params(self, n: int, k: int) -> Params:

@@ -26,14 +26,14 @@ import jax.numpy as jnp
 
 from cocoa_tpu.data.sharding import ShardedDataset
 from cocoa_tpu.ops import losses
-from cocoa_tpu.ops.rows import shard_margins
+from cocoa_tpu.ops.rows import eval_margins
 from cocoa_tpu.parallel.fanout import fanout, mesh_of
 
 
 @functools.lru_cache(maxsize=None)
 def _loss_sum_fn(mesh, loss, smoothing):
     def per_shard(w, shard):
-        vals = losses.primal(loss, shard["labels"] * shard_margins(w, shard),
+        vals = losses.primal(loss, shard["labels"] * eval_margins(w, shard),
                              smoothing=smoothing)
         return (jnp.sum(vals * shard["mask"]),)
 
@@ -62,7 +62,7 @@ def _dual_sum_fn(mesh, loss, smoothing):
 @functools.lru_cache(maxsize=None)
 def _error_sum_fn(mesh):
     def per_shard(w, shard):
-        correct = (shard_margins(w, shard) * shard["labels"]) > 0.0
+        correct = (eval_margins(w, shard) * shard["labels"]) > 0.0
         return (jnp.sum(jnp.where(correct, 0.0, 1.0) * shard["mask"]),)
 
     @jax.jit
@@ -93,7 +93,7 @@ def eval_metrics(
     if alpha is not None:
 
         def per_shard(w, alpha_k, shard):
-            margins = shard_margins(w, shard)
+            margins = eval_margins(w, shard)
             vals = losses.primal(loss, shard["labels"] * margins,
                                  smoothing=smoothing)
             dual_vals = losses.dual_term(loss, alpha_k, smoothing=smoothing)
@@ -108,7 +108,7 @@ def eval_metrics(
     else:
 
         def per_shard(w, shard):
-            margins = shard_margins(w, shard)
+            margins = eval_margins(w, shard)
             vals = losses.primal(loss, shard["labels"] * margins,
                                  smoothing=smoothing)
             return (jnp.sum(vals * shard["mask"]),)
@@ -120,7 +120,7 @@ def eval_metrics(
     if test_shard_arrays is not None:
 
         def per_test_shard(w, shard):
-            wrong = (shard_margins(w, shard) * shard["labels"]) <= 0.0
+            wrong = (eval_margins(w, shard) * shard["labels"]) <= 0.0
             return (jnp.sum(jnp.where(wrong, 1.0, 0.0) * shard["mask"]),)
 
         (errors,) = fanout(per_test_shard, mesh, w, test_shard_arrays)

@@ -7,11 +7,13 @@
 # certified 1e-4 gap instead of a fixed round budget.  Index tables are
 # generated in-jit on the device (--sampling=auto).  Append --blockSize=128
 # on large dense problems (H >= a few hundred) for the fused block-
-# coordinate MXU kernel (2.3x faster epsilon rounds, benchmarks/KERNELS.md),
-# and consider --sigma=<K/2> on randomly-partitioned data: the reference's
-# sigma'=K aggregation bound is worst-case, and K/2 halved the certified
-# comm-rounds on the rcv1 config (divergence, if pushed further, is
-# reported exactly by the gap certificate; benchmarks/SWEEPS.md).
+# coordinate MXU kernel (1.36x faster epsilon rounds than the sequential
+# kernel with the round-5 distinct path, benchmarks/KERNELS.md), and
+# --sigma=auto on randomly-partitioned data: the reference's sigma'=K
+# aggregation bound is worst-case — auto tries K/2 (which HALVED the
+# certified comm-rounds on the rcv1 config) and falls back to the safe K
+# if the divergence guard fires, so a wrong guess costs ~12 evals, not
+# the round budget (benchmarks/SWEEPS.md).
 cd "$(dirname "$0")"
 exec python -m cocoa_tpu.cli \
   --trainFile=data/small_train.dat \
