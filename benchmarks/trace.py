@@ -29,9 +29,15 @@ sys.path.insert(0, ROOT)
 
 def capture(tag, run_fn, out_root):
     """Run ``run_fn`` under the profiler; return (trace_dir, events)."""
+    import shutil
+
     import jax
 
     tdir = os.path.join(out_root, tag)
+    # start clean: the profiler appends new session dirs, and parse_trace
+    # globs recursively — stale captures would silently mix into the
+    # aggregation (observed: a re-capture summed two generations of ops)
+    shutil.rmtree(tdir, ignore_errors=True)
     os.makedirs(tdir, exist_ok=True)
     jax.profiler.start_trace(tdir)
     try:
@@ -105,9 +111,9 @@ def main():
     out_root = os.environ.get("COCOA_TRACE_DIR", "/tmp/cocoa_traces")
     sections = []
 
-    def chunked_runner(ds, params, k, n_rounds, **kw):
+    def chunked_runner(ds, params, k, n_rounds, rng="reference", **kw):
         alg = _alg_config(params, k, True)
-        sampler = IndexSampler("reference", 0, params.local_iters,
+        sampler = IndexSampler(rng, 0, params.local_iters,
                                ds.counts, device=True)
         step = make_chunk_step(None, params, k, alg, sampler=sampler,
                                math="fast", **kw)
@@ -131,13 +137,16 @@ def main():
     n, d, k = 400_000, 2000, 8
     eps = synth_dense_sharded(n, d, k, seed=0)
     p_eps = Params(n=n, num_rounds=400, local_iters=n // k // 10, lam=1e-3)
-    run_eps = chunked_runner(eps, p_eps, k, 20, pallas=False, block=128,
-                             block_chain="pallas")
+    # the shipped flagship mode: permuted sampling licenses the distinct
+    # one-scatter-per-round fused path (docs/DESIGN.md §3b-iii)
+    run_eps = chunked_runner(eps, p_eps, k, 20, rng="permuted",
+                             pallas=False, block=128,
+                             block_chain="pallas", block_distinct=True)
     t0 = time.perf_counter()
     tdir = capture("epsilon_block128", run_eps, out_root)
     wall = time.perf_counter() - t0
-    sections.append(("epsilon block128 (20 rounds, fused kernel)",
-                     parse_trace(tdir), wall, 20))
+    sections.append(("epsilon block128 (20 rounds, fused kernel, "
+                     "permuted/distinct)", parse_trace(tdir), wall, 20))
 
     # rcv1 grouped sparse round
     n2, d2 = 20242, 47236
