@@ -28,7 +28,7 @@ sys.path.insert(0, ROOT)
 
 
 def capture(tag, run_fn, out_root):
-    """Run ``run_fn`` under the profiler; return (trace_dir, events)."""
+    """Run ``run_fn`` under the profiler; return the capture directory."""
     import shutil
 
     import jax
@@ -36,8 +36,10 @@ def capture(tag, run_fn, out_root):
     tdir = os.path.join(out_root, tag)
     # start clean: the profiler appends new session dirs, and parse_trace
     # globs recursively — stale captures would silently mix into the
-    # aggregation (observed: a re-capture summed two generations of ops)
-    shutil.rmtree(tdir, ignore_errors=True)
+    # aggregation (observed: a re-capture summed two generations of ops).
+    # A rmtree failure must be LOUD for the same reason.
+    if os.path.exists(tdir):
+        shutil.rmtree(tdir)
     os.makedirs(tdir, exist_ok=True)
     jax.profiler.start_trace(tdir)
     try:
@@ -99,6 +101,7 @@ def main():
     import time
 
     import jax.numpy as jnp
+    import numpy as np
 
     from cocoa_tpu.config import Params
     from cocoa_tpu.data.sharding import shard_dataset
@@ -138,7 +141,11 @@ def main():
     eps = synth_dense_sharded(n, d, k, seed=0)
     p_eps = Params(n=n, num_rounds=400, local_iters=n // k // 10, lam=1e-3)
     # the shipped flagship mode: permuted sampling licenses the distinct
-    # one-scatter-per-round fused path (docs/DESIGN.md §3b-iii)
+    # one-scatter-per-round fused path (docs/DESIGN.md §3b-iii) — the
+    # license the production gate (run_sdca_family) checks, asserted here
+    # so a config edit cannot silently profile an unsound path
+    assert np.all(np.asarray(eps.counts) % p_eps.local_iters == 0), \
+        "distinct fused path needs counts % H == 0 (one epoch per round)"
     run_eps = chunked_runner(eps, p_eps, k, 20, rng="permuted",
                              pallas=False, block=128,
                              block_chain="pallas", block_distinct=True)
