@@ -163,7 +163,10 @@ def _chain_kernel_batched(scal_ref, gq_ref, delta_ref, coef_ref, *,
 DEFAULT_UNROLL = 8    # swept on v5e through the real chunked driver
                       # (epsilon fused config, B=128): 8 → 3.4-3.8
                       # ms/round, 32 → 4.3; a synthetic harness preferred
-                      # 32, the production index stream prefers 8
+                      # 32, the production index stream prefers 8.
+                      # Re-swept round 5 on the distinct path: 4 → 3.47,
+                      # 8 → 3.21, 16 → 3.18, 32 → 3.52 — 8 and 16 tie
+                      # within tunnel noise; 8 stays
 
 
 @functools.partial(
