@@ -36,6 +36,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from cocoa_tpu.utils import compile_cache
+
+compile_cache.enable()   # persistent XLA cache: regen compiles once, ever
+
 
 def measure(ds, params, k, *, c_lo=50, c_hi=200, reps=3, rng="reference",
             **kw):

@@ -58,6 +58,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 sys.path.insert(0, os.path.join(ROOT, "tests"))
 
+from cocoa_tpu.utils import compile_cache
+
+compile_cache.enable()   # persistent XLA cache: regen compiles once, ever
+
 DEMO_TRAIN = "/root/reference/data/small_train.dat"
 DEMO_TEST = "/root/reference/data/small_test.dat"
 DEMO_D = 9947

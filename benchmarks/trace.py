@@ -26,6 +26,10 @@ from collections import defaultdict
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from cocoa_tpu.utils import compile_cache
+
+compile_cache.enable()   # persistent XLA cache: regen compiles once, ever
+
 
 def capture(tag, run_fn, out_root):
     """Run ``run_fn`` under the profiler; return the capture directory."""

@@ -122,6 +122,12 @@ def main(argv=None) -> int:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    # persistent XLA compilation cache: repeat CLI runs of the same config
+    # skip the 20-60 s first compile (COCOA_NO_COMPILE_CACHE=1 opts out)
+    from cocoa_tpu.utils import compile_cache
+
+    compile_cache.enable()
+
     argv = sys.argv[1:] if argv is None else argv
     cfg, extras = parse_args(argv)
 
