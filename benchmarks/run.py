@@ -975,18 +975,27 @@ def write_results(results, perf_rows, out_dir, partial=False, final=False):
             bounds = [r.get("bound", "?") for r in perf_rows]
             n_lat = sum(1 for b in bounds if b == "latency")
             n_hbm = sum(1 for b in bounds if b == "HBM")
+            n_mxu = sum(1 for b in bounds if b == "MXU")
             if n_lat == len(bounds):
                 verdict = ("Every config is latency-bound: the measured "
                            "round time sits far above both the HBM-traffic "
                            "floor and the FLOP floor")
-            elif n_hbm:
-                verdict = (f"{n_hbm} of {len(bounds)} configs now run at "
-                           "their HBM-traffic floor (the fused kernels "
-                           "retired the chain latency there); the rest "
-                           "remain latency-bound")
             else:
-                verdict = (f"Bound classification is mixed "
-                           f"({', '.join(sorted(set(bounds)))})")
+                # enumerate the actual mix — a fixed two-way phrasing
+                # mislabeled MXU-bound rows as latency-bound (round-5
+                # review finding)
+                parts = []
+                if n_hbm:
+                    parts.append(f"{n_hbm} at the HBM-traffic floor")
+                if n_mxu:
+                    parts.append(f"{n_mxu} MXU-bound")
+                if n_lat:
+                    parts.append(f"{n_lat} latency-bound")
+                other = len(bounds) - n_hbm - n_mxu - n_lat
+                if other:
+                    parts.append(f"{other} unclassified")
+                verdict = (f"Of {len(bounds)} configs: "
+                           + ", ".join(parts))
             f.write(
                 f"\n{verdict}.  Where latency binds, the cause is the "
                 "algorithm's hot loop — a sequential chain of O(nnz) "

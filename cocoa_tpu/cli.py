@@ -19,7 +19,8 @@ m = K/D per device when D < K, the Spark coalesce analogue;
 ``--mesh=1`` forces the single-chip vmap path), ``--trajOut`` (JSONL
 trajectory dump), ``--gapTarget`` (early stop on duality gap — with a
 divergence guard: the run bails out and reports DIVERGED when the best
-gap stalls for 12 straight evals, see solvers/base.STALL_EVALS),
+gap stalls across a ~300-round window, at least 12 evals; see
+solvers/base.stall_window),
 ``--math`` (exact | fast: margins-decomposition inner loop with
 auto-Pallas on TPU, CoCoA/CoCoA+ only), ``--deviceLoop`` (whole train
 loop as one on-device while_loop; incompatible with checkpointing),
@@ -225,6 +226,18 @@ def main(argv=None) -> int:
                 print("error: --stallTimeout watches checkpoint progress "
                       "— it needs --chkptDir", file=sys.stderr)
                 return 2
+            if stall < 120:
+                # the watchdog cannot tell "compiling" from "wedged": a
+                # generation's first token change needs first-compile
+                # (20-60 s through a tunneled device, see
+                # utils/compile_cache.py) PLUS chkptIter rounds — a tight
+                # timeout SIGKILLs healthy gangs until the restart budget
+                # burns (round-5 review finding)
+                print(f"warning: --stallTimeout={stall:g}s is shorter than "
+                      f"a typical first-compile + first-checkpoint budget; "
+                      f"healthy gangs may be killed as stalled — consider "
+                      f">= 120s (and a --chkptIter the gang can reach "
+                      f"within the timeout)", file=sys.stderr)
 
         return elastic.supervise(
             elastic.strip_elastic_flags(argv), n_workers,

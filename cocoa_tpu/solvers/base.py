@@ -46,9 +46,22 @@ from cocoa_tpu.utils.prng import sample_indices_per_shard
 # (even the slow λ=1e-4 rcv1 tail improves ~6%/eval ⇒ ~50% per 10 evals),
 # while an oscillating run's best barely moves.  Bail out when the best
 # gap has not improved to ≤ STALL_REL × (best at the last reset) within
-# STALL_EVALS evaluations.
+# the stall window.
+#
+# The window is denominated in ROUNDS, not evaluations: per-eval progress
+# scales with the eval cadence (at --debugIter=1 a healthy run improves
+# ~1/25th as much per eval as at the calibration cadence 25), so a fixed
+# eval count would make the guard ~25x stricter at fine cadences and
+# kill slow-but-converging runs (round-5 review finding).  STALL_EVALS
+# is the floor so coarse cadences still get a meaningful window.
 STALL_EVALS = 12
+STALL_ROUNDS = 300     # = STALL_EVALS at the calibration cadence 25
 STALL_REL = 0.75
+
+
+def stall_window(debug_iter: int) -> int:
+    """The no-improvement window in EVALS for this eval cadence."""
+    return max(STALL_EVALS, -(-STALL_ROUNDS // max(1, int(debug_iter))))
 
 
 class _GapWatch:
@@ -96,7 +109,7 @@ def drive(
     Returns (state, Trajectory).
     """
     traj = Trajectory(name, quiet=quiet)
-    watch = _GapWatch()
+    watch = _GapWatch(n_evals=stall_window(debug.debug_iter))
     for t in range(start_round, params.num_rounds + 1):
         state = round_fn(t, state)
 
@@ -140,7 +153,7 @@ def drive_chunked(
     if chunk <= 0:
         raise ValueError(f"chunk must be positive, got {chunk}")
     traj = Trajectory(name, quiet=quiet)
-    watch = _GapWatch()
+    watch = _GapWatch(n_evals=stall_window(debug.debug_iter))
     t = start_round
     total = params.num_rounds
     ckpt_on = bool(debug.chkpt_dir) and debug.chkpt_iter > 0
@@ -240,7 +253,7 @@ class _Prefetch:
 
 
 def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
-                      mesh=None):
+                      mesh=None, stall_evals=STALL_EVALS):
     import functools
 
     import jax.numpy as jnp
@@ -282,7 +295,7 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                 improved = best <= STALL_REL * best_prev
                 stall = jnp.where(improved, jnp.int32(0), stall + 1)
                 best_prev = jnp.where(improved, best, best_prev)
-                done = done | (stall >= STALL_EVALS)
+                done = done | (stall >= stall_evals)
             return (i + jnp.int32(1), done, stall, best, best_prev, state,
                     traj)
 
@@ -319,6 +332,7 @@ def drive_on_device(
     start_round: int = 1,
     cache_key=None,
     mesh=None,
+    stall_evals: int = STALL_EVALS,
 ):
     """Fully device-resident outer driver: the ENTIRE run — every round,
     every ``debugIter`` evaluation, and the gap-target early-stop test — is
@@ -354,7 +368,8 @@ def drive_on_device(
     run = _DEVICE_RUNS.get(cache_key) if cache_key is not None else None
     if run is None:
         run = _build_device_run(
-            chunk_kernel, eval_kernel, tgt, n_state, mesh=mesh
+            chunk_kernel, eval_kernel, tgt, n_state, mesh=mesh,
+            stall_evals=stall_evals,
         )
         if cache_key is not None:
             _DEVICE_RUNS[cache_key] = run
@@ -421,7 +436,8 @@ def drive_device_full(
         )
     c = debug.debug_iter
     traj = Trajectory(name, quiet=quiet)
-    watch = _GapWatch()   # spans super-block boundaries (see block loop)
+    watch = _GapWatch(n_evals=stall_window(debug.debug_iter))
+    # ^ spans super-block boundaries (see block loop)
     # Device-loop checkpointing (reference anchor CoCoA.scala:59-62: the
     # production path checkpoints): state is host-reachable at every
     # super-block boundary (each drive_on_device return is the block's one
@@ -519,7 +535,7 @@ def drive_device_full(
                 name, state, chunk_kernel, eval_kernel, idxs_all,
                 shard_arrays, test_arrays, quiet=quiet,
                 gap_target=gap_target, start_round=start,
-                cache_key=cache_key, mesh=mesh,
+                cache_key=cache_key, mesh=mesh, stall_evals=watch.n,
             )
             traj.records.extend(dev_traj.records)
             if dev_traj.records:
@@ -551,7 +567,7 @@ def drive_device_full(
                 watch.update(r.gap) for r in dev_traj.records
             )
             if gap_target is not None and diverged:
-                traj.mark_diverged(done, STALL_EVALS)
+                traj.mark_diverged(done, watch.n)
                 break
         t = done + 1
 

@@ -367,7 +367,7 @@ def run_sdca_family(
         w = jax.device_put(w, primal_sharding(mesh))
         alpha = jax.device_put(alpha, sharded_rows(mesh, extra_dims=1))
 
-    from cocoa_tpu.parallel.mesh import DP_AXIS, has_fp
+    from cocoa_tpu.parallel.mesh import has_fp
     from cocoa_tpu.parallel.fanout import shards_per_device
 
     # logical shards resident per device: k on the single-chip path, K/D on
@@ -581,6 +581,18 @@ def run_cocoa(
                              "σ′ fallback triggers on the divergence "
                              "guard, which runs on the gap-target path)")
         quiet = kw.get("quiet", False)
+        if kw.get("w_init") is not None or kw.get("start_round", 1) > 1:
+            # a RESUMED run must not re-experiment: the restored state may
+            # be mid-trial (possibly diverging), and a trial verdict from
+            # it is meaningless.  Continue with the safe σ′ — any (w, α)
+            # is a valid primal-dual pair, so the safe run converges from
+            # the restored state and the certificate stays exact.
+            if not quiet:
+                print("sigma=auto: resumed run continues with the safe "
+                      f"σ′=K·γ={ds.k * params.gamma:g} (no re-trial from "
+                      "restored state)")
+            return run_cocoa(ds, _dc.replace(params, sigma=None), debug,
+                             plus, **kw)
         import os as _os
 
         ckpt_dir = debug.chkpt_dir if debug.chkpt_iter > 0 else ""
@@ -601,7 +613,12 @@ def run_cocoa(
             print(f"sigma=auto: σ′=K·γ/2={trial.sigma:g} diverged; "
                   f"restarting with the safe σ′=K·γ={ds.k * params.gamma:g}")
         safe = _dc.replace(params, sigma=None)
-        return run_cocoa(ds, safe, debug, plus, **kw)
+        # from SCRATCH: strip any resume state so the safe run cannot
+        # inherit the diverged trial's iterates (belt to the resumed-run
+        # guard's suspenders above)
+        safe_kw = {k2: v for k2, v in kw.items()
+                   if k2 not in ("w_init", "alpha_init", "start_round")}
+        return run_cocoa(ds, safe, debug, plus, **safe_kw)
 
     alg = _alg_config(params, ds.k, plus)
     return run_sdca_family(

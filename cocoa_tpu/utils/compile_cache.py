@@ -33,5 +33,5 @@ def enable(cache_dir: str | None = None) -> str | None:
     # cache everything: the suite's executables are exactly the small-once
     # big-often mix the default thresholds would skip
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     return cache_dir

@@ -165,3 +165,44 @@ def test_sigma_auto_validation():
     w_none, _, _ = run_cocoa(ds, dataclasses.replace(params, sigma=None),
                              debug, plus=False, quiet=True)
     np.testing.assert_array_equal(np.asarray(w_auto), np.asarray(w_none))
+
+
+def test_stall_window_scales_with_cadence():
+    """The guard window is denominated in ROUNDS: fine eval cadences get
+    proportionally more evals, so slow-but-steady convergence (~2%/eval
+    at cadence 1) is not mislabeled DIVERGED (round-5 review)."""
+    assert base.stall_window(25) == base.STALL_EVALS
+    assert base.stall_window(1) == base.STALL_ROUNDS
+    assert base.stall_window(10) == base.STALL_ROUNDS // 10
+    assert base.stall_window(1000) == base.STALL_EVALS  # floor
+    # a healthy 2%-per-eval run at cadence 1 survives its 300-eval window
+    w = base._GapWatch(n_evals=base.stall_window(1))
+    g = 1.0
+    for _ in range(600):
+        assert not w.update(g)
+        g *= 0.98
+
+
+def test_sigma_auto_resumed_run_skips_trial(capsys):
+    """A resumed run (w_init/start_round restored) must not re-trial: auto
+    degrades to the safe σ′ immediately, so mid-trial state can never leak
+    into a 'fresh' safe run (round-5 review)."""
+    import dataclasses
+
+    ds, n = _coherent_dataset(k=K)
+    params = Params(n=n, num_rounds=60, local_iters=16, lam=LAM,
+                    sigma="auto")
+    debug = DebugParams(debug_iter=4, seed=0)
+    rng = np.random.default_rng(0)
+    w0 = jnp.asarray(rng.normal(size=16) * 0.01, jnp.float32)
+    w_auto, _, traj = run_cocoa(ds, params, debug, plus=True, quiet=False,
+                                math="fast", gap_target=1e-3, rng="jax",
+                                w_init=w0, start_round=5)
+    out = capsys.readouterr().out
+    assert "resumed run continues with the safe" in out
+    # identical to an explicit safe resume
+    safe = dataclasses.replace(params, sigma=None)
+    w_safe, _, _ = run_cocoa(ds, safe, debug, plus=True, quiet=True,
+                             math="fast", gap_target=1e-3, rng="jax",
+                             w_init=w0, start_round=5)
+    np.testing.assert_array_equal(np.asarray(w_auto), np.asarray(w_safe))
