@@ -141,16 +141,28 @@ def _perf(tag, secs, rounds, *, n, d, k, h, layout="dense", nnz=None,
     )
 
 
-def _round_rate(run_round, rounds):
+def _round_rate(run_round, rounds, reps=3):
     """rounds/sec of ``run_round(t)`` (t 1-based), with round 1 executed
     as an UNTIMED warm-up: the first NumPy round pays allocation/BLAS
     warm-up and a 2-3 round window would otherwise overstate vs_oracle
-    ~3x vs the pinned bench.py rate."""
+    ~3x vs the pinned bench.py rate.
+
+    BEST of ``reps`` windows: single-thread NumPy timing swings ~2x with
+    concurrent host load (observed across same-day regens: identical
+    rcv1 configs read 13.2x and 9.3x vs_oracle purely from oracle-rate
+    noise), and the best window is the least-contended — i.e. the
+    fairest — estimate of the oracle's true speed."""
     run_round(1)
-    t0 = time.perf_counter()
-    for t in range(2, rounds + 2):
-        run_round(t)
-    return rounds / (time.perf_counter() - t0)
+    t = 2
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            run_round(t)
+            t += 1
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    return rounds / best
 
 
 def _oracle_rounds_per_s_csr(data, lam, h, k, n, rounds=2, mode="plus"):
