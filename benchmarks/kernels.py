@@ -57,7 +57,7 @@ def measure(ds, params, k, *, c_lo=50, c_hi=200, reps=3, rng="reference",
         from cocoa_tpu.ops.pallas_sdca import fold_rows
 
         sa = {**sa, "X_folded": fold_rows(sa["X"])}
-    if kw.get("pallas") and ds.layout == "sparse":
+    if (kw.get("pallas") or kw.get("block")) and ds.layout == "sparse":
         from cocoa_tpu.ops.pallas_sparse import row_lengths
 
         sa = {**sa, "sp_row_len": row_lengths(sa["sp_values"])}
@@ -97,13 +97,14 @@ def main():
     rows = []
 
     def add(config, kernel, ds, params, k, *, layout, nnz, path, block=0,
-            **kw):
+            max_nnz=None, **kw):
         if block:
             kw["block"] = block   # the parts-layer kwarg drives the kernel
         secs = measure(ds, params, k, **kw)
         model = perf.sdca_round_model(params.n, ds.num_features, k,
                                       params.local_iters, layout=layout,
-                                      nnz=nnz, path=path, block=block)
+                                      nnz=nnz, path=path, block=block,
+                                      max_nnz=max_nnz)
         row = perf.account(f"{config}/{kernel}", secs, model,
                            steps=k * params.local_iters)
         rows.append(row)
@@ -140,7 +141,16 @@ def main():
     add("rcv1", "pallas-seq", rc, p_rc, k, layout="sparse", nnz=nnz,
         path="pallas", pallas=True)
     add("rcv1", "block-128", rc, p_rc, k, layout="sparse", nnz=nnz,
-        path="block", block=128, pallas=False, block_chain="pallas")
+        path="block", block=128, pallas=False, block_chain="pallas",
+        block_sparse_gram=False)
+    # the sparse block-chain kernel: in-kernel (B, B) Gram from the SMEM
+    # CSR streams + sparse Δw scatter (ops/pallas_sparse) feeding the same
+    # lockstep chain — no (K, B, d) densify (block-128 above keeps the
+    # densified path for the A/B)
+    add("rcv1", "sparse-block", rc, p_rc, k, layout="sparse", nnz=nnz,
+        path="sparse-block", block=128, pallas=False, block_chain="pallas",
+        block_sparse_gram=True,
+        max_nnz=int(rc.sp_indices.shape[-1]))
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "KERNELS.md")
@@ -180,10 +190,22 @@ def main():
                 f"runs the epsilon round in {blk} ms vs the sequential "
                 f"Pallas kernel's {seq} ms — **{seq / blk:.2f}x** — with "
                 f"{stream}, same math (trajectory parity pinned by "
-                f"tests/test_block.py).  On rcv1's sparse layout the "
-                f"sequential kernel stays ahead (block tiles densify to "
-                f"(B, d) there), so `--blockSize` is the right default "
-                f"only for dense problems.\n"
+                f"tests/test_block.py).\n"
+            )
+        rseq = eps_rows.get("rcv1/pallas-seq")
+        rdense = eps_rows.get("rcv1/block-128")
+        rsp = eps_rows.get("rcv1/sparse-block")
+        if rseq and rsp:
+            f.write(
+                f"\nOn rcv1's sparse layout the densified block path "
+                f"(`block-128`: {rdense} ms) loses to the sequential "
+                f"kernel ({rseq} ms); the sparse block-chain kernel "
+                f"(`sparse-block`: {rsp} ms — in-kernel Gram from the "
+                f"SMEM CSR streams, no (B, d) densify, "
+                f"ops/pallas_sparse.py) is the sparse `--blockSize` "
+                f"path: {rdense / rsp:.2f}x over the densified blocks, "
+                f"{rseq / rsp:.2f}x vs sequential.  `--blockSize=auto` "
+                f"picks the right kernel per layout.\n"
             )
     print(f"wrote {out}")
     return 0

@@ -62,7 +62,7 @@ def device_info():
 
 
 def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
-                     block=0, itemsize=4):
+                     block=0, itemsize=4, max_nnz=None):
     """FLOP and HBM-byte model of ONE outer round of the SDCA family.
 
     Returns a dict with ``useful_flops``, ``physical_flops``, ``hbm_bytes``.
@@ -84,6 +84,13 @@ def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
       axpy, and the B·nnz Gram work that buys the MXU formulation
       (physical only).  HBM: each step reads its row once (margins and
       Gram both come from the same gathered tile).
+    - ``"sparse-block"`` — the in-kernel CSR Gram block path
+      (ops/pallas_sparse.sparse_block_gram): same useful work as ``block``
+      but NO densified tile — HBM moves only the CSR streams (re-prefetched
+      once per SMEM segment pair, sized from ``max_nnz``) and the
+      lane-blocked [w|Δw] operand per tile call; the Gram merge/scatter ops
+      each touch a 128-lane block (physical, like the sparse sequential
+      kernel).
     - ``"exact"`` — like fast but the margin dot reads w directly (same
       counts; no margins pass, the x·w dot replaces the x·Δw dot).
     """
@@ -118,6 +125,29 @@ def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
                               else row_bytes)
         return dict(useful_flops=useful + margins, physical_flops=physical,
                     hbm_bytes=tile_bytes)
+    if path == "sparse-block":
+        from cocoa_tpu.ops.pallas_sparse import seg_rows
+
+        b = max(1, block)
+        gram = 2.0 * b * nnz * steps    # B·nnz merge MACs per step
+        margins = 2.0 * nnz * steps     # in-kernel x·(w+σΔw) from [w|Δw]
+        # every SMEM-addressed pick/scatter is a (1, 128) masked lane-row
+        # op — same 128x physical factor as the sparse sequential kernel
+        physical = (useful + margins + gram) * 128
+        s = seg_rows(b, int(max_nnz if max_nnz is not None else nnz)) or b
+        ns = b // s
+        pairs = ns * (ns + 1) // 2
+        d_pad = -(-d // 128) * 128
+        blocks = steps / b              # shard-blocks per round (all K)
+        # CSR streams cross SMEM once per segment pair they appear in
+        # (~(ns+1)/2 pairs each), plus the lane-blocked [w|Δw] operand per
+        # tile call: read-only for each Gram pair, read+write for each
+        # apply segment
+        wd_bytes = 2 * d_pad * itemsize
+        hbm = (steps * row_bytes * (pairs + ns) / ns
+               + blocks * (pairs * wd_bytes + ns * 2 * wd_bytes))
+        return dict(useful_flops=useful + margins, physical_flops=physical,
+                    hbm_bytes=hbm)
     if path == "exact":
         return dict(useful_flops=useful, physical_flops=useful,
                     hbm_bytes=steps * row_bytes)

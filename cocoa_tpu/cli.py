@@ -29,7 +29,15 @@ duality-gap certificate generalize; see ops/losses.py), ``--smoothing``
 (the smooth_hinge parameter s), ``--blockSize`` (block-coordinate MXU
 inner loop for the SDCA family — same index stream and math as
 --math=fast via cached block Gram matrices; see
-ops/local_sdca.local_sdca_block), ``--sigma`` (σ′ override — below the
+ops/local_sdca.local_sdca_block; ``auto`` picks the measured-best block
+size per data layout — sparse layouts whose densified tile cannot ride
+the fused kernel use the in-kernel CSR Gram path of ops/pallas_sparse
+when it fits, and keep the sequential kernel otherwise, since
+SPLIT-path densified sparse blocks lose to it),
+``--divergenceGuard=auto|on|off`` (the
+gap-target stall watch; auto arms it only when σ′ is overridden below
+the safe K·γ bound — see solvers/base.resolve_divergence_guard),
+``--sigma`` (σ′ override — below the
 safe K·γ it buys comm-rounds on randomly partitioned data; ``auto``
 tries K·γ/2 and falls back to K·γ when the divergence guard fires,
 needs --gapTarget), ``--elastic=N`` (gang supervisor: N worker
@@ -63,6 +71,7 @@ _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize",
+                "divergenceGuard",
                 "elastic", "stallTimeout", "evalDense")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
@@ -70,6 +79,20 @@ _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
                "debug_iter", "seed"}
 _FLOAT_FIELDS = {"lam", "local_iter_frac", "beta", "gamma", "smoothing",
                  "sigma"}
+
+
+def _resolve_auto_block(ds_active, mesh, k: int, dtype) -> int:
+    """``--blockSize=auto`` against the ACTIVE dataset (rows for svm,
+    columns for lasso): the measured-best B per layout, or 0 to keep the
+    sequential kernels (solvers/cocoa.auto_block_size)."""
+    from cocoa_tpu.parallel.fanout import shards_per_device
+    from cocoa_tpu.solvers.cocoa import auto_block_size
+
+    m_local = shards_per_device(mesh, k) if mesh is not None else k
+    bs = auto_block_size(ds_active, m_local, dtype)
+    print(f"blockSize=auto: using {bs or 'the sequential path'} for the "
+          f"{ds_active.layout} layout")
+    return bs
 
 
 def parse_args(argv: list[str]):
@@ -328,6 +351,14 @@ def main(argv=None) -> int:
               f"(numSplits x fp; shard multiplexing is dp-only; have "
               f"{len(jax.devices())} devices)", file=sys.stderr)
         return 2
+    if not explicit and mesh_size * fp < len(jax.devices()):
+        # inferred mesh leaves devices idle (prime/coprime K falls to the
+        # largest divisor, worst case 1 — all shards on one chip).  A perf
+        # cliff the user can fix by aligning K, so say so.
+        print(f"note: inferred mesh uses {mesh_size} of "
+              f"{len(jax.devices())} devices (largest divisor of "
+              f"numSplits={k} that fits); a numSplits divisible by "
+              f"{len(jax.devices())} would use every device")
     if mesh_size > 1 or fp > 1:
         mesh = make_mesh(mesh_size, fp=fp)
 
@@ -394,19 +425,38 @@ def main(argv=None) -> int:
     if resume and not cfg.chkpt_dir:
         print("error: --resume requires --chkptDir", file=sys.stderr)
         return 2
-    try:
-        block_size = int(extras["blockSize"]) if extras["blockSize"] else 0
-    except ValueError:
-        print(f"error: --blockSize must be an integer, got "
-              f"{extras['blockSize']!r}", file=sys.stderr)
-        return 2
+    block_auto = (extras["blockSize"] or "").lower() == "auto"
+    block_size = 0
+    if extras["blockSize"] and not block_auto:
+        try:
+            block_size = int(extras["blockSize"])
+        except ValueError:
+            print(f"error: --blockSize must be an integer or 'auto', got "
+                  f"{extras['blockSize']!r}", file=sys.stderr)
+            return 2
     if block_size < 0:
         print(f"error: --blockSize must be >= 0, got {block_size}",
               file=sys.stderr)
         return 2
-    if block_size and cfg.math != "fast":
+    if (block_size or block_auto) and cfg.math != "fast":
         print("error: --blockSize requires --math=fast (the block kernel is "
               "a margins-decomposition variant)", file=sys.stderr)
+        return 2
+    if ds is not None and block_auto:
+        # dense always blocks; sparse blocks only when the in-kernel CSR
+        # Gram path fits (a densified sparse block LOSES to the sequential
+        # sparse kernel, benchmarks/KERNELS.md)
+        block_size = _resolve_auto_block(ds, mesh, k, dtype)
+
+    guard = (extras["divergenceGuard"] or "auto").lower()
+    if guard not in ("auto", "on", "off"):
+        print(f"error: --divergenceGuard must be auto|on|off, got "
+              f"{extras['divergenceGuard']!r}", file=sys.stderr)
+        return 2
+    if cfg.sigma == "auto" and guard == "off":
+        # the σ′ trial's only exit from a bad guess IS the guard
+        print("error: --sigma=auto requires the divergence guard; drop "
+              "--divergenceGuard=off", file=sys.stderr)
         return 2
 
     if objective == "lasso":
@@ -440,6 +490,8 @@ def main(argv=None) -> int:
         except ValueError as e:  # e.g. sparse columns + fp mesh
             print(f"error: {e}", file=sys.stderr)
             return 2
+        if block_auto:
+            block_size = _resolve_auto_block(ds_c, mesh, k, dtype)
         d = data.num_features
         # same H = max(1, localIterFrac·n/K) law, over coordinates
         lasso_params = dataclasses.replace(
@@ -461,7 +513,7 @@ def main(argv=None) -> int:
             sampling=cfg.sampling,
             gap_target=gap_target, scan_chunk=cfg.scan_chunk,
             math=cfg.math, device_loop=cfg.device_loop,
-            block_size=block_size, **resume_kw,
+            block_size=block_size, divergence_guard=guard, **resume_kw,
         )
         from cocoa_tpu.solvers.prox_cocoa import _metrics_fn
 
@@ -512,7 +564,7 @@ def main(argv=None) -> int:
 
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
                     math=cfg.math, device_loop=cfg.device_loop,
-                    block_size=block_size)
+                    block_size=block_size, divergence_guard=guard)
 
     def run_all():
         w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
@@ -528,6 +580,7 @@ def main(argv=None) -> int:
                            device_loop=cfg.device_loop)
             w, alpha, traj = run_minibatch_cd(
                 ds, params, debug, math=cfg.math, block_size=block_size,
+                divergence_guard=guard,
                 **loop_kw, **restore("Mini-batch CD"), **common)
             finish(traj, w, alpha)
 

@@ -322,6 +322,24 @@ def shard_dataset(
         mesh is not None
         and jax.process_count() > 1
         and not mesh_lib.has_fp(mesh)
+        and mesh.devices.size != k
+    ):
+        # a multiplexed dp mesh (D < K) would otherwise fall through to the
+        # single-process replicated builder: every process materializes the
+        # full (K, n_shard, d) dataset host-side and device_puts across
+        # non-addressable devices — a version-dependent crash or a
+        # per-process memory blow-up, never what was asked for (ADVICE r5;
+        # mirrors the explicit eval_dense rejection below)
+        raise ValueError(
+            f"multi-process runs need a dp mesh with exactly "
+            f"numSplits={k} positions, got {mesh.devices.size}; shard "
+            f"multiplexing (D < K) is single-process only — use "
+            f"numSplits == device count, or run single-process"
+        )
+    if (
+        mesh is not None
+        and jax.process_count() > 1
+        and not mesh_lib.has_fp(mesh)
         and mesh.devices.size == k
     ):
         if eval_dense:

@@ -319,6 +319,7 @@ def local_sdca_block_batched(
     block: int = 128,
     interpret: bool = False,
     distinct: bool = False,
+    sparse_gram: "bool | None" = None,
 ):
     """All-K-shards block-coordinate round on one chip — the TPU-native
     shape of :func:`local_sdca_block`, and the ``--blockSize`` hot path.
@@ -365,10 +366,24 @@ def local_sdca_block_batched(
     reads exactly the values it would have read, and each coordinate
     receives exactly one add.  Fused path only (the split fallback keeps
     the per-block scatter).
+
+    ``sparse_gram`` selects the SPARSE block-chain path (padded-CSR
+    layouts only): the (B, B) block Gram and the margin base are computed
+    IN-KERNEL from SMEM-scalar-prefetched CSR streams and the Δw apply is
+    a sparse scatter (ops/pallas_sparse.sparse_block_gram/_apply) — no
+    (K, B, d) densify.  ``None`` (auto) picks it for sparse layouts the
+    fused kernel cannot hold (the rcv1 regime, where the densified tile is
+    ~650x the rows' nonzero bytes) whenever the CSR streams fit the SMEM
+    segmentation (sparse_chain_fits); ``True`` forces it (tests),
+    ``False`` disables.  Same math as the split path — the chain kernel
+    consumes the identical (scal, gq) contract — so trajectory parity
+    carries over; the α update stays per-block (``distinct`` is a fused-
+    path-only license).
     """
     from cocoa_tpu.ops.pallas_chain import (
         chain_block_batched, fused_block, fused_fits,
     )
+    from cocoa_tpu.ops.pallas_sparse import sparse_chain_fits
 
     losses.validate(loss, smoothing)
     sig_eff, qii_factor = mode_factors(mode, sigma)
@@ -403,7 +418,83 @@ def local_sdca_block_batched(
 
     gat = lambda v, bidx: jnp.take_along_axis(v, bidx, axis=1)  # noqa: E731
 
-    if fused_fits(k, block, d, jnp.dtype(dtype).itemsize,
+    itemsize = jnp.dtype(dtype).itemsize
+    if sparse_gram is None:
+        # auto: the sparse Gram path is the sparse-layout block default
+        # whenever the fused kernel cannot hold the densified tile (the
+        # rcv1 regime) and the CSR streams fit the SMEM segmentation
+        sparse_gram = (
+            "sp_indices" in shards
+            and itemsize == 4
+            and not fused_fits(k, block, d, itemsize, alpha.shape[1])
+            and sparse_chain_fits(k, alpha.shape[1], d,
+                                  int(shards["sp_indices"].shape[-1]),
+                                  block, itemsize)
+        )
+    if sparse_gram:
+        from cocoa_tpu.ops.pallas_sparse import (
+            GROUP, row_lengths, sparse_block_apply, sparse_block_gram,
+            wd_delta, wd_stack,
+        )
+
+        if "sp_indices" not in shards:
+            raise ValueError("sparse_gram=True requires the padded-CSR "
+                             "(sparse) layout")
+        sp_idx, sp_val = shards["sp_indices"], shards["sp_values"]
+        w_nnz = sp_idx.shape[-1]
+        group = min(GROUP, max(1, w_nnz))
+        w_r = -(-w_nnz // group) * group
+        row_len = shards.get("sp_row_len")
+        if row_len is None:
+            row_len = row_lengths(sp_val)
+        frozen = mode == "frozen"
+        wd0 = wd_stack(w, k)
+
+        def sparse_block_step(carry, inp):
+            wd, a_vec = carry            # (K, d/128, 2·128), (K, n_shard)
+            bidx, bmask = inp            # (K, B), (B,)
+            gidx = jnp.take_along_axis(sp_idx, bidx[:, :, None], axis=1)
+            gvals = jnp.take_along_axis(sp_val, bidx[:, :, None], axis=1) \
+                .astype(dtype)
+            if w_r != w_nnz:
+                # pad the slot axis to the GROUP-rounded width the trip
+                # counts assume (zero slots are inert)
+                pad3 = ((0, 0), (0, 0), (0, w_r - w_nnz))
+                gidx = jnp.pad(gidx, pad3)
+                gvals = jnp.pad(gvals, pad3)
+            cnts = jnp.where(bmask[None, :],
+                             jnp.take_along_axis(row_len, bidx, axis=1),
+                             jnp.int32(-1))
+            gram, mbase = sparse_block_gram(
+                wd, gidx, gvals, cnts, sig_eff=sig_eff, frozen=frozen,
+                interpret=interpret,
+            )
+            eq_t = (bidx.T[:, :, None] == bidx[None, :, :]).astype(dtype)
+            gq = eq_t if frozen else jnp.concatenate([gram, eq_t], axis=1)
+            scal = jnp.stack([
+                mbase, gat(labels, bidx), gat(sq_norms, bidx) * qf,
+                gat(a_vec, bidx),
+                jnp.zeros_like(mbase),  # within-block Δw margin is in gram
+                jnp.broadcast_to(bmask[None].astype(dtype), (k, block)),
+            ], axis=1)                                    # (K, 6, B)
+            delta, coefs = chain_block_batched(
+                scal, gq,
+                lam_n=float(lam * n),
+                coef_div=float(coef_divisor(mode, lam * n)),
+                sig_eff=float(sig_eff), frozen=frozen,
+                loss=loss, smoothing=smoothing, interpret=interpret,
+            )
+            a_vec = a_vec.at[jnp.arange(k)[:, None], bidx].add(delta)
+            wd = sparse_block_apply(wd, gidx, gvals, cnts, coefs,
+                                    interpret=interpret)
+            return (wd, a_vec), None
+
+        (wd, alpha_final), _ = lax.scan(
+            sparse_block_step, (wd0, alpha), (idxs_b, mask_b)
+        )
+        return alpha_final - alpha, wd_delta(wd, d)
+
+    if fused_fits(k, block, d, itemsize,
                   alpha.shape[1]):
         # idx-only per-draw vectors hoist out of the block scan (they are
         # tiny — (nb, K, B) — unlike the row tiles, whose hoisting was

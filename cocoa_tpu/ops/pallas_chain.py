@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from cocoa_tpu.ops import losses
+from cocoa_tpu.ops.pallas_sdca import COMPILER_PARAMS
 
 LANES = 128
 SCAL_ROWS = 6  # [margins0 | labels | qii | alpha0 | mb | live-mask]
@@ -490,7 +491,7 @@ def fused_block(
             pltpu.VMEM((b, k, b), xb.dtype),    # eq, j-leading
             pltpu.VMEM((k, b), xb.dtype),       # margins
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -64,6 +64,12 @@ fails Mosaic compilation, so rounds split into SMEM-sized segments with
 the concatenated state carried between them); ``sparse_kernel_fits``
 checks the VMEM working set.  Oversized configs keep the XLA fori_loop
 path.
+
+This module also carries the SPARSE BLOCK-CHAIN kernels (round 6):
+``sparse_block_gram`` / ``sparse_block_apply`` compute the ``--blockSize``
+path's (B, B) block Gram, margin base, and rank-B Δw apply from the same
+SMEM-prefetched CSR layout — no (B, d) densify — feeding the lockstep
+chain recurrence of ops/pallas_chain.py (see the section comment below).
 """
 
 from __future__ import annotations
@@ -77,7 +83,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from cocoa_tpu.ops import losses
 from cocoa_tpu.ops.local_sdca import coef_divisor, mode_factors
-from cocoa_tpu.ops.pallas_sdca import LANES, check_dtype
+from cocoa_tpu.ops.pallas_sdca import COMPILER_PARAMS, LANES, check_dtype
 
 ROW_BLOCK = 8          # aligned sublane block for the per-step value row
 SMEM_IDX_BUDGET = 512 << 10
@@ -402,7 +408,7 @@ def pallas_sparse_sdca_round(
             jax.ShapeDtypeStruct((k, n_dblk, 2 * LANES), dtype),
             jax.ShapeDtypeStruct((k, n_blocks, 3 * LANES), dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -422,3 +428,407 @@ def pallas_sparse_sdca_round(
     dw = wd[:, :, LANES:].reshape(k, d_pad)[:, :d]
     alpha_inner = st[:, :, 2 * LANES:].reshape(k, n_pad)[:, :n_shard]
     return dw, alpha_inner
+
+
+# ---------------------------------------------------------------------------
+# Sparse block-chain support: the (B, B) block Gram and the margin base
+# computed IN-KERNEL from the SMEM-scalar-prefetched padded-CSR streams (no
+# densify to (B, d)), plus the rank-B Δw apply as a sparse scatter.
+# ---------------------------------------------------------------------------
+#
+# The dense block path (ops/local_sdca.local_sdca_block_batched) gathers each
+# sampled block into a (K, B, d) dense tile before the Gram matmul; at rcv1
+# scale (d≈47k, ~73 nnz/row) that is ~650x more HBM traffic than the rows'
+# nonzeros, and benchmarks/KERNELS.md measured the densified block path 2.2x
+# SLOWER than the sequential sparse kernel.  These kernels replace every
+# O(B·d) dense tile with O(nnz) work over the same SMEM-scalar-prefetched
+# padded-CSR layout the sequential kernel proved out:
+#
+# - ``sparse_block_gram``: Gram entry (i, j) = Σ_t v_j[t]·x_i[f_j[t]] is an
+#   O(nnz_j) merge over SMEM index streams against a lane-blocked dense
+#   expansion of row i ((d/128, 128) VMEM scratch, one masked-row scatter
+#   per nonzero — O(nnz_i), amortized over the B-1 entries of Gram row i).
+#   Only the strict upper triangle is computed: the chain multiplies
+#   G[i, j] by the step-i coefficient, which is zero for i ≥ j.  The margin
+#   base x_i·(w + σ′·Δw_blockstart) comes from the same streams against the
+#   lane-concatenated [w | Δw] array (ONE dynamic slice serves both — the
+#   sequential kernel's layout), so the block path needs no whole-shard
+#   margins pass and no dense w.
+# - ``sparse_block_apply``: Δw += Σ_j coef_j·x_j as a masked-row scatter
+#   over the block's nonzeros — O(Σ_j nnz_j), not O(B·d).
+#
+# **SMEM segmentation.**  The scalar-prefetch tables must live whole in
+# SMEM, and a (B, W) block at rcv1 scale (B=128, W≈550 GROUP-rounded) is
+# ~590 KB — over the measured budget.  The Gram therefore computes in
+# (S, S) row-segment tiles: a call for segment pair (s, u ≥ s) prefetches
+# only the two segments' streams (2·S·W·8 bytes ≤ SMEM_IDX_BUDGET) and
+# fills G[i ∈ s, j ∈ u]; scatters of segment-s rows are repeated per pair
+# (O(nnz) each — noise against the merge work).  All (shard, pair) tiles
+# run as ONE ``lax.scan`` over a single pallas_call site — the round-3
+# many-call-sites compile blow-up does not recur.  The per-row GROUP-loop
+# early exit (dynamic trip counts from prefetched per-row nnz) carries
+# over unchanged, so heavy-tailed widths cost ceil(nnz/32)·32 slots, not W.
+
+
+def seg_rows(b: int, w_nnz: int) -> int:
+    """Rows per Gram-tile segment: the largest power-of-two divisor S of B
+    (≥ 8, so output tiles stay sublane-aligned) such that a segment PAIR's
+    scalar-prefetch tables — two (S, W_rounded) int32+f32 stream sets —
+    fit the SMEM budget.  0 when even S=8 does not fit (the caller then
+    keeps the densified path)."""
+    group = min(GROUP, max(1, w_nnz))
+    w_r = -(-w_nnz // group) * group
+    s = b
+    while s >= 8 and 16 * s * w_r > SMEM_IDX_BUDGET:
+        s //= 2
+    return s if s >= 8 and b % s == 0 else 0
+
+
+def sparse_block_vmem(d: int, b: int, s: int, itemsize: int) -> int:
+    """Working set of one Gram-tile call: the (d/128, 2·128) wd operand
+    (double-buffered), the (d/128, 128) dense-row scratch, and the small
+    (S, 128·⌈S/128⌉) gram / (1, ·) mb tiles."""
+    d_pad = -(-d // LANES) * LANES
+    lanes_out = -(-s // LANES) * LANES
+    return itemsize * (5 * d_pad + 2 * s * lanes_out + 2 * lanes_out)
+
+
+def sparse_chain_fits(k: int, n_shard: int, d: int, max_nnz: int, b: int,
+                      itemsize: int) -> bool:
+    """Feasibility of the sparse block-chain path: whole-lane-tile blocks
+    (the chain kernel's contract), an SMEM-feasible segment size, the chain
+    kernel's VMEM fit, and the Gram call's VMEM fit."""
+    from cocoa_tpu.ops.pallas_chain import chain_fits
+
+    s = seg_rows(b, max_nnz)
+    del n_shard
+    return (
+        b % LANES == 0
+        and s > 0
+        and chain_fits(k, b, itemsize)
+        and sparse_block_vmem(d, b, s, itemsize) <= VMEM_BUDGET
+    )
+
+
+def wd_stack(w: jax.Array, k: int) -> jax.Array:
+    """(d,) replicated w -> the (K, d/128, 2·128) lane-blocked AND
+    lane-concatenated [w | Δw=0] array the sparse kernels address (module
+    docstring layout; Δw rides lanes [128, 256))."""
+    d = w.shape[0]
+    d_pad = -(-d // LANES) * LANES
+    n_dblk = d_pad // LANES
+    w_blocked = jnp.broadcast_to(
+        jnp.pad(w, (0, d_pad - d)).reshape(1, n_dblk, LANES),
+        (k, n_dblk, LANES),
+    )
+    return jnp.concatenate(
+        [w_blocked, jnp.zeros((k, n_dblk, LANES), w.dtype)], axis=-1
+    )
+
+
+def wd_delta(wd: jax.Array, d: int) -> jax.Array:
+    """Extract the accumulated (K, d) Δw from the concatenated layout."""
+    k, n_dblk, _ = wd.shape
+    return wd[:, :, LANES:].reshape(k, n_dblk * LANES)[:, :d]
+
+
+def _gram_kernel(
+    sidx_ref,    # scalar-prefetch: (S, W) int32 scatter-segment indices
+    svals_ref,   # scalar-prefetch: (S, W) f32 scatter-segment values
+    scnt_ref,    # scalar-prefetch: (S,) int32 scatter-row nnz (-1 = pad step)
+    pidx_ref,    # scalar-prefetch: (S, W) int32 pick-segment indices
+    pvals_ref,   # scalar-prefetch: (S, W) f32 pick-segment values
+    pcnt_ref,    # scalar-prefetch: (S,) int32 pick-row nnz
+    diag_ref,    # scalar-prefetch: (1,) int32, 1 when pick seg == scatter seg
+    wd_ref,      # (n_dblk, 2·LANES) [w | Δw at block start], read-only
+    gram_ref,    # out (S, lanes_out): gram_ref[j, i] = G[i, j], i < j only
+    mb_ref,      # out (1, lanes_out): margin base (diagonal tiles only)
+    xrow_ref,    # scratch (n_dblk, LANES): dense expansion of scatter row i
+    *,
+    s: int,
+    w_nnz: int,
+    sig_eff: float,
+    frozen: bool,
+    lanes_out: int,
+):
+    """Grid (S,) over scatter rows i.  Step i scatters row i densely into
+    ``xrow`` (O(nnz_i) masked row updates), then merges every pick row j
+    (j > i on diagonal tiles, all j off-diagonal) against it — each Gram
+    entry an O(nnz_j) accumulate of SMEM scalar reads and (1, 128) dynamic
+    slices, with the GROUP-loop trip counts skipping padding.  Diagonal
+    tiles also emit the margin base from the [w | Δw] operand (one slice
+    serves both coordinates — the concatenation trick)."""
+    i = pl.program_id(0)
+    group = min(GROUP, max(1, w_nnz))
+    dtype = wd_ref.dtype
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, lanes_out), 1)
+    lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * LANES), 1)
+
+    @pl.when(i == 0)
+    def _init():
+        gram_ref[...] = jnp.zeros((s, lanes_out), dtype)
+        mb_ref[...] = jnp.zeros((1, lanes_out), dtype)
+
+    diag = diag_ref[0] == 1
+    cnt_i = scnt_ref[i]
+    trips_i = (jnp.maximum(cnt_i, 0) + (group - 1)) // group
+
+    # dense lane-blocked expansion of scatter row i; padded slots add
+    # exactly 0 at feature 0 (same inertness trick as the whole module)
+    xrow_ref[...] = jnp.zeros(xrow_ref.shape, dtype)
+
+    def scatter_body(g, c):
+        base = g * group
+        for u in range(group):
+            f = sidx_ref[i, base + u]
+            fb = f // LANES
+            fls = f - fb * LANES
+            v = svals_ref[i, base + u]
+            row = xrow_ref[pl.ds(fb, 1)]
+            xrow_ref[pl.ds(fb, 1)] = jnp.where(lane1 == fls, row + v, row)
+        return c
+
+    jax.lax.fori_loop(0, trips_i, scatter_body, jnp.int32(0))
+
+    # margin base x_i·(w + σ′·Δw_blockstart), diagonal tiles only (each row
+    # is a scatter row of exactly one diagonal tile)
+    @pl.when(diag)
+    def _margin():
+        def m_body(g, acc):
+            base = g * group
+            for u in range(group):
+                f = sidx_ref[i, base + u]
+                fb = f // LANES
+                fls = f - fb * LANES
+                v = svals_ref[i, base + u]
+                wrow = wd_ref[pl.ds(fb, 1)]
+                coord = jnp.sum(jnp.where(lane2 == fls, wrow, 0.0))
+                if not frozen:
+                    coord = coord + sig_eff * jnp.sum(
+                        jnp.where(lane2 == fls + LANES, wrow, 0.0)
+                    )
+                acc = acc + v * coord
+            return acc
+
+        m = jax.lax.fori_loop(0, trips_i, m_body, jnp.asarray(0.0, dtype))
+        mb_ref[...] = jnp.where(lane == i, m, mb_ref[...])
+
+    if frozen:
+        return  # frozen margins never see Δw: no Gram coupling needed
+
+    # Gram row i against every later pick row: G[i, j] = Σ_t v_j·xrow[f_j],
+    # written at [j, i] so the chain's per-step read is ONE leading-dim
+    # dynamic sublane slice (gram is assembled j-leading)
+    j_start = jnp.where(diag, i + 1, 0)
+
+    def j_body(j, c):
+        cnt_j = pcnt_ref[j]
+        trips_j = (jnp.maximum(cnt_j, 0) + (group - 1)) // group
+
+        def p_body(g, acc):
+            base = g * group
+            for u in range(group):
+                f = pidx_ref[j, base + u]
+                fb = f // LANES
+                fls = f - fb * LANES
+                v = pvals_ref[j, base + u]
+                xr = xrow_ref[pl.ds(fb, 1)]
+                acc = acc + v * jnp.sum(jnp.where(lane1 == fls, xr, 0.0))
+            return acc
+
+        g_ij = jax.lax.fori_loop(0, trips_j, p_body, jnp.asarray(0.0, dtype))
+        grow = gram_ref[pl.ds(j, 1)]
+        gram_ref[pl.ds(j, 1)] = jnp.where(lane == i, g_ij, grow)
+        return c
+
+    jax.lax.fori_loop(j_start, s, j_body, jnp.int32(0))
+
+
+def sparse_block_gram(
+    wd: jax.Array,       # (K, n_dblk, 2·LANES) [w | Δw at block start]
+    gidx: jax.Array,     # (K, B, W_r) int32 block CSR indices (GROUP-rounded)
+    svals: jax.Array,    # (K, B, W_r) block CSR values
+    cnts: jax.Array,     # (K, B) int32 per-row nnz; -1 marks padded steps
+    sig_eff: float,
+    frozen: bool,
+    interpret: bool = False,
+):
+    """The block's Gram and margin base, in-kernel from the CSR streams.
+
+    Returns ``(gram, mb)``: gram (B, K, B) j-leading with the strict upper
+    triangle filled (``gram[j, k, i] = x_i·x_j`` of shard k for i < j,
+    zeros elsewhere — exactly the entries the chain's coefficient dots can
+    see; None in frozen mode), and mb (K, B) = x_j·(w + σ′·Δw_blockstart)
+    (x_j·w for frozen).  All (shard, segment-pair) tiles run as one
+    ``lax.scan`` over a single pallas_call site."""
+    k, b, w_r = gidx.shape
+    dtype = wd.dtype
+    n_dblk = wd.shape[1]
+    s = seg_rows(b, w_r)
+    if s <= 0:
+        raise ValueError(
+            f"no SMEM-feasible Gram segment for B={b}, W={w_r} "
+            f"(sparse_chain_fits should have rejected this config)"
+        )
+    ns = b // s
+    lanes_out = -(-s // LANES) * LANES
+    # (shard, scatter-seg, pick-seg) tiles; only u >= s segments (upper
+    # triangle — earlier pick rows multiply zero coefficients)
+    pairs = [(si, ui) for si in range(ns) for ui in range(si, ns)]
+    np_ = len(pairs)
+    si_t = jnp.tile(jnp.asarray([p[0] for p in pairs], jnp.int32), k)
+    ui_t = jnp.tile(jnp.asarray([p[1] for p in pairs], jnp.int32), k)
+    kk_t = jnp.repeat(jnp.arange(k, dtype=jnp.int32), np_)
+    seg = lambda a: a.reshape(k, ns, s, *a.shape[2:])  # noqa: E731
+    gi, sv, cn = seg(gidx), seg(svals), seg(cnts)
+    xs = (
+        gi[kk_t, si_t], sv[kk_t, si_t], cn[kk_t, si_t],
+        gi[kk_t, ui_t], sv[kk_t, ui_t], cn[kk_t, ui_t],
+        (si_t == ui_t).astype(jnp.int32)[:, None], kk_t,
+    )
+
+    kernel = functools.partial(
+        _gram_kernel, s=s, w_nnz=w_r, sig_eff=float(sig_eff),
+        frozen=frozen, lanes_out=lanes_out,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((n_dblk, 2 * LANES), lambda i, *_: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((s, lanes_out), lambda i, *_: (0, 0)),
+            pl.BlockSpec((1, lanes_out), lambda i, *_: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((n_dblk, LANES), dtype)],
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((s, lanes_out), dtype),
+            jax.ShapeDtypeStruct((1, lanes_out), dtype),
+        ],
+        compiler_params=COMPILER_PARAMS(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+
+    def body(carry, xs_p):
+        si, sv_, sc, pi_, pv, pc, dg, kp = xs_p
+        wd_k = jax.lax.dynamic_index_in_dim(wd, kp, axis=0, keepdims=False)
+        g_tile, mb_tile = call(si, sv_, sc, pi_, pv, pc, dg, wd_k)
+        return carry, (g_tile, mb_tile)
+
+    _, (gtiles, mbtiles) = jax.lax.scan(body, jnp.int32(0), xs)
+    gtiles = gtiles[..., :s].reshape(k, np_, s, s)
+    mbtiles = mbtiles[:, 0, :s].reshape(k, np_, s)
+
+    mb = jnp.zeros((k, b), dtype)
+    gram = None if frozen else jnp.zeros((b, k, b), dtype)
+    for p, (si, ui) in enumerate(pairs):
+        if si == ui:
+            mb = mb.at[:, si * s:(si + 1) * s].set(mbtiles[:, p])
+        if not frozen:
+            gram = gram.at[ui * s:(ui + 1) * s, :, si * s:(si + 1) * s].set(
+                gtiles[:, p].transpose(1, 0, 2)
+            )
+    return gram, mb
+
+
+def _apply_kernel(
+    gidx_ref,    # scalar-prefetch: (S, W) int32 segment indices
+    svals_ref,   # scalar-prefetch: (S, W) f32 segment values
+    cnts_ref,    # scalar-prefetch: (S,) int32 per-row nnz (-1 = pad step)
+    coefs_ref,   # scalar-prefetch: (S,) f32 chain Δw coefficients
+    wd_in,       # (n_dblk, 2·LANES)
+    wd_out,      # (n_dblk, 2·LANES)
+    *,
+    s: int,
+    w_nnz: int,
+):
+    """Grid (S,) over the segment's rows: Δw lanes += coef_j·x_j as masked
+    row updates over row j's nonzeros — the rank-B apply without the dense
+    (B, d) tile.  Padded steps carry coef 0 AND cnt -1 (zero trips)."""
+    j = pl.program_id(0)
+    group = min(GROUP, max(1, w_nnz))
+    lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * LANES), 1)
+
+    @pl.when(j == 0)
+    def _init():
+        wd_out[...] = wd_in[...]
+
+    cnt = cnts_ref[j]
+    coef = coefs_ref[j]
+    trips = (jnp.maximum(cnt, 0) + (group - 1)) // group
+
+    def body(g, c):
+        base = g * group
+        for u in range(group):
+            f = gidx_ref[j, base + u]
+            fb = f // LANES
+            fls = f - fb * LANES
+            v = svals_ref[j, base + u]
+            row = wd_out[pl.ds(fb, 1)]
+            wd_out[pl.ds(fb, 1)] = jnp.where(
+                lane2 == fls + LANES, row + coef * v, row
+            )
+        return c
+
+    jax.lax.fori_loop(0, trips, body, jnp.int32(0))
+
+
+def sparse_block_apply(
+    wd: jax.Array,       # (K, n_dblk, 2·LANES)
+    gidx: jax.Array,     # (K, B, W_r) int32
+    svals: jax.Array,    # (K, B, W_r)
+    cnts: jax.Array,     # (K, B) int32; -1 marks padded steps
+    coefs: jax.Array,    # (K, B) chain Δw coefficients
+    interpret: bool = False,
+):
+    """Apply the block's rank-B Δw update into the concatenated [w | Δw]
+    array as a sparse scatter — one (shard, row-segment) pallas call per
+    scan step, same SMEM segmentation as the Gram."""
+    k, b, w_r = gidx.shape
+    dtype = wd.dtype
+    n_dblk = wd.shape[1]
+    s = seg_rows(b, w_r)
+    if s <= 0:
+        raise ValueError(f"no SMEM-feasible apply segment for B={b}, W={w_r}")
+    ns = b // s
+    kk_t = jnp.repeat(jnp.arange(k, dtype=jnp.int32), ns)
+    ss_t = jnp.tile(jnp.arange(ns, dtype=jnp.int32), k)
+    seg = lambda a: a.reshape(k, ns, s, *a.shape[2:])  # noqa: E731
+    xs = (
+        seg(gidx)[kk_t, ss_t], seg(svals)[kk_t, ss_t],
+        seg(cnts)[kk_t, ss_t], seg(coefs.astype(svals.dtype))[kk_t, ss_t],
+        kk_t,
+    )
+    kernel = functools.partial(_apply_kernel, s=s, w_nnz=w_r)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(s,),
+        in_specs=[pl.BlockSpec((n_dblk, 2 * LANES), lambda i, *_: (0, 0))],
+        out_specs=[pl.BlockSpec((n_dblk, 2 * LANES), lambda i, *_: (0, 0))],
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((n_dblk, 2 * LANES), dtype)],
+        compiler_params=COMPILER_PARAMS(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+
+    def body(wd_c, xs_p):
+        gi, sv, cn, cf, kp = xs_p
+        wd_k = jax.lax.dynamic_index_in_dim(wd_c, kp, axis=0, keepdims=False)
+        (wd_k2,) = call(gi, sv, cn, cf, wd_k)
+        return jax.lax.dynamic_update_index_in_dim(wd_c, wd_k2, kp, 0), None
+
+    wd, _ = jax.lax.scan(body, wd, xs)
+    return wd

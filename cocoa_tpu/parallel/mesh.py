@@ -35,7 +35,12 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
-from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.5: explicit/auto axis types (the fp axis rides Auto)
+    from jax.sharding import AxisType
+except ImportError:  # older jax: dp-only meshes work; fp needs AxisType
+    AxisType = None
 
 DP_AXIS = "dp"
 FP_AXIS = "fp"
@@ -70,6 +75,11 @@ def make_mesh(
         )
     if fp == 1:
         return jax.make_mesh((k,), (DP_AXIS,), devices=devices[:need])
+    if AxisType is None:
+        raise ValueError(
+            "feature-parallel (fp) meshes need jax.sharding.AxisType "
+            "(jax >= 0.5); this jax only supports dp meshes"
+        )
     return jax.make_mesh(
         (k, fp), (DP_AXIS, FP_AXIS), devices=devices[:need],
         axis_types=(AxisType.Explicit, AxisType.Auto),

@@ -64,6 +64,26 @@ def stall_window(debug_iter: int) -> int:
     return max(STALL_EVALS, -(-STALL_ROUNDS // max(1, int(debug_iter))))
 
 
+def resolve_divergence_guard(flag: str, mode: str, sigma: float, k: int,
+                             gamma: float) -> bool:
+    """Resolve the ``--divergenceGuard`` flag to an armed/disarmed bool.
+
+    ``on``/``off`` force it.  ``auto`` (default) arms the guard only when
+    σ′ is overridden BELOW the paper-safe K·γ bound — the one regime where
+    certified divergence is an expected outcome the run should bail out of
+    (the --sigma sweep / sigma=auto trials).  A safe-σ′ run that converges
+    slowly is left to its round budget instead of being mislabeled
+    DIVERGED (ADVICE r5: the always-armed guard killed slow-but-converging
+    problems).  Modes whose subproblem never reads σ′ (cocoa's advancing
+    local view, frozen's plain gradient) never arm on auto."""
+    if flag not in ("auto", "on", "off"):
+        raise ValueError(
+            f"divergence guard must be auto|on|off, got {flag!r}")
+    if flag != "auto":
+        return flag == "on"
+    return mode in ("plus", "prox") and sigma < k * gamma
+
+
 class _GapWatch:
     """Windowed no-improvement watch over eval-cadence gap values;
     ``update(gap)`` returns True when the run should bail out (diverged or
@@ -98,11 +118,14 @@ def drive(
     quiet: bool = False,
     gap_target: Optional[float] = None,
     start_round: int = 1,
+    divergence_guard: bool = True,
 ):
     """The outer driver loop shared by every solver (CoCoA.scala:39-63
     skeleton): run rounds, gate evaluation to every ``debugIter`` rounds,
     checkpoint every ``chkptIter`` rounds, optionally stop early on a
-    duality-gap target (or on measured divergence — see STALL_EVALS).
+    duality-gap target (or on measured divergence — see STALL_EVALS;
+    ``divergence_guard=False`` disarms the stall watch, see
+    :func:`resolve_divergence_guard`).
 
     ``state`` is ``(w,)`` or ``(w, alpha)``; ``round_fn(t, state) -> state``;
     ``eval_fn(state) -> (primal, gap_or_None, test_error_or_None)``.
@@ -119,7 +142,8 @@ def drive(
             if gap_target is not None and gap is not None and gap <= gap_target:
                 traj.stopped = "target"
                 break
-            if gap_target is not None and watch.update(gap):
+            if (gap_target is not None and divergence_guard
+                    and watch.update(gap)):
                 traj.mark_diverged(t, watch.n)
                 break
 
@@ -142,6 +166,7 @@ def drive_chunked(
     gap_target: Optional[float] = None,
     start_round: int = 1,
     chunk: int = 50,
+    divergence_guard: bool = True,
 ):
     """Chunked variant of :func:`drive`: rounds run device-side in blocks of
     up to ``chunk`` via ``lax.scan`` (one dispatch per block instead of one
@@ -176,7 +201,8 @@ def drive_chunked(
             if gap_target is not None and gap is not None and gap <= gap_target:
                 traj.stopped = "target"
                 break
-            if gap_target is not None and watch.update(gap):
+            if (gap_target is not None and divergence_guard
+                    and watch.update(gap)):
                 traj.mark_diverged(end, watch.n)
                 break
 
@@ -253,17 +279,18 @@ class _Prefetch:
 
 
 def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
-                      mesh=None, stall_evals=STALL_EVALS):
+                      mesh=None, stall_evals=STALL_EVALS,
+                      divergence_guard=True):
     import functools
 
     import jax.numpy as jnp
     from jax import lax
 
     tgt = -jnp.inf if gap_target is None else float(gap_target)
-    # divergence bail-out rides the loop carry only for gap-targeted runs:
-    # fixed-round runs are the benchmark timing paths and must execute
-    # exactly their round budget
-    check_div = gap_target is not None
+    # divergence bail-out rides the loop carry only for gap-targeted runs
+    # with the guard armed: fixed-round runs are the benchmark timing paths
+    # and must execute exactly their round budget
+    check_div = gap_target is not None and divergence_guard
 
     @functools.partial(jax.jit, donate_argnums=tuple(range(n_state)))
     def run(*args):
@@ -275,16 +302,16 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
         n_chunks = jax.tree.leaves(idxs_all)[0].shape[0]
 
         def cond(s):
-            i, done, stall, best, best_prev, state, traj = s
-            return (i < n_chunks) & jnp.logical_not(done)
+            i, done_tgt, done_stall, stall, best, best_prev, state, traj = s
+            return (i < n_chunks) & jnp.logical_not(done_tgt | done_stall)
 
         def body(s):
-            i, done, stall, best, best_prev, state, traj = s
+            i, done_tgt, done_stall, stall, best, best_prev, state, traj = s
             chunk = jax.tree.map(lambda a: a[i], idxs_all)
             state = chunk_kernel(state, chunk, shard_arrays)
             metrics = eval_kernel(state, shard_arrays, test_arrays)
             traj = lax.dynamic_update_index_in_dim(traj, metrics, i, 0)
-            done = metrics[1] <= tgt
+            done_tgt = metrics[1] <= tgt
             if check_div:
                 # windowed no-improvement watch (the _GapWatch twin): NaN
                 # gaps (primal-only eval) map to +inf, leaving best — and
@@ -295,9 +322,10 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                 improved = best <= STALL_REL * best_prev
                 stall = jnp.where(improved, jnp.int32(0), stall + 1)
                 best_prev = jnp.where(improved, best, best_prev)
-                done = done | (stall >= stall_evals)
-            return (i + jnp.int32(1), done, stall, best, best_prev, state,
-                    traj)
+                # the target wins a tie (the host drivers check that order)
+                done_stall = (stall >= stall_evals) & jnp.logical_not(done_tgt)
+            return (i + jnp.int32(1), done_tgt, done_stall, stall, best,
+                    best_prev, state, traj)
 
         traj0 = jnp.full((n_chunks, 3), jnp.nan, dtype=state[0].dtype)
         if mesh is not None:
@@ -308,13 +336,15 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
             traj0 = lax.with_sharding_constraint(
                 traj0, NamedSharding(mesh, P(None, None))
             )
-        i, done, stall, best, best_prev, state, traj = lax.while_loop(
+        (i, done_tgt, done_stall, stall, best, best_prev, state,
+         traj) = lax.while_loop(
             cond, body,
-            (jnp.int32(0), jnp.asarray(False), jnp.int32(0),
+            (jnp.int32(0), jnp.asarray(False), jnp.asarray(False),
+             jnp.int32(0),
              jnp.asarray(jnp.inf, dtype=state[0].dtype),
              jnp.asarray(jnp.inf, dtype=state[0].dtype), state, traj0),
         )
-        return i, state, traj
+        return i, done_tgt, done_stall, state, traj
 
     return run
 
@@ -333,6 +363,7 @@ def drive_on_device(
     cache_key=None,
     mesh=None,
     stall_evals: int = STALL_EVALS,
+    divergence_guard: bool = True,
 ):
     """Fully device-resident outer driver: the ENTIRE run — every round,
     every ``debugIter`` evaluation, and the gap-target early-stop test — is
@@ -369,12 +400,13 @@ def drive_on_device(
     if run is None:
         run = _build_device_run(
             chunk_kernel, eval_kernel, tgt, n_state, mesh=mesh,
-            stall_evals=stall_evals,
+            stall_evals=stall_evals, divergence_guard=divergence_guard,
         )
         if cache_key is not None:
             _DEVICE_RUNS[cache_key] = run
 
-    i, state, traj_buf = run(*state, idxs_all, shard_arrays, test_arrays)
+    i, done_tgt, done_stall, state, traj_buf = run(
+        *state, idxs_all, shard_arrays, test_arrays)
     # the single host sync of the whole run
     n_done = int(i)
     traj_host = np.asarray(traj_buf[:n_done])
@@ -393,14 +425,14 @@ def drive_on_device(
             # dispatch and one fetch — don't fabricate flat timestamps
             wall_time=None,
         )
-    if tgt is not None and 0 < n_done < jax.tree.leaves(idxs_all)[0].shape[0]:
-        # the while_loop stopped before exhausting its chunks: either the
-        # gap target was reached, or the divergence guard fired
-        last_gap = traj.records[-1].gap
-        if last_gap is not None and last_gap <= tgt:
-            traj.stopped = "target"
-        else:
+    # classify from the device-side stop flags themselves (not from
+    # n_done < n_chunks, which misses a guard fire on the FINAL chunk —
+    # ADVICE r5): the while_loop carried exactly why it stopped
+    if tgt is not None:
+        if bool(done_stall):
             traj.stopped = "diverged"   # caller reports (with the round)
+        elif bool(done_tgt):
+            traj.stopped = "target"
     return state, traj
 
 
@@ -421,6 +453,7 @@ def drive_device_full(
     start_round: int = 1,
     cache_key=None,
     mesh=None,
+    divergence_guard: bool = True,
 ):
     """Cadence-aligned wrapper around :func:`drive_on_device`, usable by any
     solver whose round has the (state, idxs, shards) shape: host-steps the
@@ -536,6 +569,7 @@ def drive_device_full(
                 shard_arrays, test_arrays, quiet=quiet,
                 gap_target=gap_target, start_round=start,
                 cache_key=cache_key, mesh=mesh, stall_evals=watch.n,
+                divergence_guard=divergence_guard,
             )
             traj.records.extend(dev_traj.records)
             if dev_traj.records:
@@ -563,8 +597,9 @@ def drive_device_full(
             # the in-loop watch state is per-block; the host twin spans
             # block boundaries (geometric blocks start with < STALL_EVALS
             # evals, where the in-loop watch alone could never fire)
-            diverged = dev_traj.stopped == "diverged" or any(
-                watch.update(r.gap) for r in dev_traj.records
+            diverged = divergence_guard and (
+                dev_traj.stopped == "diverged"
+                or any(watch.update(r.gap) for r in dev_traj.records)
             )
             if gap_target is not None and diverged:
                 traj.mark_diverged(done, watch.n)
@@ -792,6 +827,7 @@ def drive_device_paths(
     device_loop: bool = False,
     cache_key=None,
     eval_kernel=None,
+    divergence_guard: bool = True,
 ):
     """The scan_chunk / device_loop dispatch shared by every solver: builds
     the fused eval kernel (dual state iff ``alpha_in_state``; overridable
@@ -817,12 +853,14 @@ def drive_device_paths(
             name, params, debug, state, chunk_kernel, eval_kernel, chunk_fn,
             eval_fn, sampler, shard_arrays, test_arrays, quiet=quiet,
             gap_target=gap_target, start_round=start_round,
-            cache_key=None if cache_key is None else (*cache_key, test_n),
-            mesh=mesh,
+            cache_key=None if cache_key is None
+            else (*cache_key, test_n, divergence_guard),
+            mesh=mesh, divergence_guard=divergence_guard,
         )
     return drive_chunked(
         name, params, debug, state, chunk_fn, eval_fn, quiet=quiet,
         gap_target=gap_target, start_round=start_round, chunk=scan_chunk,
+        divergence_guard=divergence_guard,
     )
 
 

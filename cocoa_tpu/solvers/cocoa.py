@@ -58,6 +58,40 @@ def _pallas_batched(w, alpha, idxs_kh, shards, params, mode, sigma,
     )
 
 
+def auto_block_size(ds: ShardedDataset, m_local: int, dtype) -> int:
+    """Resolve ``--blockSize=auto`` per data layout (benchmarks/KERNELS.md),
+    mirroring EXACTLY the path local_sdca_block_batched would dispatch to:
+
+    - dense: 128 — the measured-best block size — whenever the lockstep
+      chain kernel fits VMEM;
+    - sparse: 128 when a winning block kernel exists — the fused kernel
+      holding the (small-d) densified tile, or otherwise the in-kernel CSR
+      Gram path (ops/pallas_sparse.sparse_chain_fits).  When neither fits,
+      0: a SPLIT-path densified sparse block loses to the sequential
+      sparse kernel, so those configs keep the sequential default;
+    - anything the f32 chain kernel cannot serve (2/8-byte dtypes,
+      oversized VMEM): 0, the sequential path.
+    """
+    from cocoa_tpu.ops.pallas_chain import chain_fits, fused_fits
+    from cocoa_tpu.ops.pallas_sparse import sparse_chain_fits
+
+    b = 128
+    itemsize = jnp.dtype(dtype).itemsize
+    if itemsize != 4 or not chain_fits(m_local, b, itemsize):
+        return 0
+    if ds.layout == "sparse":
+        # same precedence as the block dispatch: the fused kernel first
+        # (densify is cheap when the half-tile fits), the CSR Gram path
+        # when it cannot (the rcv1 regime)
+        if fused_fits(m_local, b, ds.num_features, itemsize, ds.n_shard):
+            return b
+        return b if sparse_chain_fits(
+            m_local, ds.n_shard, ds.num_features,
+            int(ds.sp_indices.shape[-1]), b, itemsize,
+        ) else 0
+    return b
+
+
 def _alg_config(params: Params, k: int, plus: Optional[bool], mode=None):
     """(mode, scaling, sigma) for the three SDCA-family algorithms.
 
@@ -97,6 +131,7 @@ def _sdca_round_parts(
     block: int = 0,
     block_chain: str = "xla",
     block_distinct: bool = False,
+    block_sparse_gram=None,
 ):
     """The per-shard local update and driver-side apply shared by the
     per-round and chunked builders (so the two paths cannot diverge), for
@@ -152,7 +187,7 @@ def _sdca_round_parts(
             w, alpha, shards, idxs_kh, params.lam, params.n, mode=mode,
             sigma=sigma, loss=params.loss, smoothing=params.smoothing,
             block=block, interpret=(block_chain == "pallas_interpret"),
-            distinct=block_distinct,
+            distinct=block_distinct, sparse_gram=block_sparse_gram,
         )
 
     def per_shard(w, alpha_k, idxs_k, shard_k):
@@ -299,10 +334,12 @@ def run_sdca_family(
     pallas=None,
     block_size: int = 0,
     block_chain=None,
+    block_sparse_gram=None,
     device_loop: bool = False,
     eval_fn=None,
     eval_kernel=None,
     sampling: str = "auto",
+    divergence_guard: str = "auto",
 ):
     """Shared driver for the SDCA-family algorithms (CoCoA, CoCoA+,
     mini-batch CD — they differ only in their ``alg`` scaling triple, see
@@ -345,8 +382,19 @@ def run_sdca_family(
     base.drive_on_device).  Observable trajectory identical to the
     host-stepped drivers; requires debug_iter > 0, not compatible with
     checkpointing (chkpt_iter).
+
+    ``block_sparse_gram`` (None = auto by layout and fit) selects the
+    sparse block-chain path for padded-CSR data: the block Gram and margin
+    base come from SMEM CSR streams in-kernel and the Δw apply is a sparse
+    scatter (ops/pallas_sparse) — no (K, B, d) densify.
+
+    ``divergence_guard`` ("auto" | "on" | "off", flag --divergenceGuard)
+    controls the gap-target stall watch: auto arms it only when σ′ is
+    overridden below the safe K·γ bound (base.resolve_divergence_guard).
     """
     base.check_shards(ds)
+    guard_on = base.resolve_divergence_guard(
+        divergence_guard, alg[0], alg[2], ds.k, params.gamma)
     k = ds.k
     if not quiet:
         # ds.n, not params.n: the prox family clones params with n=1 (its
@@ -458,6 +506,7 @@ def run_sdca_family(
         math=math, pallas=pallas,
         pallas_interpret=(pallas and platform == "cpu"),
         block=block_size, block_chain=block_chain,
+        block_sparse_gram=block_sparse_gram,
         # permuted sampling with n_local % H == 0 keeps every round inside
         # one epoch's permutation, so the round's H draws are pairwise
         # distinct per shard — the license for the block kernel's
@@ -484,9 +533,10 @@ def run_sdca_family(
         from cocoa_tpu.ops.pallas_sdca import fold_rows
 
         shard_arrays = {**shard_arrays, "X_folded": fold_rows(shard_arrays["X"])}
-    if pallas and ds.layout == "sparse":
-        # per-row nnz counts for the kernel's group early exit, ONCE per
-        # run (per round it would re-read the whole values array)
+    if (pallas or block_size > 0) and ds.layout == "sparse":
+        # per-row nnz counts for the kernels' group early exit (sequential
+        # sparse kernel AND the sparse block-chain path), ONCE per run —
+        # per round it would re-read the whole values array inside the scan
         from cocoa_tpu.ops.pallas_sparse import row_lengths
 
         shard_arrays = {**shard_arrays,
@@ -515,6 +565,7 @@ def run_sdca_family(
 
         cache_key = (
             "sdca", alg_name, alg, math, pallas, block_size, block_chain,
+            block_sparse_gram,
             sampler.cache_token(), k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,
@@ -527,7 +578,7 @@ def run_sdca_family(
             test_ds=test_ds, quiet=quiet, gap_target=gap_target,
             start_round=start_round, scan_chunk=scan_chunk,
             device_loop=device_loop, cache_key=cache_key,
-            eval_kernel=eval_kernel,
+            eval_kernel=eval_kernel, divergence_guard=guard_on,
         )
         return w, alpha, traj
 
@@ -540,6 +591,7 @@ def run_sdca_family(
     (w, alpha), traj = base.drive(
         alg_name, params, debug, (w, alpha), round_fn, eval_fn,
         quiet=quiet, gap_target=gap_target, start_round=start_round,
+        divergence_guard=guard_on,
     )
     return w, alpha, traj
 
@@ -580,6 +632,10 @@ def run_cocoa(
             raise ValueError("--sigma=auto requires --gapTarget (the "
                              "σ′ fallback triggers on the divergence "
                              "guard, which runs on the gap-target path)")
+        if kw.get("divergence_guard", "auto") == "off":
+            # the trial's only exit from a bad guess IS the guard
+            raise ValueError("--sigma=auto requires the divergence guard "
+                             "(drop --divergenceGuard=off)")
         quiet = kw.get("quiet", False)
         if kw.get("w_init") is not None or kw.get("start_round", 1) > 1:
             # a RESUMED run must not re-experiment: the restored state may
@@ -605,9 +661,22 @@ def run_cocoa(
         if ckpt_dir and _os.path.isdir(ckpt_dir):
             # the diverged trial's checkpoints must not survive: the safe
             # rerun restarts from round 1, and a later --resume would
-            # otherwise pick the trial's (higher-round, diverged) state
+            # otherwise pick the trial's (higher-round, diverged) state.
+            # Deletion is scoped to THIS run's files only — the exact
+            # algorithm prefix the trial's checkpoint writer used and the
+            # round range it actually reached — so a concurrent CoCoA /
+            # CoCoA+ run sharing the directory (elastic workers, parallel
+            # sweeps) can never lose its checkpoints to our cleanup
+            # (ADVICE r5: the bare 'CoCoA' prefix matched them all).
+            import re as _re
+
+            algo = ("CoCoA+" if plus else "CoCoA").replace(" ", "_")
+            last = traj.records[-1].round if traj.records else 0
+            stamp = _re.compile(
+                _re.escape(algo) + r"-r(\d+)\.(npz|npz\.json|json)$")
             for f in sorted(set(_os.listdir(ckpt_dir)) - before):
-                if f.startswith("CoCoA"):
+                m = stamp.match(f)
+                if m and int(m.group(1)) <= last:
                     _os.remove(_os.path.join(ckpt_dir, f))
         if not quiet:
             print(f"sigma=auto: σ′=K·γ/2={trial.sigma:g} diverged; "

@@ -42,6 +42,7 @@ def run_minibatch_cd(
     block_chain=None,
     device_loop: bool = False,
     sampling: str = "auto",
+    divergence_guard: str = "auto",
 ):
     """Train; returns (w, alpha, Trajectory)."""
     alg = _alg_config(params, ds.k, None, mode="frozen")
@@ -52,4 +53,5 @@ def run_minibatch_cd(
         scan_chunk=scan_chunk, math=math, pallas=pallas,
         block_size=block_size, block_chain=block_chain,
         device_loop=device_loop, sampling=sampling,
+        divergence_guard=divergence_guard,
     )

@@ -118,6 +118,7 @@ def run_prox_cocoa(
     block_chain=None,
     device_loop: bool = False,
     sampling: str = "auto",
+    divergence_guard: str = "auto",
 ):
     """Train; returns (x, r, Trajectory) with x (K, d_shard) the sharded
     coordinates and r = A·x − b the replicated residual (v = r + b).
@@ -172,5 +173,6 @@ def run_prox_cocoa(
         math=math, pallas=pallas, block_size=block_size,
         block_chain=block_chain, device_loop=device_loop,
         eval_fn=eval_fn, eval_kernel=eval_kernel, sampling=sampling,
+        divergence_guard=divergence_guard,
     )
     return x, r, traj

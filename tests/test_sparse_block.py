@@ -1,0 +1,329 @@
+"""Sparse block-chain kernel (round 6 tentpole) — in-kernel Gram from SMEM
+CSR streams, no densify (ops/pallas_sparse.sparse_block_gram/_apply feeding
+ops/pallas_chain.chain_block_batched through local_sdca_block_batched's
+``sparse_gram`` path).
+
+The contract mirrors tests/test_block.py: the sparse block path consumes the
+SAME sampled index stream as the sequential fast path and is identical to it
+in real arithmetic, so trajectory parity to fp tolerance — not mere
+convergence parity — is what is pinned, in CPU interpret mode
+(``pl.pallas_call(..., interpret=True)``) so CI exercises the kernels
+without a TPU.  Coverage: all three SDCA modes, f32 and f64, the masked tail
+(H % B != 0), duplicate draws inside a block, multi-block rounds with the Δw
+carry, the SMEM row-segment tiling, generic losses, the layout-driven auto
+dispatch, the driver integration, and the ``--blockSize=auto`` CLI flag.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.libsvm import LibsvmData
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.ops.local_sdca import local_sdca_block_batched, local_sdca_fast
+from cocoa_tpu.ops.rows import shard_margins
+from cocoa_tpu.solvers import run_cocoa
+from cocoa_tpu.utils.prng import sample_indices_per_shard
+
+K = 4
+
+
+def _sparse_ds(tiny_data, dtype=jnp.float32, k=K):
+    ds = shard_dataset(tiny_data, k=k, layout="sparse", dtype=dtype)
+    return ds, ds.shard_arrays()
+
+
+def _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, n, mode, sigma,
+                       rtol, atol, loss="hinge", smoothing=1.0):
+    d = w.shape[0]
+    for s in range(alpha.shape[0]):
+        shard = {kk: v[s] for kk, v in sa.items()}
+        m0 = shard_margins(w, shard)
+        da_f, dw_f = local_sdca_fast(
+            m0, alpha[s], shard, idxs[s], 0.01, n,
+            jnp.zeros(d, w.dtype), mode=mode, sigma=sigma, loss=loss,
+            smoothing=smoothing,
+        )
+        np.testing.assert_allclose(np.asarray(da_b[s]), np.asarray(da_f),
+                                   rtol=rtol, atol=atol)
+        np.testing.assert_allclose(np.asarray(dw_b[s]), np.asarray(dw_f),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
+                                        ("frozen", 1.0)])
+def test_sparse_block_kernel_matches_fast(tiny_data, mode, sigma):
+    """f32 interpret-mode parity against the sequential fast path — masked
+    tail (H=37 vs B=128) and within-block duplicate draws included (37
+    draws from 24-row shards guarantee repeats)."""
+    ds, sa = _sparse_ds(tiny_data)
+    rng = np.random.default_rng(5)
+    d = tiny_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0, 1),
+        jnp.float32,
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 37, ds.counts)[:, 0, :]
+    )
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode=mode, sigma=sigma,
+        block=128, interpret=True, sparse_gram=True,
+    )
+    _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, tiny_data.n,
+                       mode, sigma, rtol=2e-4, atol=1e-6)
+
+
+def test_sparse_block_kernel_f64(tiny_data):
+    """Float64 interpret mode pins the algebra tightly (the fp-association
+    differences shrink to ~1e-12) — same tolerance contract as the f64
+    chain tests in test_block.py."""
+    ds, sa = _sparse_ds(tiny_data, dtype=jnp.float64)
+    rng = np.random.default_rng(11)
+    d = tiny_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0, 1))
+    idxs = jnp.asarray(
+        sample_indices_per_shard(3, range(1, 2), 37, ds.counts)[:, 0, :]
+    )
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode="plus", sigma=4.0,
+        block=128, interpret=True, sparse_gram=True,
+    )
+    _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, tiny_data.n,
+                       "plus", 4.0, rtol=1e-9, atol=1e-12)
+
+
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
+                                        ("frozen", 1.0)])
+def test_sparse_block_segmented_smem(tiny_data, monkeypatch, mode, sigma):
+    """The SMEM row-segment tiling (the rcv1 regime, where a whole block's
+    streams exceed the budget): shrink the budget so B=128 splits into
+    four (32, 32) Gram tiles, and run H=200 so the round spans TWO blocks
+    — the cross-block Δw carry through the [w | Δw] array is covered."""
+    import cocoa_tpu.ops.pallas_sparse as ps
+
+    ds, sa = _sparse_ds(tiny_data)
+    w_nnz = int(sa["sp_indices"].shape[-1])
+    group = min(ps.GROUP, w_nnz)
+    w_r = -(-w_nnz // group) * group
+    monkeypatch.setattr(ps, "SMEM_IDX_BUDGET", 16 * 32 * w_r)
+    assert ps.seg_rows(128, w_nnz) == 32
+    rng = np.random.default_rng(5)
+    d = tiny_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0, 1),
+        jnp.float32,
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 200, ds.counts)[:, 0, :]
+    )
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode=mode, sigma=sigma,
+        block=128, interpret=True, sparse_gram=True,
+    )
+    _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, tiny_data.n,
+                       mode, sigma, rtol=2e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("loss,smoothing", [("smooth_hinge", 0.5),
+                                            ("logistic", 1.0)])
+def test_sparse_block_generic_losses(tiny_data, loss, smoothing):
+    """Non-hinge losses ride the chain kernel's generic branch; the sparse
+    Gram/margins feed it the identical (scal, gq) contract."""
+    ds, sa = _sparse_ds(tiny_data)
+    rng = np.random.default_rng(9)
+    d = tiny_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0.01, 0.99),
+        jnp.float32,
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 37, ds.counts)[:, 0, :]
+    )
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode="plus", sigma=4.0,
+        loss=loss, smoothing=smoothing, block=128, interpret=True,
+        sparse_gram=True,
+    )
+    _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, tiny_data.n,
+                       "plus", 4.0, rtol=2e-4, atol=1e-6,
+                       loss=loss, smoothing=smoothing)
+
+
+def test_sparse_block_duplicates_exact(tiny_data):
+    """A pathological stream — every draw the same index — makes the Gram
+    self-coupling plus the equality tile carry the whole sequential
+    recurrence (‖x‖² on the diagonal never enters: only i < j entries are
+    read, the α chaining rides eq)."""
+    ds, sa = _sparse_ds(tiny_data, dtype=jnp.float64, k=1)
+    d = tiny_data.num_features
+    w = jnp.zeros(d)
+    alpha = jnp.zeros((1, ds.n_shard))
+    idxs = jnp.full((1, 16), 3, dtype=jnp.int32)
+    da_b, dw_b = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, mode="plus", sigma=4.0,
+        block=128, interpret=True, sparse_gram=True,
+    )
+    _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, tiny_data.n,
+                       "plus", 4.0, rtol=1e-9, atol=1e-12)
+
+
+def test_seg_rows_and_fits():
+    """SMEM segmentation plan at real scales: a whole rcv1-like block
+    (W≈548 GROUP-rounds to 576 → 590 KB of streams) does NOT fit the
+    512 KB budget whole, splits into S=32 segments, and sparse_chain_fits
+    accepts the flagship shape; pathologically wide rows are rejected."""
+    from cocoa_tpu.ops.pallas_sparse import (
+        SMEM_IDX_BUDGET, seg_rows, sparse_chain_fits,
+    )
+
+    assert 16 * 128 * 576 > SMEM_IDX_BUDGET          # whole block misses
+    assert seg_rows(128, 548) == 32                  # the rcv1 plan
+    assert seg_rows(128, 15) == 128                  # tiny rows: one tile
+    assert seg_rows(128, 5000) == 0                  # even S=8 misses
+    assert sparse_chain_fits(8, 2544, 47236, 548, 128, 4)   # rcv1 flagship
+    assert not sparse_chain_fits(8, 2544, 47236, 548, 100, 4)  # B % 128
+    assert not sparse_chain_fits(8, 2544, 47236, 5000, 128, 4)
+
+
+def test_sparse_block_auto_dispatch(monkeypatch):
+    """The block dispatch picks the sparse Gram path FROM THE LAYOUT: a
+    sparse dataset whose densified tile cannot fit the fused kernel
+    (d=12000 at K=2, B=128 needs ~18 MB of half-tile) routes through
+    sparse_block_gram with no explicit override; the dense layout of the
+    same rows never does."""
+    import cocoa_tpu.ops.pallas_sparse as ps
+    from cocoa_tpu.ops.pallas_chain import fused_fits
+
+    rng = np.random.default_rng(3)
+    n, d, nnz = 64, 12000, 12
+    cols = np.stack([rng.choice(d, size=nnz, replace=False) for _ in range(n)])
+    cols.sort(axis=1)
+    vals = rng.normal(size=(n, nnz))
+    y = np.where(rng.random(n) > 0.5, 1.0, -1.0)
+    data = LibsvmData(
+        labels=y, indptr=np.arange(0, (n + 1) * nnz, nnz, dtype=np.int64),
+        indices=cols.reshape(-1).astype(np.int32),
+        values=vals.reshape(-1), num_features=d,
+    )
+    k = 2
+    ds = shard_dataset(data, k=k, layout="sparse", dtype=jnp.float32)
+    sa = ds.shard_arrays()
+    assert not fused_fits(k, 128, d, 4, ds.n_shard)
+
+    seen = []
+    real = ps.sparse_block_gram
+
+    def spy(*args, **kw):
+        seen.append(True)
+        return real(*args, **kw)
+
+    monkeypatch.setattr(ps, "sparse_block_gram", spy)
+    w = jnp.zeros(d, jnp.float32)
+    alpha = jnp.zeros((k, ds.n_shard), jnp.float32)
+    idxs = jnp.asarray(
+        sample_indices_per_shard(1, range(1, 2), 8, ds.counts)[:, 0, :]
+    )
+    da, dw = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, n, mode="plus", sigma=2.0, block=128,
+        interpret=True,                       # sparse_gram=None → auto
+    )
+    assert seen, "auto dispatch must take the sparse Gram path"
+    # and the numbers still match the sequential fast path
+    _compare_per_shard(da, dw, sa, w, alpha, idxs, n, "plus", 2.0,
+                       rtol=2e-4, atol=1e-6)
+
+
+def test_sparse_block_rejects_dense_layout(tiny_data):
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float32)
+    with pytest.raises(ValueError, match="padded-CSR"):
+        local_sdca_block_batched(
+            jnp.zeros(tiny_data.num_features, jnp.float32),
+            jnp.zeros((K, ds.n_shard), jnp.float32), ds.shard_arrays(),
+            jnp.zeros((K, 4), jnp.int32), 0.01, tiny_data.n,
+            block=128, interpret=True, sparse_gram=True,
+        )
+
+
+def test_sparse_block_through_driver(tiny_data):
+    """Driver integration (the chunked per_round_batched routing): the
+    sparse Gram block solver reproduces the no-block fast-path trajectory
+    through run_cocoa, including the final duality gap."""
+    ds = shard_dataset(tiny_data, k=K, layout="sparse", dtype=jnp.float32)
+    p = Params(n=tiny_data.n, num_rounds=6, local_iters=20, lam=0.01)
+    dbg = DebugParams(debug_iter=3, seed=0)
+    w_f, a_f, traj_f = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                                 math="fast", pallas=False)
+    w_b, a_b, traj_b = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                                 math="fast", block_size=128,
+                                 block_chain="pallas_interpret",
+                                 block_sparse_gram=True)
+    np.testing.assert_allclose(np.asarray(w_b), np.asarray(w_f),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a_b), np.asarray(a_f),
+                               rtol=2e-4, atol=1e-6)
+    assert traj_b.records[-1].gap == pytest.approx(
+        traj_f.records[-1].gap, rel=1e-3)
+
+
+def test_auto_block_size_per_layout(tiny_data):
+    """--blockSize=auto resolution mirrors the dispatch: dense → 128;
+    sparse → 128 when the fused OR CSR Gram path fits, 0 (sequential)
+    when neither does; non-f32 → 0."""
+    from cocoa_tpu.ops.pallas_chain import fused_fits
+    from cocoa_tpu.solvers.cocoa import auto_block_size
+
+    ds_d = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float32)
+    ds_s = shard_dataset(tiny_data, k=K, layout="sparse", dtype=jnp.float32)
+    assert auto_block_size(ds_d, K, jnp.float32) == 128
+    assert auto_block_size(ds_s, K, jnp.float32) == 128
+    assert auto_block_size(ds_d, K, jnp.float64) == 0
+    # big-d (fused cannot hold the densified tile) + streams too wide for
+    # the SMEM segmentation: neither block kernel wins — sequential stays
+    rng = np.random.default_rng(0)
+    n, d, nnz = 32, 12000, 4
+    cols = np.stack([np.sort(rng.choice(d, size=nnz, replace=False))
+                     for _ in range(n)])
+    data = LibsvmData(
+        labels=np.where(rng.random(n) > 0.5, 1.0, -1.0),
+        indptr=np.arange(0, (n + 1) * nnz, nnz, dtype=np.int64),
+        indices=cols.reshape(-1).astype(np.int32),
+        values=rng.normal(size=n * nnz), num_features=d,
+    )
+    ds_wide = shard_dataset(data, k=2, layout="sparse", dtype=jnp.float32,
+                            max_nnz=5000)
+    assert not fused_fits(2, 128, d, 4, ds_wide.n_shard)
+    assert auto_block_size(ds_wide, 2, jnp.float32) == 0
+
+
+def test_cli_block_size_auto(tmp_path, capsys):
+    """--blockSize=auto through the CLI: rejected without --math=fast,
+    resolved per layout otherwise."""
+    from cocoa_tpu import cli
+    from cocoa_tpu.data.synth import synth_dense, write_libsvm
+
+    path = str(tmp_path / "train.dat")
+    write_libsvm(synth_dense(48, 16, seed=0), path)
+
+    rc = cli.main([f"--trainFile={path}", "--numFeatures=16",
+                   "--blockSize=auto"])
+    assert rc == 2
+    assert "--math=fast" in capsys.readouterr().err
+
+    rc = cli.main([
+        f"--trainFile={path}", "--numFeatures=16", "--numSplits=4",
+        "--numRounds=3", "--localIterFrac=0.5", "--lambda=.01",
+        "--justCoCoA=true", "--debugIter=3", "--math=fast",
+        "--blockSize=auto", "--mesh=1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "blockSize=auto: using 128 for the dense layout" in out
+    assert "CoCoA+" in out
