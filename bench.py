@@ -59,16 +59,30 @@ def _enable_compile_cache():
     """Persistent XLA compilation cache (utils/compile_cache.py): the
     gap-run + slope executables recompile identically across bench
     invocations, and first compiles through the tunnel were a large part
-    of the 25-minute deadline budget."""
+    of the 25-minute deadline budget.  Returns the cache directory (None
+    when disabled) so the first-run breakdown can classify hit vs miss."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from cocoa_tpu.utils import compile_cache
 
-    compile_cache.enable()
+    return compile_cache.enable()
 
 
-def run_tpu() -> tuple[float, float, float, int]:
-    """Returns (steady_seconds, fixed_overhead_s, raw_best_s, comm_rounds)
-    to reach GAP_TARGET.
+def _cache_entries(cache_dir) -> int:
+    """Number of persistent-cache entries (0 when disabled/absent)."""
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return 0
+    return sum(len(fs) for _, _, fs in os.walk(cache_dir))
+
+
+def run_tpu(cache_dir=None):
+    """Returns (steady_seconds, fixed_overhead_s, raw_best_s,
+    raw_first_run_s, compile_cache_mode, comm_rounds) to reach GAP_TARGET.
+
+    ``raw_first_run_s`` is the stopwatch on the FIRST invocation — trace +
+    compile (persistent-cache hit or miss, classified by whether the run
+    added cache entries) + first dispatch + fetch — reported alongside
+    the slope-measured steady state so the 0.0x-second headline cannot be
+    misread as a cold-start claim.
 
     The RAW wall-clock of one run through a tunneled device carries
     hundreds of ms of dispatch+fetch latency that varies run-to-run by more
@@ -109,9 +123,18 @@ def run_tpu() -> tuple[float, float, float, int]:
     # arithmetic to the reference order, same 440-round trajectory
     kw = dict(plus=True, quiet=True, device_loop=True, math="fast")
 
-    # gap-targeted run: verifies the certificate and fixes the round count
+    # gap-targeted run: verifies the certificate and fixes the round count.
+    # The first invocation is timed too — it carries trace + compile (or
+    # persistent-cache hit) + the first dispatch, the fixed costs a user's
+    # stopwatch sees once per process.
     params = Params(n=data.n, num_rounds=MAX_ROUNDS, local_iters=H, lam=LAM)
-    run_cocoa(ds, params, debug, gap_target=GAP_TARGET, **kw)  # compile
+    entries_before = _cache_entries(cache_dir)
+    t0 = time.perf_counter()
+    run_cocoa(ds, params, debug, gap_target=GAP_TARGET, **kw)
+    raw_first = time.perf_counter() - t0
+    cache_mode = ("disabled" if cache_dir is None else
+                  "miss" if _cache_entries(cache_dir) > entries_before
+                  else "hit")
     t0 = time.perf_counter()
     w, alpha, traj = run_cocoa(ds, params, debug, gap_target=GAP_TARGET,
                                **kw)
@@ -138,7 +161,7 @@ def run_tpu() -> tuple[float, float, float, int]:
         return lambda: run_cocoa(ds, p, debug, **kw)
 
     sr = slope_time(make_run, rounds, min_span_s=1.0, reps=5)
-    return sr.steady_s, sr.fixed_s, raw, rounds
+    return sr.steady_s, sr.fixed_s, raw, raw_first, cache_mode, rounds
 
 
 def run_oracle_baseline() -> float:
@@ -198,10 +221,19 @@ def _arm_deadline(minutes: float = 25.0) -> None:
 
 def main() -> int:
     _arm_deadline(float(os.environ.get("COCOA_BENCH_DEADLINE_MIN", "25")))
-    _enable_compile_cache()
+    cache_dir = _enable_compile_cache()
     mode = os.environ.get("COCOA_BENCH_BASELINE", "")
-    elapsed, fixed, raw, rounds = run_tpu()
+    elapsed, fixed, raw, raw_first, cache_mode, rounds = run_tpu(cache_dir)
     fpr = machine_fingerprint()
+    # one-line fixed-cost breakdown (VERDICT r5 weak #6): what separates
+    # the slope-measured steady state from a user's stopwatch — the
+    # first-run trace/compile (cache hit or miss), and the per-run
+    # dispatch+fetch the slope cancels
+    print(f"bench: fixed-cost breakdown — first run {raw_first:.3f}s "
+          f"(compile cache {cache_mode}: trace+compile+first-dispatch "
+          f"{max(0.0, raw_first - raw):.3f}s over a warm run), warm raw "
+          f"run {raw:.3f}s = steady {elapsed:.3f}s + dispatch/fetch "
+          f"{fixed:.3f}s (+ tunnel jitter)", file=sys.stderr)
     if mode == "measure":
         baseline, baseline_mode = run_oracle_baseline(), "measured"
         print(f"bench: pinned oracle {ORACLE_BASELINE_S}s, live-measured "
@@ -235,6 +267,11 @@ def main() -> int:
         # single-run stopwatch adds on top of the steady-state time
         "fixed_overhead_s": round(fixed, 3),
         "raw_best_s": round(raw, 3),
+        # the stopwatch on the FIRST invocation (trace + compile-or-cache
+        # + first dispatch + fetch): the cold number next to the
+        # steady-state headline so neither can be misread as the other
+        "raw_first_run_s": round(raw_first, 3),
+        "compile_cache": cache_mode,
         "baseline_s": round(baseline, 3),
         "baseline_mode": baseline_mode,
         "baseline_fingerprint_match": fpr == ORACLE_FINGERPRINT,

@@ -117,10 +117,25 @@ def main():
         path="fast", pallas=False)
     add("epsilon", "pallas-seq", eps, p_eps, k, layout="dense", nnz=None,
         path="pallas", pallas=True)
-    for b in (128, 256):
+    # B sweep under the fused-fits accounting — the measured ranking
+    # behind --blockSize=auto (pallas_chain.BLOCK_SIZE_PREFERENCE).  At
+    # this shape B=128 rides the fused kernel; B=256 fails fused_fits
+    # (the half-tile is ~29 MB against the 14 MB budget) and takes the
+    # split path (XLA einsums + chain-only kernel); B=512 additionally
+    # fails chain_fits and falls all the way to the XLA fori chain —
+    # each row measures exactly the path the auto dispatch would run.
+    for b, chain in ((128, "pallas"), (256, "pallas"), (512, "xla")):
         add("epsilon", f"block-{b}", eps, p_eps, k, layout="dense",
             nnz=None, path="block", block=b, pallas=False,
-            block_chain="pallas")
+            block_chain=chain)
+    # pipelined-vs-serial A/B: block-128 above runs the two-phase
+    # software-pipelined scan (the default — block b+1's row-tile gather
+    # overlapped with block b's chain kernel); this row pins the serial
+    # schedule so the overlap win is a measured number, not an inference
+    # (bit-identical trajectories, tests/test_block.py)
+    add("epsilon", "block-128-serial", eps, p_eps, k, layout="dense",
+        nnz=None, path="block", block=128, pallas=False,
+        block_chain="pallas", block_pipeline=False)
     # round 5: the distinctness-licensed glue elimination (permuted
     # sampling, one α scatter + one merged (y,q,α₀) gather per round —
     # docs/DESIGN.md §3b-iii).  Same math; the index stream differs from
@@ -129,6 +144,10 @@ def main():
     add("epsilon", "block-128-distinct", eps, p_eps, k, layout="dense",
         nnz=None, path="block", block=128, pallas=False,
         block_chain="pallas", rng="permuted", block_distinct=True)
+    add("epsilon", "block-128-distinct-serial", eps, p_eps, k,
+        layout="dense", nnz=None, path="block", block=128, pallas=False,
+        block_chain="pallas", rng="permuted", block_distinct=True,
+        block_pipeline=False)
 
     n2, d2 = 20242, 47236
     data = synth_sparse(n2, d2, nnz_mean=75, seed=0)
@@ -175,11 +194,14 @@ def main():
                     + " |\n")
         eps_rows = {r["config"]: r["ms_per_round"] for r in rows}
         seq = eps_rows.get("epsilon/pallas-seq")
-        blk = min(v for c, v in eps_rows.items()
-                  if c.startswith("epsilon/block"))
+        # the -serial rows are the pipelining A/B controls — never the
+        # headline, even when tunnel noise ranks one marginally fastest
+        contender = lambda c: (c.startswith("epsilon/block")  # noqa: E731
+                               and not c.endswith("-serial"))
+        blk = min(v for c, v in eps_rows.items() if contender(c))
         if seq and blk:
             best = min(eps_rows, key=lambda c: eps_rows[c]
-                       if c.startswith("epsilon/block") else 1e9)
+                       if contender(c) else 1e9)
             stream = ("its permuted index stream (distinctness licenses "
                       "the merged gather / single α scatter; "
                       "reference-stream rows above share the exact "
@@ -191,6 +213,22 @@ def main():
                 f"Pallas kernel's {seq} ms — **{seq / blk:.2f}x** — with "
                 f"{stream}, same math (trajectory parity pinned by "
                 f"tests/test_block.py).\n"
+            )
+        pip = eps_rows.get("epsilon/block-128")
+        ser = eps_rows.get("epsilon/block-128-serial")
+        dpip = eps_rows.get("epsilon/block-128-distinct")
+        dser = eps_rows.get("epsilon/block-128-distinct-serial")
+        if pip and ser:
+            f.write(
+                f"\nPipelined-vs-serial A/B (the two-phase block scan — "
+                f"block b+1's row-tile gather overlapped with block b's "
+                f"chain kernel, ops/local_sdca.local_sdca_block_batched "
+                f"``pipeline``): reference-rng {ser} → {pip} ms/round "
+                f"(**{ser / pip:.2f}x**)"
+                + (f"; permuted+distinct {dser} → {dpip} ms/round "
+                   f"(**{dser / dpip:.2f}x**)" if dpip and dser else "")
+                + ".  Bit-identical schedules (tests/test_block.py); the "
+                  "serial rows exist only as the A/B control.\n"
             )
         rseq = eps_rows.get("rcv1/pallas-seq")
         rdense = eps_rows.get("rcv1/block-128")

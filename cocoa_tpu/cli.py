@@ -34,6 +34,12 @@ size per data layout — sparse layouts whose densified tile cannot ride
 the fused kernel use the in-kernel CSR Gram path of ops/pallas_sparse
 when it fits, and keep the sequential kernel otherwise, since
 SPLIT-path densified sparse blocks lose to it),
+``--blockPipeline=auto|on|off`` (the two-phase software-pipelined block
+scan: block b+1's row-tile gather overlapped with block b's chain
+kernel — bit-identical schedules, auto = on for multi-block rounds;
+``off`` is the serial A/B control benchmarks/kernels.py measures
+against.  Dense/densified block paths only: the sparse CSR Gram path
+always runs serial and the flag is inert there),
 ``--divergenceGuard=auto|on|off`` (the
 gap-target stall watch; auto arms it only when σ′ is overridden below
 the safe K·γ bound — see solvers/base.resolve_divergence_guard),
@@ -71,7 +77,7 @@ _TPU_FLAGS = ("dtype", "layout", "rng", "math", "loss",
 _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize",
-                "divergenceGuard",
+                "blockPipeline", "divergenceGuard",
                 "elastic", "stallTimeout", "evalDense")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
@@ -393,6 +399,15 @@ def main(argv=None) -> int:
     params = cfg.to_params(n, k)
     debug = cfg.to_debug()
     gap_target = float(extras["gapTarget"]) if extras["gapTarget"] else None
+    if gap_target is not None and dtype == jnp.bfloat16:
+        # the duality gap sits below bf16's ~2^-8 relative resolution, so
+        # a gap-targeted bf16 run cannot certify (docs/DESIGN.md §6;
+        # measured in tests/test_bf16.py) — reject up front with the
+        # remedy instead of burning the round budget
+        print("error: --gapTarget cannot be certified at --dtype=bfloat16 "
+              "(the gap is below bf16 resolution); use --dtype=float32 or "
+              "drop --gapTarget", file=sys.stderr)
+        return 2
     cfg.device_loop = (
         extras["deviceLoop"] is not None
         and str(extras["deviceLoop"]).lower() != "false"
@@ -447,6 +462,17 @@ def main(argv=None) -> int:
         # Gram path fits (a densified sparse block LOSES to the sequential
         # sparse kernel, benchmarks/KERNELS.md)
         block_size = _resolve_auto_block(ds, mesh, k, dtype)
+
+    bp = (extras["blockPipeline"] or "auto").lower()
+    if bp not in ("auto", "on", "off"):
+        print(f"error: --blockPipeline must be auto|on|off, got "
+              f"{extras['blockPipeline']!r}", file=sys.stderr)
+        return 2
+    if bp != "auto" and not (block_size or block_auto):
+        print("error: --blockPipeline controls the block-coordinate scan "
+              "schedule and needs --blockSize", file=sys.stderr)
+        return 2
+    block_pipeline = None if bp == "auto" else (bp == "on")
 
     guard = (extras["divergenceGuard"] or "auto").lower()
     if guard not in ("auto", "on", "off"):
@@ -513,7 +539,8 @@ def main(argv=None) -> int:
             sampling=cfg.sampling,
             gap_target=gap_target, scan_chunk=cfg.scan_chunk,
             math=cfg.math, device_loop=cfg.device_loop,
-            block_size=block_size, divergence_guard=guard, **resume_kw,
+            block_size=block_size, block_pipeline=block_pipeline,
+            divergence_guard=guard, **resume_kw,
         )
         from cocoa_tpu.solvers.prox_cocoa import _metrics_fn
 
@@ -564,7 +591,8 @@ def main(argv=None) -> int:
 
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
                     math=cfg.math, device_loop=cfg.device_loop,
-                    block_size=block_size, divergence_guard=guard)
+                    block_size=block_size, block_pipeline=block_pipeline,
+                    divergence_guard=guard)
 
     def run_all():
         w, alpha, traj = run_cocoa(ds, params, debug, plus=True,
@@ -580,7 +608,7 @@ def main(argv=None) -> int:
                            device_loop=cfg.device_loop)
             w, alpha, traj = run_minibatch_cd(
                 ds, params, debug, math=cfg.math, block_size=block_size,
-                divergence_guard=guard,
+                block_pipeline=block_pipeline, divergence_guard=guard,
                 **loop_kw, **restore("Mini-batch CD"), **common)
             finish(traj, w, alpha)
 

@@ -320,6 +320,7 @@ def local_sdca_block_batched(
     interpret: bool = False,
     distinct: bool = False,
     sparse_gram: "bool | None" = None,
+    pipeline: "bool | None" = None,
 ):
     """All-K-shards block-coordinate round on one chip — the TPU-native
     shape of :func:`local_sdca_block`, and the ``--blockSize`` hot path.
@@ -379,6 +380,31 @@ def local_sdca_block_batched(
     consumes the identical (scal, gq) contract — so trajectory parity
     carries over; the α update stays per-block (``distinct`` is a fused-
     path-only license).
+
+    ``pipeline`` (None = auto: on whenever the round spans more than one
+    block) software-pipelines the dense block scan into a two-phase
+    schedule: the row tile for block b+1 is gathered by block b's scan
+    iteration — as an op with NO data dependence on block b's chain
+    kernel — and rides the scan carry into iteration b+1.  The round-5
+    trace (benchmarks/TRACE.md) showed the serial schedule spending
+    1.17 ms/round in the row-tile gather and ~0.5 ms in a tile copy
+    strictly SERIALIZED with the 1.39 ms chain kernel; the pipelined
+    schedule (a) hands XLA's scheduler a gather whose DMA traffic can
+    overlap the Pallas kernel's execution window, and (b) lands the
+    gather directly in the loop-carried tile buffer instead of a fresh
+    per-iteration allocation, which is what fed the ~0.5 ms ``copy.13``
+    relayout.  The prefetch reorders memory traffic ONLY — every kernel
+    invocation consumes a tile gathered from the same indices by the same
+    gather op, so the pipelined and serial schedules are bit-identical
+    (pinned by tests/test_block.py); the last block prefetches block 0's
+    tile and discards it (one dead gather per round, ~1/nb of the gather
+    budget).  ``False`` restores the serial schedule (the A/B control in
+    benchmarks/kernels.py).  Scope: the fused and split (dense/densified)
+    paths only — the ``sparse_gram`` CSR path returns before the pipeline
+    machinery and always runs its serial schedule (its streams are
+    SMEM-prefetched inside the kernels; an explicit ``pipeline`` value is
+    inert there, so a pipelined-vs-serial A/B on a sparse-Gram config
+    measures nothing).
     """
     from cocoa_tpu.ops.pallas_chain import (
         chain_block_batched, fused_block, fused_fits,
@@ -494,90 +520,117 @@ def local_sdca_block_batched(
         )
         return alpha_final - alpha, wd_delta(wd, d)
 
+    # software pipeline (see the ``pipeline`` docstring note): block b's
+    # scan iteration also issues block b+1's row-tile gather — the one
+    # per-block input with no dependence on b's kernel — so the gather's
+    # HBM traffic can hide behind the chain kernel instead of serializing
+    # with it.  The last block prefetches block 0's tile (discarded).
+    if pipeline is None:
+        pipeline = nb > 1
+    idxs_next = jnp.roll(idxs_b, -1, axis=0) if pipeline else None
+
+    def pipelined_scan(body, carry0, xs):
+        """Run ``body(carry, xb, *x_leaves) -> carry, out`` over the
+        blocks with the row tile double-buffered through the scan carry
+        (pipelined) or gathered in-iteration (serial).  Bit-identical
+        either way: the same gather feeds the same kernel."""
+        if not pipeline:
+            def step(carry, inp):
+                return body(carry, gather_rows(inp[0]), *inp)
+
+            return lax.scan(step, carry0, xs)
+
+        def step(carry, inp):
+            inner, xb = carry
+            bnext = inp[-1]
+            xb_next = gather_rows(bnext)    # block b+1: independent of
+            inner, out = body(inner, xb, *inp[:-1])   # block b's kernel
+            return (inner, xb_next), out
+
+        (carry, _), outs = lax.scan(
+            step, (carry0, gather_rows(idxs_b[0])), (*xs, idxs_next)
+        )
+        return carry, outs
+
     if fused_fits(k, block, d, itemsize,
                   alpha.shape[1]):
-        # idx-only per-draw vectors hoist out of the block scan (they are
-        # tiny — (nb, K, B) — unlike the row tiles, whose hoisting was
-        # measured SLOWER than in-scan gathering, see pallas_chain.py)
-        flat = idxs_b.transpose(1, 0, 2).reshape(k, nb * block)
-        per_block = lambda v: gat(v, flat) \
-            .reshape(k, nb, block).transpose(1, 0, 2)  # noqa: E731
-        idxf_all = idxs_b.astype(dtype)
-        live_all = jnp.broadcast_to(
-            mask_b[:, None, :].astype(dtype), (nb, k, block))
         dw0 = jnp.zeros((k, d), dtype) + 0.0 * w[None]
 
-        def fused_call(dw, bidx, yb, qb, idxf, live, a0b):
-            xb = gather_rows(bidx)
+        def fused_call(dw, xb, bidx, yb, qb, live, a0b):
             if mode == "frozen":
                 v = jnp.broadcast_to(w[None], (k, d)).astype(dtype)
             else:
                 v = w[None] + sig_c * dw
             return fused_block(
-                xb, idxf, yb, qb, a0b, live, v,
+                xb, bidx.astype(dtype), yb, qb, a0b, live, v,
                 lam_n=float(lam * n),
                 coef_div=float(coef_divisor(mode, lam * n)),
                 sig_eff=float(sig_eff), frozen=(mode == "frozen"),
                 loss=loss, smoothing=smoothing, interpret=interpret,
             )
 
+        def live_of(bmask):
+            return jnp.broadcast_to(bmask[None].astype(dtype), (k, block))
+
         if distinct:
-            # pairwise-distinct indices (caller-checked): α₀ for every
-            # block comes from ONE hoisted gather, the per-step deltas
-            # ride out as scan outputs, and α takes ONE batched
-            # scatter-add per round — the per-block α gather/scatter
-            # (the hottest glue in the round-5 trace) vanishes.
-            # The y/q/α₀ gathers also merge into ONE width-3 row gather:
-            # TPU scalar gathers pay per index fetched, and three (K, H)
-            # fetches from the same index vector are pure waste.  The
-            # (K, ns, 3) stack costs one streaming write per round
-            # (~2 µs at epsilon scale) against a saved ~0.6 ms of gather.
+            # pairwise-distinct indices (caller-checked): the per-block α
+            # gather/scatter (the hottest glue in the round-5 trace)
+            # vanishes — α₀ comes from the per-round (K, ns, 3) stack, the
+            # per-step deltas ride out as scan outputs, and α takes ONE
+            # batched scatter-add per round.  The y/q/α₀ gathers also
+            # merge into ONE width-3 row gather per block: TPU scalar
+            # gathers pay per index fetched, and three fetches from the
+            # same index vector are pure waste.  The stack costs one
+            # streaming write per round (~6 µs at epsilon scale) against
+            # ~0.6 ms of saved gather.  Gathering per BLOCK (not one
+            # hoisted per-round gather) keeps the gather carry-independent
+            # — so it pipelines — and kills the (nb, K, B, 3) transposed
+            # staging copy the hoisted form materialized as scan inputs.
             yqa = jnp.stack([labels, sq_norms * qf, alpha], axis=-1)
-            yqa_all = jnp.take_along_axis(
-                yqa, flat[:, :, None], axis=1
-            ).reshape(k, nb, block, 3).transpose(1, 0, 2, 3)   # (nb,K,B,3)
-            yb_all = yqa_all[..., 0]
-            qb_all = yqa_all[..., 1]
-            a0_all = yqa_all[..., 2]
 
-            def block_step(dw, inp):
-                bidx, yb, qb, idxf, live, a0b = inp
-                delta, dwu = fused_call(dw, bidx, yb, qb, idxf, live, a0b)
-                return dw + dwu, delta
+            def body(dw, xb, bidx, bmask):
+                g = jnp.take_along_axis(yqa, bidx[:, :, None], axis=1)
+                yb, qb, a0b = g[..., 0], g[..., 1], g[..., 2]
+                delta, dwu = fused_call(dw, xb, bidx, yb, qb,
+                                        live_of(bmask), a0b)
+                # (a0+δ)−a0 on the gathered values == what the old
+                # alpha.at[].add(δ)−alpha computed at these coordinates,
+                # bit for bit — but scattered into ZEROS below, so α is
+                # never copied to preserve the subtrahend (the donation
+                # miss behind the round-5 trace's copy glue)
+                return dw + dwu, (a0b + delta) - a0b
 
-            dw, deltas = lax.scan(
-                block_step, dw0,
-                (idxs_b, yb_all, qb_all, idxf_all, live_all, a0_all),
-            )                                     # deltas: (nb, K, B)
-            delta_flat = deltas.transpose(1, 0, 2).reshape(k, nb * block)
-            alpha_final = alpha.at[
-                jnp.arange(k)[:, None], flat].add(delta_flat)
-            return alpha_final - alpha, dw
+            dw, dvals = pipelined_scan(body, dw0, (idxs_b, mask_b))
+            flat = idxs_b.transpose(1, 0, 2).reshape(k, nb * block)
+            dval_flat = dvals.transpose(1, 0, 2).reshape(k, nb * block)
+            da = jnp.zeros_like(alpha).at[
+                jnp.arange(k)[:, None], flat].add(dval_flat)
+            return da, dw
 
-        yb_all = per_block(labels)
-        qb_all = per_block(sq_norms) * qf
+        # non-distinct: α must ride the carry (a later block may re-draw
+        # an earlier block's coordinate), but y/q still merge into one
+        # width-2 per-block gather from a per-round stack
+        yq = jnp.stack([labels, sq_norms * qf], axis=-1)
 
-        def block_step(carry, inp):
+        def body(carry, xb, bidx, bmask):
             dw, a_vec = carry            # (K, d), (K, n_shard)
-            bidx, yb, qb, idxf, live = inp
-            delta, dwu = fused_call(dw, bidx, yb, qb, idxf, live,
-                                    gat(a_vec, bidx))
+            g = jnp.take_along_axis(yq, bidx[:, :, None], axis=1)
+            delta, dwu = fused_call(dw, xb, bidx, g[..., 0], g[..., 1],
+                                    live_of(bmask), gat(a_vec, bidx))
             a_vec = a_vec.at[jnp.arange(k)[:, None], bidx].add(delta)
             return (dw + dwu, a_vec), None
 
-        (dw, alpha_final), _ = lax.scan(
-            block_step, (dw0, alpha),
-            (idxs_b, yb_all, qb_all, idxf_all, live_all),
+        (dw, alpha_final), _ = pipelined_scan(
+            body, (dw0, alpha), (idxs_b, mask_b)
         )
         return alpha_final - alpha, dw
 
     # legacy split path: per-block XLA einsums feeding the chain-only
-    # kernel (configs whose half-tile does not fit VMEM)
+    # kernel (configs whose half-tile does not fit VMEM); the row-tile
+    # prefetch applies unchanged — the gather is the same op
 
-    def block_step(carry, inp):
+    def body(carry, xb, bidx, bmask):
         dw, a_vec = carry            # (K, d), (K, n_shard)
-        bidx, bmask = inp            # (K, B), (B,)
-        xb = gather_rows(bidx)
         # the equality tile, directly in the kernel's (B, K, B)
         # j-sliceable layout: eq_t[j, k, i] = (idx_i == idx_j) in shard k
         eq_t = (bidx.T[:, :, None] == bidx[None, :, :]).astype(dtype)
@@ -612,7 +665,7 @@ def local_sdca_block_batched(
         return (dw, a_vec), None
 
     dw0 = jnp.zeros((k, d), dtype) + 0.0 * w[None]  # inherit w's VMA type
-    (dw, alpha_final), _ = lax.scan(
-        block_step, (dw0, alpha), (idxs_b, mask_b)
+    (dw, alpha_final), _ = pipelined_scan(
+        body, (dw0, alpha), (idxs_b, mask_b)
     )
     return alpha_final - alpha, dw

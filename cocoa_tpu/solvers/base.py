@@ -548,22 +548,36 @@ def drive_device_full(
             remaining -= b
 
         done = t - 1
-        # one-ahead sampling: block i+1's index tables are generated on a
-        # daemon host thread while the device executes block i, hiding the
-        # numpy LCG cost behind device time (at epsilon scale both are
-        # ~ms/round).  On early stop the in-flight speculative block is
-        # abandoned — bounded waste, overlapped with the final device block
-        # either way, and the daemon thread cannot delay interpreter exit.
+        # one-ahead sampling WITH pre-staged index specs: block i+1's
+        # tables are generated on a daemon host thread while the device
+        # executes block i — hiding the numpy LCG cost behind device time
+        # (at epsilon scale both are ~ms/round) — and the thread also
+        # reshapes them to the (n_chunks, C, ...) chunk layout and commits
+        # them to the device, so the table's h2d transfer overlaps the
+        # previous block's execution instead of landing on the next
+        # dispatch's critical path (a tunneled device moves these tables
+        # at ~10 MB/s — see IndexSampler).  On early stop the in-flight
+        # speculative block is abandoned — bounded waste, overlapped with
+        # the final device block either way, and the daemon thread cannot
+        # delay interpreter exit.
         start = done + 1
-        fut = _Prefetch(sampler.chunk_indices, start, sizes[0] * c)
+
+        def stage(t0, nb):
+            flat = sampler.chunk_indices(t0, nb * c)
+            reshaped = jax.tree.map(
+                lambda a: a.reshape(nb, c, *a.shape[1:]), flat)
+            if mesh is not None:
+                # committing to the default device would conflict with
+                # the mesh-sharded state at dispatch ("incompatible
+                # devices"); on a mesh let jit place the tables as before
+                return reshaped
+            return jax.tree.map(jax.device_put, reshaped)
+
+        fut = _Prefetch(stage, start, sizes[0])
         for bi, b in enumerate(sizes):
-            flat = fut.result()
+            idxs_all = fut.result()
             if bi + 1 < len(sizes):
-                fut = _Prefetch(sampler.chunk_indices, start + b * c,
-                                sizes[bi + 1] * c)
-            idxs_all = jax.tree.map(
-                lambda a: a.reshape(b, c, *a.shape[1:]), flat
-            )
+                fut = _Prefetch(stage, start + b * c, sizes[bi + 1])
             state, dev_traj = drive_on_device(
                 name, state, chunk_kernel, eval_kernel, idxs_all,
                 shard_arrays, test_arrays, quiet=quiet,

@@ -59,37 +59,51 @@ def _pallas_batched(w, alpha, idxs_kh, shards, params, mode, sigma,
 
 
 def auto_block_size(ds: ShardedDataset, m_local: int, dtype) -> int:
-    """Resolve ``--blockSize=auto`` per data layout (benchmarks/KERNELS.md),
-    mirroring EXACTLY the path local_sdca_block_batched would dispatch to:
+    """Resolve ``--blockSize=auto`` per data layout, mirroring EXACTLY the
+    path local_sdca_block_batched would dispatch to.
 
-    - dense: 128 — the measured-best block size — whenever the lockstep
-      chain kernel fits VMEM;
-    - sparse: 128 when a winning block kernel exists — the fused kernel
+    Candidates are walked in the MEASURED ranking from the
+    benchmarks/kernels.py B sweep (pallas_chain.BLOCK_SIZE_PREFERENCE,
+    recorded in KERNELS.md) — the first candidate that passes the same
+    fit accounting the dispatch layer uses wins, so auto picks the
+    measured-best tile, not just the largest that fits:
+
+    - dense: a candidate fits when the lockstep chain kernel fits VMEM
+      (chain_fits);
+    - sparse: a candidate needs a WINNING block kernel — the fused kernel
       holding the (small-d) densified tile, or otherwise the in-kernel CSR
-      Gram path (ops/pallas_sparse.sparse_chain_fits).  When neither fits,
-      0: a SPLIT-path densified sparse block loses to the sequential
-      sparse kernel, so those configs keep the sequential default;
+      Gram path (ops/pallas_sparse.sparse_chain_fits).  When neither fits
+      any candidate, 0: a SPLIT-path densified sparse block loses to the
+      sequential sparse kernel, so those configs keep the sequential
+      default;
     - anything the f32 chain kernel cannot serve (2/8-byte dtypes,
-      oversized VMEM): 0, the sequential path.
+      oversized VMEM at every candidate): 0, the sequential path.
     """
-    from cocoa_tpu.ops.pallas_chain import chain_fits, fused_fits
+    from cocoa_tpu.ops.pallas_chain import (
+        BLOCK_SIZE_PREFERENCE, chain_fits, fused_fits,
+    )
     from cocoa_tpu.ops.pallas_sparse import sparse_chain_fits
 
-    b = 128
     itemsize = jnp.dtype(dtype).itemsize
-    if itemsize != 4 or not chain_fits(m_local, b, itemsize):
+    if itemsize != 4:
         return 0
-    if ds.layout == "sparse":
-        # same precedence as the block dispatch: the fused kernel first
-        # (densify is cheap when the half-tile fits), the CSR Gram path
-        # when it cannot (the rcv1 regime)
-        if fused_fits(m_local, b, ds.num_features, itemsize, ds.n_shard):
-            return b
-        return b if sparse_chain_fits(
-            m_local, ds.n_shard, ds.num_features,
-            int(ds.sp_indices.shape[-1]), b, itemsize,
-        ) else 0
-    return b
+    for b in BLOCK_SIZE_PREFERENCE:
+        if not chain_fits(m_local, b, itemsize):
+            continue
+        if ds.layout == "sparse":
+            # same precedence as the block dispatch: the fused kernel
+            # first (densify is cheap when the half-tile fits), the CSR
+            # Gram path when it cannot (the rcv1 regime)
+            if not (
+                fused_fits(m_local, b, ds.num_features, itemsize,
+                           ds.n_shard)
+                or sparse_chain_fits(
+                    m_local, ds.n_shard, ds.num_features,
+                    int(ds.sp_indices.shape[-1]), b, itemsize)
+            ):
+                continue
+        return b
+    return 0
 
 
 def _alg_config(params: Params, k: int, plus: Optional[bool], mode=None):
@@ -132,6 +146,7 @@ def _sdca_round_parts(
     block_chain: str = "xla",
     block_distinct: bool = False,
     block_sparse_gram=None,
+    block_pipeline=None,
 ):
     """The per-shard local update and driver-side apply shared by the
     per-round and chunked builders (so the two paths cannot diverge), for
@@ -145,7 +160,11 @@ def _sdca_round_parts(
     kernel — ops/pallas_sdca.py for the dense layout, ops/pallas_sparse.py
     for padded-CSR.  ``block > 0`` runs the fast inner loop as the
     block-coordinate MXU kernel (ops/local_sdca.local_sdca_block) with that
-    block size.  Returns (per_shard, per_round_batched | None, apply_fn)."""
+    block size; ``block_pipeline`` (None = auto) controls the two-phase
+    software-pipelined block scan — next block's row-tile gather overlapped
+    with the current chain kernel, bit-identical schedules (see
+    local_sdca_block_batched).  Returns (per_shard, per_round_batched |
+    None, apply_fn)."""
     if math not in ("exact", "fast"):
         raise ValueError(f"math must be 'exact' or 'fast', got {math!r}")
     if block and pallas:
@@ -188,6 +207,7 @@ def _sdca_round_parts(
             sigma=sigma, loss=params.loss, smoothing=params.smoothing,
             block=block, interpret=(block_chain == "pallas_interpret"),
             distinct=block_distinct, sparse_gram=block_sparse_gram,
+            pipeline=block_pipeline,
         )
 
     def per_shard(w, alpha_k, idxs_k, shard_k):
@@ -335,6 +355,7 @@ def run_sdca_family(
     block_size: int = 0,
     block_chain=None,
     block_sparse_gram=None,
+    block_pipeline=None,
     device_loop: bool = False,
     eval_fn=None,
     eval_kernel=None,
@@ -388,6 +409,14 @@ def run_sdca_family(
     base come from SMEM CSR streams in-kernel and the Δw apply is a sparse
     scatter (ops/pallas_sparse) — no (K, B, d) densify.
 
+    ``block_pipeline`` (None = auto: on for multi-block rounds; flag
+    ``--blockPipeline``) software-pipelines the dense block scan: block
+    b+1's row-tile gather rides block b's scan iteration with no data
+    dependence on its chain kernel, so the gather traffic can hide behind
+    the kernel.  Bit-identical to the serial schedule
+    (local_sdca_block_batched; parity pinned by tests/test_block.py);
+    ``False`` is the A/B control benchmarks/kernels.py measures against.
+
     ``divergence_guard`` ("auto" | "on" | "off", flag --divergenceGuard)
     controls the gap-target stall watch: auto arms it only when σ′ is
     overridden below the safe K·γ bound (base.resolve_divergence_guard).
@@ -403,6 +432,20 @@ def run_sdca_family(
               f"distributed over {k} workers")
 
     dtype = ds.labels.dtype
+    if gap_target is not None and dtype == jnp.bfloat16:
+        # bf16 cannot certify a small duality gap: the dual objective's
+        # Σα/n accumulation and the primal−dual cancellation both sit
+        # below bf16's ~2^-8 relative resolution, so the computed gap is
+        # noise at 1e-4 scale and the trajectory stalls far above it
+        # (measured in tests/test_bf16.py; predicted by docs/DESIGN.md
+        # §6).  A gap-targeted bf16 run would either burn its whole round
+        # budget or "certify" on rounding artifacts — reject it instead.
+        raise ValueError(
+            "gap-targeted runs cannot certify in bfloat16 (the duality "
+            "gap is below bf16 resolution — docs/DESIGN.md §6); use "
+            "--dtype=float32, or drop --gapTarget for an uncertified "
+            "bf16 run"
+        )
     w = jnp.zeros(ds.num_features, dtype=dtype) if w_init is None else jnp.array(w_init, dtype=dtype, copy=True)
     alpha = (
         jnp.zeros((k, ds.n_shard), dtype=dtype)
@@ -507,6 +550,7 @@ def run_sdca_family(
         pallas_interpret=(pallas and platform == "cpu"),
         block=block_size, block_chain=block_chain,
         block_sparse_gram=block_sparse_gram,
+        block_pipeline=block_pipeline,
         # permuted sampling with n_local % H == 0 keeps every round inside
         # one epoch's permutation, so the round's H draws are pairwise
         # distinct per shard — the license for the block kernel's
@@ -565,7 +609,7 @@ def run_sdca_family(
 
         cache_key = (
             "sdca", alg_name, alg, math, pallas, block_size, block_chain,
-            block_sparse_gram,
+            block_sparse_gram, block_pipeline,
             sampler.cache_token(), k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,

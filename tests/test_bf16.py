@@ -1,0 +1,121 @@
+"""bf16: certify or retire (VERDICT r5 weak #5).
+
+README advertises ``--dtype=bfloat16``; docs/DESIGN.md §6 predicts a 1e-4
+duality gap CANNOT be certified in bf16 (the dual objective's Σα/n
+accumulation and the primal−dual cancellation sit below bf16's ~2^-8
+relative resolution).  These tests measure that prediction — the bf16
+trajectory's computed gap is quantization noise at 1e-4 scale (it reads
+exactly 0.0 on some evals while the f64-recomputed gap of the same
+iterate is ~20x the target) and the x-accumulated iterate itself stalls
+above the target — and pin the consequence: gap-targeted bf16 runs are
+REJECTED with the remedy, at the solver API and at the CLI.  Uncertified
+(fixed-round) bf16 runs stay allowed; the fori_loop path runs them (the
+Pallas kernels gate on itemsize == 4).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.data.synth import synth_dense
+from cocoa_tpu.evals import objectives
+from cocoa_tpu.solvers import run_cocoa
+
+K = 4
+LAM = 1e-3
+GAP_TARGET = 1e-4
+
+
+@pytest.fixture(scope="module")
+def dense_data():
+    # big enough that 200 rounds drive the f32 gap well below where bf16
+    # stalls, small enough for the fast suite
+    return synth_dense(512, 32, seed=3)
+
+
+def _run(data, dtype, rounds=200):
+    ds = shard_dataset(data, k=K, layout="dense", dtype=dtype)
+    p = Params(n=data.n, num_rounds=rounds, local_iters=32, lam=LAM)
+    dbg = DebugParams(debug_iter=25, seed=0)
+    w, a, traj = run_cocoa(ds, p, dbg, plus=True, quiet=True, math="fast")
+    return w, a, traj
+
+
+def _true_gap(data, w, alpha):
+    """The exact duality gap of the iterate, recomputed in f64 — what the
+    certificate claims to measure."""
+    ds64 = shard_dataset(data, k=K, layout="dense", dtype=jnp.float64)
+    _, gap, _ = objectives.evaluate(
+        ds64, jnp.asarray(np.asarray(w, np.float64)),
+        jnp.asarray(np.asarray(alpha, np.float64)), LAM)
+    return float(gap)
+
+
+def test_bf16_gap_certificate_is_noise_at_target_scale(dense_data):
+    """The demo-config-shaped trajectory at --dtype=bfloat16 (x-accum):
+    the bf16-COMPUTED gap disagrees with the f64-recomputed gap of the
+    same state by more than the 1e-4 target (measured: it quantizes to
+    exactly 0.0 on some evals — a spurious certificate), and the bf16
+    iterate itself stalls above the target while the f32 twin keeps
+    descending.  The f32 control's computed gap tracks its true gap to
+    well under the target — the certificate is trustworthy exactly where
+    the kernels run it."""
+    w16, a16, traj16 = _run(dense_data, jnp.bfloat16)
+    w32, a32, traj32 = _run(dense_data, jnp.float32)
+
+    true16 = _true_gap(dense_data, w16, a16)
+    true32 = _true_gap(dense_data, w32, a32)
+    comp16 = float(traj16.records[-1].gap)
+    comp32 = float(traj32.records[-1].gap)
+
+    # f32: the computed certificate measures the true gap at target scale
+    assert abs(comp32 - true32) < GAP_TARGET / 2
+    # bf16: the computed certificate is off by MORE than the target —
+    # a gap-targeted run would stop on rounding artifacts
+    assert abs(comp16 - true16) > GAP_TARGET
+    # and the x-accumulated bf16 iterate cannot reach the target anyway:
+    # it stalls above both the target and the f32 twin's true gap
+    assert true16 > GAP_TARGET
+    assert true16 > true32
+
+
+def test_bf16_gap_target_rejected(dense_data):
+    """Gap-targeted bf16 runs are rejected with the remedy (the
+    certificate they would stop on is unmeasurable — see above)."""
+    ds = shard_dataset(dense_data, k=K, layout="dense", dtype=jnp.bfloat16)
+    p = Params(n=dense_data.n, num_rounds=10, local_iters=8, lam=LAM)
+    with pytest.raises(ValueError, match="bfloat16"):
+        run_cocoa(ds, p, DebugParams(debug_iter=5, seed=0), plus=True,
+                  quiet=True, math="fast", gap_target=GAP_TARGET)
+
+
+def test_bf16_fixed_rounds_still_run(dense_data):
+    """Uncertified bf16 runs stay allowed — storage-dtype experiments are
+    legitimate; only the certificate claim is rejected."""
+    w, a, traj = _run(dense_data, jnp.bfloat16, rounds=4)
+    assert w.dtype == jnp.bfloat16
+    assert len(traj.records) == 0 or np.isfinite(
+        float(traj.records[-1].primal))
+
+
+def _write_tiny_libsvm(path):
+    rows = ["+1 1:0.5 3:1.0", "-1 2:0.25 4:0.5", "+1 1:0.75",
+            "-1 3:0.5 4:0.25"] * 8
+    path.write_text("\n".join(rows) + "\n")
+
+
+def test_cli_rejects_bf16_gap_target(tmp_path, capsys):
+    from cocoa_tpu import cli
+
+    train = tmp_path / "tiny.dat"
+    _write_tiny_libsvm(train)
+    rc = cli.main([
+        f"--trainFile={train}", "--numFeatures=4", "--numSplits=2",
+        "--numRounds=4", "--localIterFrac=0.5", "--lambda=.01",
+        "--justCoCoA=true", "--debugIter=2", "--dtype=bfloat16",
+        "--gapTarget=1e-4", "--mesh=1",
+    ])
+    assert rc == 2
+    assert "bfloat16" in capsys.readouterr().err

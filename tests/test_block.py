@@ -537,6 +537,125 @@ def test_batched_chain_distinct_matches_per_block(tiny_data, mode, sigma, h):
     np.testing.assert_array_equal(np.asarray(dw_d), np.asarray(dw_p))
 
 
+@pytest.mark.parametrize("distinct", [False, True])
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
+                                        ("frozen", 1.0)])
+def test_pipelined_fused_matches_serial_bit_exact(mode, sigma, distinct):
+    """The two-phase software-pipelined block scan (row tile for block
+    b+1 gathered during block b's chain kernel, riding the scan carry)
+    must be BIT-identical to the serial schedule: the prefetch reorders
+    memory traffic, never math — every kernel invocation consumes a tile
+    gathered from the same indices by the same gather op.  h=200 > B=128
+    spans two blocks, the only case where the pipeline differs from the
+    serial scan at all; f32 so the fused branch actually runs."""
+    from cocoa_tpu.data.synth import synth_dense
+    from cocoa_tpu.ops.local_sdca import local_sdca_block_batched
+    from cocoa_tpu.ops.pallas_chain import fused_fits
+
+    k, h = 2, 200
+    data = synth_dense(640, 32, seed=3)
+    ds = shard_dataset(data, k=k, layout="dense", dtype=jnp.float32)
+    sa = ds.shard_arrays()
+    d = data.num_features
+    assert fused_fits(k, 128, d, 4, ds.n_shard), \
+        "test config must exercise the fused branch"
+    rng = np.random.default_rng(11)
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(k, ds.n_shard)) * 0.3 + 0.3, 0, 1),
+        jnp.float32,
+    )
+    if distinct:
+        # the distinct license requires pairwise-distinct draws per shard
+        idxs = jnp.asarray(np.stack([
+            rng.permutation(int(c))[:h] for c in ds.counts
+        ]).astype(np.int32))
+    else:
+        idxs = jnp.asarray(
+            sample_indices_per_shard(7, range(1, 2), h, ds.counts)[:, 0, :]
+        )
+    kw = dict(mode=mode, sigma=sigma, block=128, interpret=True,
+              distinct=distinct)
+    da_s, dw_s = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, data.n, pipeline=False, **kw)
+    da_p, dw_p = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, data.n, pipeline=True, **kw)
+    np.testing.assert_array_equal(np.asarray(da_p), np.asarray(da_s))
+    np.testing.assert_array_equal(np.asarray(dw_p), np.asarray(dw_s))
+
+
+def test_pipelined_split_matches_serial_bit_exact(tiny_data):
+    """Same schedule contract on the legacy split path (float64 fails
+    fused_fits's itemsize gate, so this pins the einsum+chain-kernel
+    fallback): the prefetched row tile feeds identical einsums."""
+    from cocoa_tpu.ops.local_sdca import local_sdca_block_batched
+
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float64)
+    sa = ds.shard_arrays()
+    rng = np.random.default_rng(5)
+    d = tiny_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, ds.n_shard)) * 0.3 + 0.3, 0, 1)
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 200, ds.counts)[:, 0, :]
+    )  # 200 > B=128: two blocks, so the pipeline actually differs
+    kw = dict(mode="plus", sigma=4.0, block=128, interpret=True)
+    da_s, dw_s = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, pipeline=False, **kw)
+    da_p, dw_p = local_sdca_block_batched(
+        w, alpha, sa, idxs, 0.01, tiny_data.n, pipeline=True, **kw)
+    np.testing.assert_array_equal(np.asarray(da_p), np.asarray(da_s))
+    np.testing.assert_array_equal(np.asarray(dw_p), np.asarray(dw_s))
+
+
+def test_pipelined_through_driver_matches_serial(tiny_data):
+    """Driver-level A/B: ``block_pipeline`` on/off through run_cocoa
+    (chunked driver, interpret chain) produces the same trajectory — the
+    flag changes the schedule, never the observable run."""
+    ds = shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float32)
+    p = _params(tiny_data, num_rounds=4)
+    dbg = DebugParams(debug_iter=4, seed=0)
+    outs = {}
+    for pipe in (False, True):
+        outs[pipe] = run_cocoa(ds, p, dbg, plus=True, quiet=True,
+                               math="fast", block_size=128,
+                               block_chain="pallas_interpret",
+                               block_pipeline=pipe, scan_chunk=2)
+    w_s, a_s, traj_s = outs[False]
+    w_p, a_p, traj_p = outs[True]
+    np.testing.assert_array_equal(np.asarray(w_p), np.asarray(w_s))
+    np.testing.assert_array_equal(np.asarray(a_p), np.asarray(a_s))
+    assert [r.gap for r in traj_p.records] == [r.gap for r in traj_s.records]
+
+
+def test_cli_block_pipeline_flag(tmp_path, capsys):
+    """--blockPipeline validates its value and requires --blockSize."""
+    from cocoa_tpu import cli
+
+    train = tmp_path / "tiny.dat"
+    train.write_text("\n".join(
+        ["+1 1:0.5 3:1.0", "-1 2:0.25 4:0.5", "+1 1:0.75",
+         "-1 3:0.5 4:0.25"] * 8) + "\n")
+    base = [f"--trainFile={train}", "--numFeatures=4", "--numSplits=2",
+            "--numRounds=4", "--localIterFrac=0.5", "--lambda=.01",
+            "--justCoCoA=true", "--debugIter=2", "--mesh=1"]
+    rc = cli.main(base + ["--math=fast", "--blockSize=8",
+                          "--blockPipeline=banana"])
+    assert rc == 2
+    assert "--blockPipeline" in capsys.readouterr().err
+
+    rc = cli.main(base + ["--blockPipeline=on"])
+    assert rc == 2
+    assert "--blockSize" in capsys.readouterr().err
+
+    rc = cli.main(base + ["--math=fast", "--blockSize=8",
+                          "--blockPipeline=off"])
+    assert rc == 0
+    assert "CoCoA+" in capsys.readouterr().out
+
+
 def test_block_distinct_through_driver_permuted(tiny_data, monkeypatch):
     """End-to-end: the driver auto-enables the distinct α update for
     permuted sampling exactly when counts % H == 0 (observed via a spy on
