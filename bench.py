@@ -51,7 +51,10 @@ DEBUG_ITER = 10
 LAM = 1e-3
 K = 4
 H = 50
-TRAIN = "/root/reference/data/small_train.dat"
+_REF_TRAIN = "/root/reference/data/small_train.dat"
+TRAIN = (_REF_TRAIN if os.path.exists(_REF_TRAIN) else
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "data", "small_train.dat"))  # committed twin
 D = 9947
 
 

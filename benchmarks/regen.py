@@ -1,0 +1,124 @@
+"""Re-run exactly the ``needs-TPU-regen`` benchmark rows in one command.
+
+Several sessions landed kernel-level changes (the sparse block-chain
+kernel, the pipelined block round) with no TPU attached, so
+KERNELS.md/RESULTS.md still carry rows measured on the PRE-change kernels,
+marked with a ``needs-TPU-regen`` banner and per-row ``⚠`` flags.  This
+script is the one-command refresh for the next session that has hardware:
+
+    python benchmarks/regen.py            # refuses off-TPU, lists stale rows
+    python benchmarks/regen.py --list     # just list the stale rows
+
+What it runs (exactly the marked surface, nothing else):
+
+- ``benchmarks/kernels.py`` — regenerates KERNELS.md including the
+  pipelined-vs-serial A/B rows (``block-128`` vs ``block-128-serial``,
+  distinct twins) and the B ∈ {128, 256, 512} sweep behind
+  ``--blockSize=auto``'s measured ranking;
+- ``benchmarks/run.py --only epsilon,losses`` — the ⚠ block rows
+  (epsilon-cocoa+(block128), permuted+block128, smooth_hinge/logistic
+  block rows);
+- ``benchmarks/run.py --only rcv1`` — the rcv1 production headline row
+  whose vs_oracle_parallel columns are currently derived, not measured.
+
+On success the ``needs-TPU-regen`` banners and per-row ⚠ marks are
+dropped from both files (the regenerated tables ARE the fresh
+measurement).  ``--only`` restricts the run; banners are only stripped on
+a full pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DOCS = ("KERNELS.md", "RESULTS.md")
+MARK = "needs-TPU-regen"
+
+
+def stale_rows():
+    """(file, row-config) pairs still carrying the ⚠ mark."""
+    out = []
+    for doc in DOCS:
+        path = os.path.join(HERE, doc)
+        if not os.path.exists(path):
+            continue
+        for line in open(path):
+            if line.startswith("|") and "⚠" in line:
+                out.append((doc, line.split("|")[1].strip()))
+    return out
+
+
+def tpu_attached() -> bool:
+    import jax
+
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
+def strip_banners():
+    """Drop the needs-TPU-regen blockquote banners and per-row ⚠ marks —
+    only called after a successful FULL regen, when the tables just
+    rewritten are the fresh measurement."""
+    for doc in DOCS:
+        path = os.path.join(HERE, doc)
+        if not os.path.exists(path):
+            continue
+        src = open(path).read()
+        src = re.sub(r"^> \*\*⚠ " + MARK + r":\*\*.*?\n\n", "", src,
+                     flags=re.S | re.M)
+        src = src.replace(" ⚠ |", " |").replace(" ⚠|", "|")
+        open(path, "w").write(src)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--list", action="store_true",
+                    help="list the stale rows and exit")
+    ap.add_argument("--only", default="",
+                    help="restrict to a subset: kernels,epsilon,losses,rcv1 "
+                         "(banner stripping then stays off)")
+    args = ap.parse_args()
+
+    rows = stale_rows()
+    print(f"{len(rows)} row(s) marked {MARK}:")
+    for doc, cfg in rows:
+        print(f"  {doc}: {cfg}")
+    if args.list:
+        return 0
+    if not tpu_attached():
+        print(f"\nno TPU attached — refusing to overwrite the marked rows "
+              f"with CPU numbers.  Attach hardware and rerun "
+              f"`python {os.path.relpath(__file__)}`.", file=sys.stderr)
+        return 1
+
+    only = set(args.only.split(",")) if args.only else None
+    py = sys.executable
+
+    def run(argv):
+        print("+", " ".join(argv), flush=True)
+        subprocess.run(argv, check=True)
+
+    if only is None or "kernels" in only:
+        run([py, os.path.join(HERE, "kernels.py")])
+    run_only = [s for s in ("epsilon", "losses", "rcv1")
+                if only is None or s in only]
+    if run_only:
+        run([py, os.path.join(HERE, "run.py"),
+             f"--only={','.join(run_only)}"])
+
+    if only is None:
+        strip_banners()
+        print("regen complete — banners and ⚠ marks dropped from "
+              + ", ".join(DOCS))
+    else:
+        print("partial regen complete — banners left in place "
+              "(rerun without --only for the full pass)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,8 +27,16 @@ def save(
     w: jax.Array,
     alpha: Optional[jax.Array] = None,
     seed: int = 0,
+    sched: Optional[jax.Array] = None,
 ) -> str:
     """Write checkpoint for ``round_t``; returns the file path.
+
+    ``sched`` is the σ′-schedule / watch state of a ``--sigmaSchedule``
+    run (solvers/base.py SCHED layout, a tiny float32 vector).  It rides
+    the meta JSON rather than the array set: every float32 is exactly
+    representable as a JSON double, so the round trip is bit-identical —
+    which is what makes a mid-schedule ``--resume`` reproduce the
+    uninterrupted trajectory — and old checkpoints/readers stay valid.
 
     Crash-safe: both files are written to temp names and renamed in, the
     ``.npz`` LAST — :func:`latest` discovers checkpoints by the ``.npz``,
@@ -40,6 +48,11 @@ def save(
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
     meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
+    if sched is not None:
+        # float32 -> python float is exact; json.dump emits Infinity for
+        # the watch's untouched best-gap slots (python json reads it back)
+        meta["sched"] = [float(v) for v in
+                         np.asarray(sched, dtype=np.float32)]
     if (isinstance(alpha, jax.Array) and not alpha.is_fully_addressable):
         # multi-host run: each process holds only its dp shards of alpha.
         # Gather the full array on every host so each writes a complete,

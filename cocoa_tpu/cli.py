@@ -45,8 +45,16 @@ gap-target stall watch; auto arms it only when σ′ is overridden below
 the safe K·γ bound — see solvers/base.resolve_divergence_guard),
 ``--sigma`` (σ′ override — below the
 safe K·γ it buys comm-rounds on randomly partitioned data; ``auto``
-tries K·γ/2 and falls back to K·γ when the divergence guard fires,
-needs --gapTarget), ``--elastic=N`` (gang supervisor: N worker
+starts at the aggressive K·γ/2, needs --gapTarget),
+``--sigmaSchedule=anneal|trial`` (how --sigma=auto reacts when the stall
+watch fires: ``anneal`` — the default — backs σ′ off multiplicatively
+toward the safe K·γ *inside* the device loop, continuing from the
+current iterate with no restart; ``trial`` is the pre-schedule
+trial-then-rerun A/B control, preserved bit-exact.  ``anneal`` with an
+explicit sub-safe ``--sigma=<float>`` anneals from that start),
+``--warmStart=<s>,<rounds>`` (smooth_hinge(s) warm phase handing off to
+hinge at the first debugIter boundary ≥ rounds, inside the same device
+loop; requires --loss=hinge), ``--elastic=N`` (gang supervisor: N worker
 processes, restart-from-checkpoint on any death), and
 ``--stallTimeout=S`` (with --elastic: also restart a gang that stops
 making checkpoint progress for S seconds without any process dying).
@@ -78,6 +86,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize",
                 "blockPipeline", "divergenceGuard",
+                "sigmaSchedule", "warmStart",
                 "elastic", "stallTimeout", "evalDense")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
@@ -192,6 +201,54 @@ def main(argv=None) -> int:
               "triggers on the divergence guard, which runs on the "
               "gap-target path)", file=sys.stderr)
         return 2
+
+    sigma_schedule = extras["sigmaSchedule"]
+    if sigma_schedule is not None and sigma_schedule not in ("trial",
+                                                             "anneal"):
+        print(f"error: --sigmaSchedule must be trial|anneal, got "
+              f"{extras['sigmaSchedule']!r}", file=sys.stderr)
+        return 2
+    if sigma_schedule == "trial" and cfg.sigma != "auto":
+        print("error: --sigmaSchedule=trial is the --sigma=auto A/B "
+              "control and needs --sigma=auto", file=sys.stderr)
+        return 2
+    anneal_engages = (cfg.sigma == "auto"
+                      or (isinstance(cfg.sigma, float)
+                          and 0 < cfg.sigma < cfg.num_splits * cfg.gamma))
+    if (sigma_schedule == "anneal" and anneal_engages
+            and not extras["gapTarget"]):
+        # the anneal backoff rides the stall watch, which only runs on the
+        # gap-target path (with no sub-safe σ′ the schedule is inert and
+        # the flag is accepted as a no-op)
+        print("error: --sigmaSchedule=anneal requires --gapTarget (the "
+              "in-loop backoff triggers on the stall watch, which runs "
+              "on the gap-target path)", file=sys.stderr)
+        return 2
+
+    warm_start = None
+    if extras["warmStart"]:
+        parts = str(extras["warmStart"]).split(",")
+        try:
+            if len(parts) != 2:
+                raise ValueError
+            warm_start = (float(parts[0]), int(parts[1]))
+        except ValueError:
+            print(f"error: --warmStart takes <smoothing>,<rounds> (e.g. "
+                  f"0.1,300), got {extras['warmStart']!r}", file=sys.stderr)
+            return 2
+        if warm_start[0] <= 0 or warm_start[1] < 1:
+            print("error: --warmStart needs smoothing > 0 and rounds >= 1",
+                  file=sys.stderr)
+            return 2
+        if cfg.loss != "hinge":
+            print("error: --warmStart hands a smooth_hinge phase off to "
+                  "hinge and requires --loss=hinge", file=sys.stderr)
+            return 2
+        if cfg.debug_iter <= 0:
+            print("error: --warmStart requires --debugIter > 0 (the "
+                  "in-loop handoff lands on the eval cadence)",
+                  file=sys.stderr)
+            return 2
 
     if extras["stallTimeout"] and not extras["elastic"]:
         # without a supervisor there is no watchdog to act on the timeout —
@@ -479,10 +536,14 @@ def main(argv=None) -> int:
         print(f"error: --divergenceGuard must be auto|on|off, got "
               f"{extras['divergenceGuard']!r}", file=sys.stderr)
         return 2
-    if cfg.sigma == "auto" and guard == "off":
-        # the σ′ trial's only exit from a bad guess IS the guard
-        print("error: --sigma=auto requires the divergence guard; drop "
-              "--divergenceGuard=off", file=sys.stderr)
+    if guard == "off" and (
+            cfg.sigma == "auto"
+            or (sigma_schedule == "anneal" and anneal_engages)):
+        # the guard's firing IS the schedule's only exit from a bad σ′
+        # guess (trial restart or in-loop anneal backoff alike)
+        print("error: --sigma=auto / --sigmaSchedule=anneal require the "
+              "divergence guard; drop --divergenceGuard=off",
+              file=sys.stderr)
         return 2
 
     if objective == "lasso":
@@ -552,9 +613,14 @@ def main(argv=None) -> int:
         return 0
 
     def restore(algorithm):
-        """(w_init, alpha_init, start_round) from the latest checkpoint."""
+        """(w_init, alpha_init, start_round[, sched_init]) from the latest
+        checkpoint.  ``sched_init`` (present on --sigmaSchedule/--warmStart
+        runs) restores the σ′-schedule stage and stall-watch counters so a
+        mid-schedule resume is bit-identical to the uninterrupted run."""
         if not resume:
             return dict()
+        import numpy as _np
+
         from cocoa_tpu import checkpoint as ckpt_lib
 
         path = ckpt_lib.latest(cfg.chkpt_dir, algorithm)
@@ -565,6 +631,8 @@ def main(argv=None) -> int:
         out = dict(w_init=w0, start_round=meta["round"] + 1)
         if a0 is not None:
             out["alpha_init"] = a0
+        if meta.get("sched") is not None:
+            out["sched_init"] = _np.asarray(meta["sched"], _np.float32)
         return out
 
     def finish(traj, w, alpha=None):
@@ -592,7 +660,8 @@ def main(argv=None) -> int:
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
                     math=cfg.math, device_loop=cfg.device_loop,
                     block_size=block_size, block_pipeline=block_pipeline,
-                    divergence_guard=guard)
+                    divergence_guard=guard, sigma_schedule=sigma_schedule,
+                    warm_start=warm_start)
 
     def run_all():
         w, alpha, traj = run_cocoa(ds, params, debug, plus=True,

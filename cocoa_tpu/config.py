@@ -31,9 +31,13 @@ class Params:
                                 # by the other losses)
     sigma: Optional[float] = None  # σ′ subproblem-coupling override (extension;
                                 # None = the reference's safe bound K·γ,
-                                # CoCoA.scala:45; the string "auto" = try
-                                # the aggressive K·γ/2 and fall back to
-                                # K·γ when the divergence guard fires —
+                                # CoCoA.scala:45; the string "auto" =
+                                # start at the aggressive K·γ/2 and back
+                                # off toward K·γ when the stall watch
+                                # fires — in place on the device by
+                                # default (--sigmaSchedule=anneal), or
+                                # via the trial-then-rerun A/B control
+                                # (--sigmaSchedule=trial) —
                                 # solvers/cocoa.run_cocoa).  K·γ assumes worst-case
                                 # cross-shard coherence; random shards
                                 # tolerate less — measured on the rcv1

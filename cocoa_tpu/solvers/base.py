@@ -64,6 +64,129 @@ def stall_window(debug_iter: int) -> int:
     return max(STALL_EVALS, -(-STALL_ROUNDS // max(1, int(debug_iter))))
 
 
+# --- device-resident σ′ schedule (--sigmaSchedule=anneal) -------------------
+#
+# The sigma=auto trial-and-rerun (solvers/cocoa.run_cocoa, --sigmaSchedule=
+# trial) pays for a wrong aggressive guess twice: the guarded trial burns a
+# stall window AND the safe rerun restarts from round 1.  The anneal
+# schedule instead carries σ′ IN the driver ladder's loop state: start
+# aggressive, and when the stall watch fires, multiply σ′ toward the safe
+# K·γ bound IN PLACE and keep going from the current iterate.  That is
+# sound because the primal-dual correspondence w = (1/λn)·Σ y·α·x and the
+# box constraint α ∈ [0,1]^n — everything the exact duality-gap
+# certificate rests on — are maintained by the update rule under ANY σ′:
+# σ′ only scales the local subproblem's coupling term, so any (w, α) pair
+# a σ′-a run produced is a feasible starting point for a σ′-b run and the
+# certificate stays exact across the switch.  The cost of a wrong guess
+# drops from (stall window + full restart) to (stall window), and the
+# iterate progress made before the backoff is kept, not discarded.
+#
+# The schedule state is a tiny float32 vector riding the solver state
+# tuple (so it is donated, checkpointed, and resumed with w and α — a
+# mid-schedule --resume is bit-identical):
+#
+#   sched[0] = stage       index into the static σ′ ladder
+#   sched[1] = stall       consecutive no-improvement evals at this stage
+#   sched[2] = best        best gap seen since the stage started
+#   sched[3] = best_prev   best at the last watch reset (the _GapWatch twin)
+#   sched[4] = t_next      1-based round the NEXT chunk starts at (the
+#                          chunk kernels advance it; the warm-start loss
+#                          handoff reads it — solvers/cocoa.py)
+#
+# All five values are small integers or f32 gaps, so float32 carries them
+# exactly; stage/stall arithmetic in f32 is exact far beyond any real
+# ladder or window length.
+SCHED_LEN = 5
+MAX_SIGMA_LEVELS = 8
+
+
+def anneal_levels(start: float, safe: float, factor: float = 2.0,
+                  max_levels: int = MAX_SIGMA_LEVELS) -> tuple:
+    """The static σ′ ladder: geometric from the aggressive ``start`` up to
+    the paper-safe ``safe`` = K·γ (always the final rung — the schedule can
+    never anneal PAST safety; a ladder that would exceed ``max_levels``
+    jumps straight to safe on its last step)."""
+    if start >= safe:
+        return (float(safe),)
+    levels = [float(start)]
+    while levels[-1] * factor < safe and len(levels) < max_levels - 1:
+        levels.append(levels[-1] * factor)
+    levels.append(float(safe))
+    return tuple(levels)
+
+
+def sched_init_array(start_round: int, sched_init=None):
+    """The initial sched vector (see the layout note above): a restored
+    mid-schedule state, or a fresh stage-0 watch starting at
+    ``start_round``."""
+    import jax.numpy as jnp
+
+    if sched_init is not None:
+        s = np.asarray(sched_init, dtype=np.float32)
+        if s.shape != (SCHED_LEN,):
+            raise ValueError(
+                f"restored sigma-schedule state has shape {s.shape}, "
+                f"expected ({SCHED_LEN},) — was the checkpoint written by "
+                f"an incompatible version?")
+        return jnp.asarray(s)
+    return jnp.asarray(
+        np.array([0.0, 0.0, np.inf, np.inf, float(start_round)],
+                 dtype=np.float32))
+
+
+def _watch_update(xp, gv, best, best_prev, stall, rel):
+    """ONE windowed no-improvement step — the single arithmetic behind
+    every in-loop stall watch (the legacy device twin, the anneal device
+    branch, and :func:`sched_host_step`; ``xp`` is jnp when traced, np on
+    the host).  Callers pass ``rel`` at the dtype the comparison must run
+    in (float32 for the anneal twins — host and device must make
+    IDENTICAL backoff decisions for bit-identical resume).  Returns
+    (best, best_prev, stall)."""
+    best = xp.minimum(best, gv)
+    improved = best <= rel * best_prev
+    stall = xp.where(improved, xp.zeros_like(stall), stall + 1)
+    best_prev = xp.where(improved, best, best_prev)
+    return best, best_prev, stall
+
+
+def _sched_replace(state, sched_np):
+    """Swap the host-updated sched vector back into the state tuple (the
+    sched leaf is by convention the LAST leaf of a scheduled state, and
+    the only 3rd leaf any driver state carries — the checkpoint savers
+    below rely on the same invariant).  The replacement keeps the old
+    leaf's placement: under an explicit mesh the initialization committed
+    sched with a replicated NamedSharding, and a bare jnp.asarray would
+    re-enter the donating jitted step with mismatched sharding typing."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(sched_np)
+    sharding = getattr(state[-1], "sharding", None)
+    if sharding is not None:
+        arr = jax.device_put(arr, sharding)
+    return (*state[:-1], arr)
+
+
+def sched_host_step(sched, gap, stall_evals: int, n_stages: int):
+    """Host twin of the device-side schedule/watch update (same float32
+    arithmetic via :func:`_watch_update`, so the host-stepped drivers and
+    the device loop make identical backoff decisions).  Returns
+    (new sched ndarray, backed_off)."""
+    s = np.asarray(sched, dtype=np.float32).copy()
+    gv = (np.float32(np.inf) if gap is None or np.isnan(gap)
+          else np.float32(gap))
+    s[2], s[3], s[1] = _watch_update(np, gv, s[2], s[3], s[1],
+                                     np.float32(STALL_REL))
+    backed = bool(s[1] >= np.float32(stall_evals) and s[0] < n_stages - 1)
+    if backed:
+        # fresh watch at the new stage; the iterate (w, α) carries over
+        s[0] += 1.0
+        s[1] = 0.0
+        s[2] = np.float32(np.inf)
+        s[3] = np.float32(np.inf)
+    return s, backed
+
+
 def resolve_divergence_guard(flag: str, mode: str, sigma: float, k: int,
                              gamma: float) -> bool:
     """Resolve the ``--divergenceGuard`` flag to an armed/disarmed bool.
@@ -151,6 +274,7 @@ def drive(
             ckpt_lib.save(
                 debug.chkpt_dir, name, t, state[0],
                 state[1] if len(state) > 1 else None, seed=debug.seed,
+                sched=state[-1] if len(state) > 2 else None,
             )
     return state, traj
 
@@ -167,6 +291,7 @@ def drive_chunked(
     start_round: int = 1,
     chunk: int = 50,
     divergence_guard: bool = True,
+    sigma_levels: Optional[tuple] = None,
 ):
     """Chunked variant of :func:`drive`: rounds run device-side in blocks of
     up to ``chunk`` via ``lax.scan`` (one dispatch per block instead of one
@@ -174,9 +299,18 @@ def drive_chunked(
     so the observable trajectory is identical to the per-round driver.
 
     ``chunk_fn(t0, c, state) -> state`` advances rounds t0..t0+c-1.
+
+    ``sigma_levels`` (more than one): the run carries the σ′-anneal
+    schedule in ``state[-1]`` (layout note at :data:`SCHED_LEN`); the
+    stall watch then BACKS OFF σ′ in place — :func:`sched_host_step`, the
+    host twin of the device loop's in-state update — instead of bailing
+    out, and the final (safe K·γ) stage simply runs to its round budget:
+    a scheduled run never reports DIVERGED, because its last rung is the
+    paper-safe bound.
     """
     if chunk <= 0:
         raise ValueError(f"chunk must be positive, got {chunk}")
+    anneal = sigma_levels is not None and len(sigma_levels) > 1
     traj = Trajectory(name, quiet=quiet)
     watch = _GapWatch(n_evals=stall_window(debug.debug_iter))
     t = start_round
@@ -198,10 +332,28 @@ def drive_chunked(
         if debug.debug_iter > 0 and end % debug.debug_iter == 0:
             primal, gap, test_err = eval_fn(state)
             traj.log_round(end, primal=primal, gap=gap, test_error=test_err)
+            anneal_on = (gap_target is not None and divergence_guard
+                         and anneal)
+            if anneal_on:
+                # the σ′ this eval ran under (the device loop records the
+                # post-update stage too; on a target hit the update is
+                # moot — the run ends — so the current stage is exact)
+                traj.records[-1].sigma = sigma_levels[
+                    int(np.asarray(state[-1])[0])]
             if gap_target is not None and gap is not None and gap <= gap_target:
                 traj.stopped = "target"
                 break
-            if (gap_target is not None and divergence_guard
+            if anneal_on:
+                sched, backed = sched_host_step(
+                    state[-1], gap, watch.n, len(sigma_levels))
+                state = _sched_replace(state, sched)
+                traj.records[-1].sigma = sigma_levels[int(sched[0])]
+                if backed and not quiet:
+                    print(f"{name}: σ′ anneal — gap stalled for {watch.n} "
+                          f"evals; backing off to "
+                          f"σ′={sigma_levels[int(sched[0])]:g} at round "
+                          f"{end} (iterate kept, certificate exact)")
+            elif (gap_target is not None and divergence_guard
                     and watch.update(gap)):
                 traj.mark_diverged(end, watch.n)
                 break
@@ -210,6 +362,7 @@ def drive_chunked(
             ckpt_lib.save(
                 debug.chkpt_dir, name, end, state[0],
                 state[1] if len(state) > 1 else None, seed=debug.seed,
+                sched=state[-1] if len(state) > 2 else None,
             )
     return state, traj
 
@@ -280,7 +433,7 @@ class _Prefetch:
 
 def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                       mesh=None, stall_evals=STALL_EVALS,
-                      divergence_guard=True):
+                      divergence_guard=True, n_stages=0):
     import functools
 
     import jax.numpy as jnp
@@ -291,6 +444,15 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
     # with the guard armed: fixed-round runs are the benchmark timing paths
     # and must execute exactly their round budget
     check_div = gap_target is not None and divergence_guard
+    # n_stages > 1: σ′-anneal mode — the stall watch lives in the state
+    # tuple's sched leaf (persisting across super-block dispatches and
+    # into checkpoints), and firing BACKS OFF the schedule stage in place
+    # instead of stopping the loop; the final stage is the safe K·γ bound,
+    # so a scheduled run never stops "diverged" (see sched_host_step, the
+    # host twin).  The traj buffer gains a 4th column carrying the
+    # post-update stage so the host can report σ′ per eval.
+    anneal = check_div and n_stages > 1
+    n_cols = 4 if anneal else 3
 
     @functools.partial(jax.jit, donate_argnums=tuple(range(n_state)))
     def run(*args):
@@ -310,24 +472,47 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
             chunk = jax.tree.map(lambda a: a[i], idxs_all)
             state = chunk_kernel(state, chunk, shard_arrays)
             metrics = eval_kernel(state, shard_arrays, test_arrays)
-            traj = lax.dynamic_update_index_in_dim(traj, metrics, i, 0)
             done_tgt = metrics[1] <= tgt
-            if check_div:
+            if anneal:
+                # in-state schedule/watch update (float32, exactly the
+                # sched_host_step arithmetic): a fired window at a
+                # non-final stage bumps the stage — the NEXT chunk's
+                # kernel reads it and runs the backed-off σ′ — and
+                # resets the watch; at the final (safe) stage the watch
+                # is inert and the run continues to target or budget
+                sched = state[-1]
+                gv = jnp.where(jnp.isnan(metrics[1]), jnp.inf,
+                               metrics[1]).astype(jnp.float32)
+                stg, stl, bst, bpv = sched[0], sched[1], sched[2], sched[3]
+                bst, bpv, stl = _watch_update(jnp, gv, bst, bpv, stl,
+                                              jnp.float32(STALL_REL))
+                fired = stl >= jnp.float32(stall_evals)
+                bo = (fired & (stg < jnp.float32(n_stages - 1))
+                      & jnp.logical_not(done_tgt))
+                inf32 = jnp.float32(jnp.inf)
+                stg = jnp.where(bo, stg + 1, stg)
+                stl = jnp.where(bo, jnp.float32(0), stl)
+                bst = jnp.where(bo, inf32, bst)
+                bpv = jnp.where(bo, inf32, bpv)
+                state = (*state[:-1],
+                         jnp.stack([stg, stl, bst, bpv, sched[4]]))
+                metrics = jnp.concatenate(
+                    [metrics, stg.astype(metrics.dtype)[None]])
+            elif check_div:
                 # windowed no-improvement watch (the _GapWatch twin): NaN
                 # gaps (primal-only eval) map to +inf, leaving best — and
                 # the always-true inf <= rel·inf reset — untouched
                 gv = jnp.where(jnp.isnan(metrics[1]),
                                jnp.asarray(jnp.inf, best.dtype), metrics[1])
-                best = jnp.minimum(best, gv)
-                improved = best <= STALL_REL * best_prev
-                stall = jnp.where(improved, jnp.int32(0), stall + 1)
-                best_prev = jnp.where(improved, best, best_prev)
+                best, best_prev, stall = _watch_update(
+                    jnp, gv, best, best_prev, stall, STALL_REL)
                 # the target wins a tie (the host drivers check that order)
                 done_stall = (stall >= stall_evals) & jnp.logical_not(done_tgt)
+            traj = lax.dynamic_update_index_in_dim(traj, metrics, i, 0)
             return (i + jnp.int32(1), done_tgt, done_stall, stall, best,
                     best_prev, state, traj)
 
-        traj0 = jnp.full((n_chunks, 3), jnp.nan, dtype=state[0].dtype)
+        traj0 = jnp.full((n_chunks, n_cols), jnp.nan, dtype=state[0].dtype)
         if mesh is not None:
             # metrics coming out of the shard_mapped eval carry the (Explicit)
             # mesh in their sharding type; the update target must match
@@ -364,10 +549,16 @@ def drive_on_device(
     mesh=None,
     stall_evals: int = STALL_EVALS,
     divergence_guard: bool = True,
+    sigma_levels: Optional[tuple] = None,
 ):
     """Fully device-resident outer driver: the ENTIRE run — every round,
     every ``debugIter`` evaluation, and the gap-target early-stop test — is
     one ``lax.while_loop`` inside one jit.  One dispatch, one host fetch.
+
+    ``sigma_levels`` (more than one): σ′-anneal mode — the stall watch and
+    schedule stage ride ``state[-1]`` (see :data:`SCHED_LEN`) and a fired
+    window backs the σ′ stage off IN the loop instead of stopping it; the
+    per-eval σ′ is decoded into the trajectory records.
 
     Rationale: the per-round device compute of these solvers is microseconds,
     so the wall-clock of the host-stepped drivers is pure host/device
@@ -395,12 +586,15 @@ def drive_on_device(
     c = int(jax.tree.leaves(idxs_all)[0].shape[1])
     tgt = gap_target
     n_state = len(state)
+    n_stages = len(sigma_levels) if sigma_levels is not None else 0
+    anneal = (tgt is not None and divergence_guard and n_stages > 1)
 
     run = _DEVICE_RUNS.get(cache_key) if cache_key is not None else None
     if run is None:
         run = _build_device_run(
             chunk_kernel, eval_kernel, tgt, n_state, mesh=mesh,
             stall_evals=stall_evals, divergence_guard=divergence_guard,
+            n_stages=n_stages,
         )
         if cache_key is not None:
             _DEVICE_RUNS[cache_key] = run
@@ -412,9 +606,11 @@ def drive_on_device(
     traj_host = np.asarray(traj_buf[:n_done])
 
     traj = Trajectory(name, quiet=quiet)
+    prev_sigma = None
     for j in range(n_done):
         end = start_round - 1 + (j + 1) * c
-        primal, gap, test_err = (float(v) for v in traj_host[j])
+        primal, gap, test_err = (float(v) for v in traj_host[j, :3])
+        sigma = (sigma_levels[int(traj_host[j, 3])] if anneal else None)
         traj.log_round(
             end, primal=primal,
             # NaN slots mean "not applicable" (no dual state / no test set)
@@ -424,7 +620,14 @@ def drive_on_device(
             # per-round wall-clock is unobservable here: the whole run is one
             # dispatch and one fetch — don't fabricate flat timestamps
             wall_time=None,
+            sigma=sigma,
         )
+        if (not quiet and anneal and prev_sigma is not None
+                and sigma != prev_sigma):
+            print(f"{name}: σ′ anneal — backed off to σ′={sigma:g} in the "
+                  f"device loop at round {end} (iterate kept, certificate "
+                  f"exact)")
+        prev_sigma = sigma
     # classify from the device-side stop flags themselves (not from
     # n_done < n_chunks, which misses a guard fire on the FINAL chunk —
     # ADVICE r5): the while_loop carried exactly why it stopped
@@ -454,6 +657,7 @@ def drive_device_full(
     cache_key=None,
     mesh=None,
     divergence_guard: bool = True,
+    sigma_levels: Optional[tuple] = None,
 ):
     """Cadence-aligned wrapper around :func:`drive_on_device`, usable by any
     solver whose round has the (state, idxs, shards) shape: host-steps the
@@ -461,16 +665,24 @@ def drive_device_full(
     ``debugIter`` boundary), rides all full eval-cadence chunks device-side
     as one dispatch, then host-steps the sub-cadence tail (num_rounds %
     debugIter remainder, no eval — same observable behavior as
-    :func:`drive_chunked`).  Returns (state, Trajectory)."""
+    :func:`drive_chunked`).  Returns (state, Trajectory).
+
+    With ``sigma_levels`` (σ′ anneal) the stall watch rides ``state[-1]``
+    ACROSS super-block boundaries — the host-twin watch below is then
+    unnecessary (and skipped): the device loop's counters are the single
+    source of truth, and the checkpoints written at block boundaries carry
+    them, which is what makes a mid-schedule resume bit-identical."""
     if debug.debug_iter <= 0:
         raise ValueError(
             "the device loop requires debug_iter > 0 (the eval cadence is "
             "its chunk axis)"
         )
     c = debug.debug_iter
+    anneal = (sigma_levels is not None and len(sigma_levels) > 1
+              and gap_target is not None and divergence_guard)
     traj = Trajectory(name, quiet=quiet)
     watch = _GapWatch(n_evals=stall_window(debug.debug_iter))
-    # ^ spans super-block boundaries (see block loop)
+    # ^ spans super-block boundaries (see block loop); inert under anneal
     # Device-loop checkpointing (reference anchor CoCoA.scala:59-62: the
     # production path checkpoints): state is host-reachable at every
     # super-block boundary (each drive_on_device return is the block's one
@@ -486,6 +698,7 @@ def drive_device_full(
             ckpt_lib.save(
                 debug.chkpt_dir, name, done_round, state[0],
                 state[1] if len(state) > 1 else None, seed=debug.seed,
+                sched=state[-1] if len(state) > 2 else None,
             )
             last_saved = done_round
 
@@ -507,7 +720,15 @@ def drive_device_full(
             primal, gap, test_err = eval_fn(state)
             traj.log_round(head_end, primal=primal, gap=gap,
                            test_error=test_err)
-            watch.update(gap)
+            if anneal:
+                # host-stepped eval feeds the SAME in-state watch the
+                # device loop reads (sched_host_step is its bit-twin)
+                sched, _ = sched_host_step(state[-1], gap, watch.n,
+                                           len(sigma_levels))
+                state = _sched_replace(state, sched)
+                traj.records[-1].sigma = sigma_levels[int(sched[0])]
+            else:
+                watch.update(gap)
         maybe_ckpt(head_end)
 
     n_full = max(0, (params.num_rounds - (t - 1)) // c)
@@ -584,6 +805,7 @@ def drive_device_full(
                 gap_target=gap_target, start_round=start,
                 cache_key=cache_key, mesh=mesh, stall_evals=watch.n,
                 divergence_guard=divergence_guard,
+                sigma_levels=sigma_levels,
             )
             traj.records.extend(dev_traj.records)
             if dev_traj.records:
@@ -610,8 +832,11 @@ def drive_device_full(
                 break
             # the in-loop watch state is per-block; the host twin spans
             # block boundaries (geometric blocks start with < STALL_EVALS
-            # evals, where the in-loop watch alone could never fire)
-            diverged = divergence_guard and (
+            # evals, where the in-loop watch alone could never fire).
+            # Under σ′ anneal the watch rides state[-1] across blocks
+            # instead, and a fired window backs off rather than stops —
+            # so there is no twin to run and nothing to mark diverged.
+            diverged = not anneal and divergence_guard and (
                 dev_traj.stopped == "diverged"
                 or any(watch.update(r.gap) for r in dev_traj.records)
             )
@@ -842,6 +1067,7 @@ def drive_device_paths(
     cache_key=None,
     eval_kernel=None,
     divergence_guard: bool = True,
+    sigma_levels: Optional[tuple] = None,
 ):
     """The scan_chunk / device_loop dispatch shared by every solver: builds
     the fused eval kernel (dual state iff ``alpha_in_state``; overridable
@@ -870,11 +1096,12 @@ def drive_device_paths(
             cache_key=None if cache_key is None
             else (*cache_key, test_n, divergence_guard),
             mesh=mesh, divergence_guard=divergence_guard,
+            sigma_levels=sigma_levels,
         )
     return drive_chunked(
         name, params, debug, state, chunk_fn, eval_fn, quiet=quiet,
         gap_target=gap_target, start_round=start_round, chunk=scan_chunk,
-        divergence_guard=divergence_guard,
+        divergence_guard=divergence_guard, sigma_levels=sigma_levels,
     )
 
 

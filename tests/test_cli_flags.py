@@ -61,8 +61,12 @@ def test_cli_resume_covers_full_menu(tmp_path, capsys):
     from cocoa_tpu.cli import main
 
     ck = str(tmp_path / "ck")
+    # --mesh=1: the single-chip vmap path, so the test exercises the full
+    # resume menu even on jax builds without jax.shard_map (< 0.5) — the
+    # restore plumbing under test is identical on both paths
     base = [f"--trainFile={train}", "--numFeatures=9947", "--numRounds=2",
-            "--localIterFrac=0.002", "--numSplits=4", "--lambda=.001",
+            "--localIterFrac=0.002", "--numSplits=4", "--mesh=1",
+            "--lambda=.001",
             "--justCoCoA=false", "--debugIter=1", "--chkptIter=1",
             f"--chkptDir={ck}"]
     assert main(base) == 0
@@ -83,6 +87,26 @@ def test_cli_resume_covers_full_menu(tmp_path, capsys):
         ["--trainFile=x", "--numFeatures=3", "--loss=nope"],
         ["--trainFile=x", "--numFeatures=3", "--loss=smooth_hinge",
          "--smoothing=0"],
+        # --sigmaSchedule: bad value; trial without --sigma=auto; anneal
+        # with a sub-safe σ′ but no gap target (the stall watch the
+        # backoff rides runs on the gap-target path only)
+        ["--trainFile=x", "--numFeatures=3", "--sigmaSchedule=nope"],
+        ["--trainFile=x", "--numFeatures=3", "--sigmaSchedule=trial"],
+        ["--trainFile=x", "--numFeatures=3", "--sigmaSchedule=trial",
+         "--sigma=2.0"],
+        ["--trainFile=x", "--numFeatures=3", "--sigmaSchedule=anneal",
+         "--sigma=2.0", "--numSplits=4"],
+        ["--trainFile=x", "--numFeatures=3", "--sigmaSchedule=anneal",
+         "--sigma=2.0", "--numSplits=4", "--gapTarget=1e-3",
+         "--divergenceGuard=off"],
+        # --warmStart: malformed pair, bad values, non-hinge loss, no evals
+        ["--trainFile=x", "--numFeatures=3", "--warmStart=0.1"],
+        ["--trainFile=x", "--numFeatures=3", "--warmStart=0.1,abc"],
+        ["--trainFile=x", "--numFeatures=3", "--warmStart=0,300"],
+        ["--trainFile=x", "--numFeatures=3", "--warmStart=0.1,300",
+         "--loss=logistic"],
+        ["--trainFile=x", "--numFeatures=3", "--warmStart=0.1,300",
+         "--debugIter=0"],
     ],
 )
 def test_cli_bad_flags_exit_cleanly(argv, capsys):
@@ -92,3 +116,15 @@ def test_cli_bad_flags_exit_cleanly(argv, capsys):
 
     assert main(argv) == 2
     assert "error:" in capsys.readouterr().err
+
+
+def test_cli_sigma_schedule_and_warm_start_flags():
+    """--sigmaSchedule / --warmStart land in the run-level extras (they
+    are run_cocoa kwargs, not RunConfig fields)."""
+    cfg, extras = parse_args(
+        ["--sigma=auto", "--sigmaSchedule=anneal", "--warmStart=0.1,300",
+         "--gapTarget=1e-4"])
+    assert cfg.sigma == "auto"
+    assert extras["sigmaSchedule"] == "anneal"
+    assert extras["warmStart"] == "0.1,300"
+    assert extras["gapTarget"] == "1e-4"

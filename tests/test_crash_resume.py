@@ -14,7 +14,7 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-TRAIN = "/root/reference/data/small_train.dat"
+from conftest import SMALL_TRAIN as TRAIN  # noqa: E402
 
 # localIterFrac=1 makes CPU rounds slow enough (H=500 exact-math steps)
 # that the SIGKILL reliably lands mid-run, after the first checkpoint but

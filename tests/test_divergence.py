@@ -42,13 +42,15 @@ def _coherent_dataset(k=4, m=32, d=16, seed=0):
 K, LAM = 4, 1e-4
 
 
-def _run(sigma, device_loop, num_rounds=400, gap_target=1e-3, rng="jax"):
+def _run(sigma, device_loop, num_rounds=400, gap_target=1e-3, rng="jax",
+         **kw):
     ds, n = _coherent_dataset(k=K)
     params = Params(n=n, num_rounds=num_rounds, local_iters=16, lam=LAM,
                     sigma=sigma)
     debug = DebugParams(debug_iter=4, seed=0)
     return run_cocoa(ds, params, debug, plus=True, quiet=True, math="fast",
-                     device_loop=device_loop, gap_target=gap_target, rng=rng)
+                     device_loop=device_loop, gap_target=gap_target, rng=rng,
+                     **kw)
 
 
 def test_gap_watch_windowed_no_improvement():
@@ -68,19 +70,35 @@ def test_gap_watch_windowed_no_improvement():
         g *= 0.7
 
 
+def _bail_run(device_loop):
+    """The bail-out pin runs at the calibration cadence 25 (window = 12
+    evals = 300 rounds) with a 1600-round budget: at the original cadence
+    4 the window is 75 evals = 300 rounds against a 400-round budget, and
+    this environment's oscillation pattern improves the best gap just
+    often enough that the streak never reaches 75 before the budget ends
+    (the guard window is denominated in rounds exactly so cadence does not
+    change its strictness — but the budget must leave room for it)."""
+    ds, n = _coherent_dataset(k=K)
+    params = Params(n=n, num_rounds=1600, local_iters=16, lam=LAM,
+                    sigma=1.0)
+    debug = DebugParams(debug_iter=25, seed=0)
+    return run_cocoa(ds, params, debug, plus=True, quiet=True, math="fast",
+                     device_loop=device_loop, gap_target=1e-3, rng="jax")
+
+
 def test_unsafe_sigma_bails_out_host_driver(capsys):
-    _, _, traj = _run(sigma=1.0, device_loop=False)
+    _, _, traj = _bail_run(device_loop=False)
     assert traj.stopped == "diverged"
     # the bail-out is the point: far fewer than the full budget
-    assert traj.records[-1].round < 400
+    assert traj.records[-1].round < 1600
     # quiet=True: the message is suppressed, the flag still set
     assert "DIVERGED" not in capsys.readouterr().out
 
 
 def test_unsafe_sigma_bails_out_device_loop():
-    _, _, traj = _run(sigma=1.0, device_loop=True)
+    _, _, traj = _bail_run(device_loop=True)
     assert traj.stopped == "diverged"
-    assert traj.records[-1].round < 400
+    assert traj.records[-1].round < 1600
 
 
 def test_safe_sigma_converges_to_target():
@@ -101,8 +119,11 @@ def test_fixed_round_runs_never_bail():
 def test_sigma_auto_trial_converges(capsys):
     """When the aggressive K·γ/2 trial certifies the gap (it does on this
     data — even the adversarially coherent shards tolerate σ′ = K/2 here),
-    auto returns the trial's result with no restart."""
-    w, alpha, traj = _run(sigma="auto", device_loop=False)
+    auto returns the trial's result with no restart.  Pinned on the
+    ``--sigmaSchedule=trial`` A/B control (the in-loop anneal schedule is
+    the default now — tests/test_sigma_anneal.py)."""
+    w, alpha, traj = _run(sigma="auto", device_loop=False,
+                          sigma_schedule="trial")
     assert traj.stopped == "target"
     assert traj.records[-1].gap <= 1e-3
     assert "restarting with the safe" not in capsys.readouterr().out
@@ -139,7 +160,8 @@ def test_sigma_auto_fallback_on_divergence(tmp_path, monkeypatch, capsys):
     debug = DebugParams(debug_iter=4, seed=0, chkpt_iter=8,
                         chkpt_dir=str(tmp_path))
     w, alpha, traj = run_cocoa(ds, params, debug, plus=True, quiet=False,
-                               math="fast", gap_target=1e-3, rng="jax")
+                               math="fast", gap_target=1e-3, rng="jax",
+                               sigma_schedule="trial")
     assert calls[0] == trial_sigma          # aggressive trial first
     assert calls[1] == float(K)             # safe σ′ = K·γ rerun
     assert traj.stopped == "target"
@@ -197,7 +219,8 @@ def test_sigma_auto_resumed_run_skips_trial(capsys):
     w0 = jnp.asarray(rng.normal(size=16) * 0.01, jnp.float32)
     w_auto, _, traj = run_cocoa(ds, params, debug, plus=True, quiet=False,
                                 math="fast", gap_target=1e-3, rng="jax",
-                                w_init=w0, start_round=5)
+                                w_init=w0, start_round=5,
+                                sigma_schedule="trial")
     out = capsys.readouterr().out
     assert "resumed run continues with the safe" in out
     # identical to an explicit safe resume

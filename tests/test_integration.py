@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import SMALL_TRAIN, SMALL_TEST  # noqa: E402
 from cocoa_tpu.config import DebugParams, Params
 from cocoa_tpu.data import shard_dataset
 from cocoa_tpu.parallel import make_mesh
@@ -47,8 +48,8 @@ def test_cli_end_to_end(capsys):
     from cocoa_tpu import cli
 
     rc = cli.main([
-        "--trainFile=/root/reference/data/small_train.dat",
-        "--testFile=/root/reference/data/small_test.dat",
+        f"--trainFile={SMALL_TRAIN}",
+        f"--testFile={SMALL_TEST}",
         "--numFeatures=9947",
         "--numSplits=4",
         "--numRounds=10",

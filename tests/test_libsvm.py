@@ -6,6 +6,7 @@ import os
 import numpy as np
 import pytest
 
+from conftest import SMALL_TRAIN  # noqa: E402
 from cocoa_tpu.data.libsvm import _parse_label, load_libsvm_python
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -62,8 +63,8 @@ def test_native_parser_matches_python_oracle():
         import pytest
 
         pytest.skip("native parser not built (make -C native)")
-    nat = native_loader.parse_file("/root/reference/data/small_train.dat", 9947)
-    py = load_libsvm_python("/root/reference/data/small_train.dat", 9947)
+    nat = native_loader.parse_file(SMALL_TRAIN, 9947)
+    py = load_libsvm_python(SMALL_TRAIN, 9947)
     np.testing.assert_array_equal(nat.labels, py.labels)
     np.testing.assert_array_equal(nat.indptr, py.indptr)
     np.testing.assert_array_equal(nat.indices, py.indices)
@@ -71,7 +72,7 @@ def test_native_parser_matches_python_oracle():
 
 
 def test_python_parser_is_fallback_identical(small_train):
-    py = load_libsvm_python("/root/reference/data/small_train.dat", 9947)
+    py = load_libsvm_python(SMALL_TRAIN, 9947)
     np.testing.assert_array_equal(py.labels, small_train.labels)
     np.testing.assert_array_equal(py.indptr, small_train.indptr)
     np.testing.assert_array_equal(py.indices, small_train.indices)

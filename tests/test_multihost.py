@@ -273,3 +273,119 @@ def test_two_process_loading_materializes_only_local_shard(tmp_path):
         # own shard host slab (1/2) + its device buffer (1/2) + slack —
         # the old replicated path cost >= 2x full (numpy (K,·,d) + buffers)
         assert r["frac"] < 1.35, r
+
+
+_WEDGE_WORKER = r"""
+import os, sys, time
+
+# Fault injection for the stall-watchdog wedge test: on the FIRST
+# generation only (marker file absent), worker 1 lets two checkpoints land
+# and then WEDGES inside checkpoint.save — it stops checkpointing but
+# stays alive, and worker 0 blocks at the next collective.  No process
+# dies, so death-only supervision would poll this gang forever.
+marker = os.environ["WEDGE_MARKER"]
+proc_id = [a for a in sys.argv[1:] if a.startswith("--processId=")]
+proc_id = proc_id[0].split("=", 1)[1] if proc_id else "?"
+if proc_id == "1" and not os.path.exists(marker):
+    open(marker, "w").write("wedged")
+    import cocoa_tpu.checkpoint as _ckpt
+    _real_save = _ckpt.save
+    _n = [0]
+    def _wedging_save(*a, **k):
+        _n[0] += 1
+        if _n[0] > 2:
+            time.sleep(3600)  # alive, silent, making no progress
+        return _real_save(*a, **k)
+    _ckpt.save = _wedging_save
+
+from cocoa_tpu.cli import main
+sys.exit(main(sys.argv[1:]))
+"""
+
+
+@pytest.mark.slow
+def test_stall_watchdog_recovers_wedged_but_alive_gang(tmp_path, monkeypatch):
+    """VERDICT r5 #6, end-to-end: one worker STOPS CHECKPOINTING but stays
+    alive (wedged inside checkpoint.save), its peer blocks in the next
+    collective — no death for death-only supervision to see.  The
+    --stallTimeout watchdog kills the gang and restarts it from the last
+    good checkpoint, and the run completes with the same final state an
+    unwedged run reaches (resume exactness itself is pinned by
+    tests/test_crash_resume.py; this pins the watchdog mechanics
+    end-to-end: detection without a death, teardown, restart, completion).
+    """
+    import jax as _jax
+
+    if not hasattr(_jax, "shard_map"):
+        pytest.skip("the 2-process gang rides the mesh path, which needs "
+                    "jax.shard_map (newer jax)")
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu import elastic
+    from cocoa_tpu.data.synth import synth_sparse, write_libsvm
+
+    data = synth_sparse(96, 64, nnz_mean=8, seed=2)
+    train = tmp_path / "train.dat"
+    write_libsvm(data, str(train))
+    ckdir = tmp_path / "ck"
+    marker = tmp_path / "wedged.marker"
+    wedge_mod = tmp_path / "wedge_worker.py"
+    wedge_mod.write_text(_WEDGE_WORKER)
+    rounds = 200
+    argv = [
+        f"--trainFile={train}", "--numFeatures=64", f"--numRounds={rounds}",
+        "--localIterFrac=0.2", "--numSplits=2", "--lambda=.01",
+        "--justCoCoA=true", "--debugIter=10", f"--chkptDir={ckdir}",
+        "--chkptIter=10", "--dtype=float64",
+    ]
+    monkeypatch.setenv("XLA_FLAGS", " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    ))
+    monkeypatch.setenv("WEDGE_MARKER", str(marker))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        f"{tmp_path}{os.pathsep}{os.environ.get('PYTHONPATH', '')}")
+
+    def progress_token():
+        # the cli.py supervisor's token: the checkpoint directory listing
+        if not ckdir.is_dir():
+            return None
+        return tuple(sorted(f for f in os.listdir(ckdir)
+                            if f.endswith(".npz")))
+
+    gens = []
+    rc = elastic.supervise(
+        argv, 2, max_restarts=3, module="wedge_worker",
+        on_generation=lambda gen, procs: gens.append(gen),
+        progress_token=progress_token,
+        # generous vs compile time, tiny vs the 3600 s wedge: the watchdog
+        # is the ONLY thing that can unwedge this gang
+        stall_timeout_s=90.0,
+    )
+    assert rc == 0
+    assert marker.exists(), "the fault was never injected"
+    assert len(gens) >= 2, "the wedged gang was never restarted"
+    # the run completed: final-round checkpoints exist for both algorithms
+    for alg in ("CoCoA+", "CoCoA"):
+        path = ckpt_lib.latest(str(ckdir), alg)
+        assert path is not None
+        meta, w, a = ckpt_lib.load(path)
+        assert meta["round"] == rounds
+        assert w.shape == (64,) and a is not None
+    # and bit-identically: an unwedged reference gang (same flags, same
+    # 2-process layout) reaches exactly the same final checkpoint state —
+    # round-keyed sampling makes restart-resume invisible to the math
+    refdir = tmp_path / "ck_ref"
+    ref_argv = [a if str(ckdir) not in a else f"--chkptDir={refdir}"
+                for a in argv]
+    marker.unlink()
+    open(marker, "w").write("disarm")  # marker present -> no wedge
+    rc_ref = elastic.supervise(
+        ref_argv, 2, max_restarts=0, module="wedge_worker",
+    )
+    assert rc_ref == 0
+    for alg in ("CoCoA+", "CoCoA"):
+        _, w0, a0 = ckpt_lib.load(ckpt_lib.latest(str(ckdir), alg))
+        _, w1, a1 = ckpt_lib.load(ckpt_lib.latest(str(refdir), alg))
+        np.testing.assert_array_equal(w0, w1)
+        np.testing.assert_array_equal(a0, a1)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import SMALL_TRAIN  # noqa: E402
 from cocoa_tpu.config import DebugParams, Params
 from cocoa_tpu.data.sharding import shard_dataset
 from cocoa_tpu.solvers import run_cocoa
@@ -116,7 +117,7 @@ def test_cli_rng_permuted(capsys):
     from cocoa_tpu import cli
 
     rc = cli.main([
-        "--trainFile=/root/reference/data/small_train.dat",
+        f"--trainFile={SMALL_TRAIN}",
         "--numFeatures=9947", "--numSplits=4", "--numRounds=5",
         "--localIterFrac=0.05", "--lambda=.001", "--justCoCoA=true",
         "--debugIter=5", "--rng=permuted", "--mesh=1",

@@ -193,7 +193,9 @@ def test_sigma_auto_cleanup_scoped_to_trial(tmp_path, monkeypatch, capsys):
     'CoCoA+-r' prefix, rounds ≤ the diverged round) are removed — a
     concurrent plain-CoCoA run's files and higher-round CoCoA+ files in
     the same directory survive (ADVICE r5: the bare 'CoCoA' prefix
-    deleted them all)."""
+    deleted them all).  Pinned on the --sigmaSchedule=trial A/B control —
+    the in-loop anneal default never restarts, so it has no checkpoints
+    to clean up (tests/test_sigma_anneal.py)."""
     from cocoa_tpu.solvers import cocoa as cocoa_mod
     from cocoa_tpu.utils.logging import RoundRecord, Trajectory
 
@@ -222,7 +224,7 @@ def test_sigma_auto_cleanup_scoped_to_trial(tmp_path, monkeypatch, capsys):
     debug = DebugParams(debug_iter=2, seed=0, chkpt_iter=100,
                         chkpt_dir=str(tmp_path))
     run_cocoa(ds, params, debug, plus=True, quiet=False, math="fast",
-              gap_target=1e-3, rng="jax")
+              gap_target=1e-3, rng="jax", sigma_schedule="trial")
     names = sorted(p.name for p in tmp_path.iterdir())
     assert "CoCoA+-r000392.npz" not in names          # trial ckpt deleted
     assert "CoCoA+-r000392.npz.json" not in names     # and its sidecar
