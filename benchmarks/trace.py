@@ -16,12 +16,8 @@ latency-bound claim.
 
 from __future__ import annotations
 
-import glob
-import gzip
-import json
 import os
 import sys
-from collections import defaultdict
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -30,75 +26,12 @@ from cocoa_tpu.utils import compile_cache
 
 compile_cache.enable()   # persistent XLA cache: regen compiles once, ever
 
-
-def capture(tag, run_fn, out_root):
-    """Run ``run_fn`` under the profiler; return the capture directory."""
-    import shutil
-
-    import jax
-
-    tdir = os.path.join(out_root, tag)
-    # start clean: the profiler appends new session dirs, and parse_trace
-    # globs recursively — stale captures would silently mix into the
-    # aggregation (observed: a re-capture summed two generations of ops).
-    # A rmtree failure must be LOUD for the same reason.
-    if os.path.exists(tdir):
-        shutil.rmtree(tdir)
-    os.makedirs(tdir, exist_ok=True)
-    jax.profiler.start_trace(tdir)
-    try:
-        run_fn()
-    finally:
-        jax.profiler.stop_trace()
-    return tdir
-
-
-def parse_trace(tdir):
-    """Aggregate complete events from the Perfetto trace.json.gz files:
-    {track_name: {op_name: total_us}}."""
-    out = defaultdict(lambda: defaultdict(float))
-    for path in glob.glob(os.path.join(
-            tdir, "**", "*.trace.json.gz"), recursive=True):
-        with gzip.open(path, "rt") as f:
-            data = json.load(f)
-        events = data.get("traceEvents", [])
-        # map (pid, tid) -> track name from metadata events
-        pids = {}
-        tids = {}
-        for e in events:
-            if e.get("ph") == "M" and e.get("name") == "process_name":
-                pids[e.get("pid")] = e["args"].get("name", "")
-            if e.get("ph") == "M" and e.get("name") == "thread_name":
-                tids[(e.get("pid"), e.get("tid"))] = e["args"].get("name", "")
-        for e in events:
-            if e.get("ph") != "X":
-                continue
-            pname = pids.get(e.get("pid"), "")
-            tname = tids.get((e.get("pid"), e.get("tid")), "")
-            track = f"{pname}/{tname}".strip("/")
-            out[track][e.get("name", "?")] += float(e.get("dur", 0.0))
-    return {k: dict(v) for k, v in out.items()}
-
-
-def device_table(tracks, top=18):
-    """The device-side op table: the track(s) that look like TPU op
-    streams (XLA ops land on '/device:TPU... XLA Ops'-style threads).
-    Control-flow container events (while/cond shells) are excluded — their
-    durations INCLUDE their children and would double-count every loop
-    body op."""
-    rows = []
-    for track, ops in tracks.items():
-        low = track.lower()
-        if not ("tpu" in low or "device" in low):
-            continue
-        if "xla op" not in low and "step" not in low and "ops" not in low:
-            continue
-        for name, us in ops.items():
-            if name.split(".")[0] in ("while", "cond", "conditional"):
-                continue
-            rows.append((track, name, us))
-    rows.sort(key=lambda r: -r[2])
-    return rows[:top], sum(r[2] for r in rows)
+# the capture/summarize core moved to cocoa_tpu/telemetry/profiling.py so
+# production runs (--profile) and this benchmark driver share ONE
+# implementation; re-exported here for existing importers
+from cocoa_tpu.telemetry.profiling import (  # noqa: E402,F401
+    capture, device_table, parse_trace,
+)
 
 
 def main():

@@ -92,6 +92,13 @@ def save(
                 os.unlink(os.path.join(directory, name))
             except OSError:
                 pass
+    # every save flows through here (all drive* paths), so this is the one
+    # emission point for the checkpoint_write event — what the elastic
+    # supervisor's progress watch and external monitors key on
+    from cocoa_tpu.telemetry import events as _tele
+
+    _tele.get_bus().emit("checkpoint_write", algorithm=algorithm,
+                         round=int(round_t), path=path)
     return path
 
 

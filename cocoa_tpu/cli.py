@@ -87,7 +87,8 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "profile", "objective", "l2", "blockSize",
                 "blockPipeline", "divergenceGuard",
                 "sigmaSchedule", "warmStart",
-                "elastic", "stallTimeout", "evalDense")  # run-level
+                "elastic", "stallTimeout", "evalDense",
+                "metrics", "events", "quiet")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -96,7 +97,8 @@ _FLOAT_FIELDS = {"lam", "local_iter_frac", "beta", "gamma", "smoothing",
                  "sigma"}
 
 
-def _resolve_auto_block(ds_active, mesh, k: int, dtype) -> int:
+def _resolve_auto_block(ds_active, mesh, k: int, dtype,
+                        quiet: bool = False) -> int:
     """``--blockSize=auto`` against the ACTIVE dataset (rows for svm,
     columns for lasso): the measured-best B per layout, or 0 to keep the
     sequential kernels (solvers/cocoa.auto_block_size)."""
@@ -105,8 +107,9 @@ def _resolve_auto_block(ds_active, mesh, k: int, dtype) -> int:
 
     m_local = shards_per_device(mesh, k) if mesh is not None else k
     bs = auto_block_size(ds_active, m_local, dtype)
-    print(f"blockSize=auto: using {bs or 'the sequential path'} for the "
-          f"{ds_active.layout} layout")
+    if not quiet:
+        print(f"blockSize=auto: using {bs or 'the sequential path'} for the "
+              f"{ds_active.layout} layout")
     return bs
 
 
@@ -169,6 +172,29 @@ def main(argv=None) -> int:
 
     argv = sys.argv[1:] if argv is None else argv
     cfg, extras = parse_args(argv)
+
+    # --quiet: silence the console (flag echo, per-round lines, summaries).
+    # The telemetry sinks (--events/--metrics/--trajOut) are unaffected —
+    # a quiet run still leaves the full machine-readable trace.
+    quiet = (extras["quiet"] is not None
+             and str(extras["quiet"]).lower() != "false")
+
+    # --profile=DIR traces the whole run; --profile=DIR,START,STOP traces
+    # the round window [START, STOP) by riding the telemetry event stream
+    # (telemetry/profiling.py) — validated here so a typo fails before the
+    # run, not after it
+    profile_dir = profile_window = None
+    if extras["profile"]:
+        from cocoa_tpu.telemetry.profiling import parse_profile_flag
+
+        try:
+            profile_dir, p_start, p_stop = parse_profile_flag(
+                extras["profile"])
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if p_start is not None:
+            profile_window = (p_start, p_stop)
 
     if not cfg.train_file:
         print("error: --trainFile is required", file=sys.stderr)
@@ -285,12 +311,26 @@ def main(argv=None) -> int:
         def progress_token():
             # the restart budget bounds CONSECUTIVE failures: any new or
             # renamed checkpoint file since the last generation means the
-            # run advanced, so the streak resets
-            if not cfg.chkpt_dir or not os.path.isdir(cfg.chkpt_dir):
+            # run advanced, so the streak resets.  The worker's --metrics
+            # textfile (refreshed on every telemetry event) is a FINER
+            # progress signal than checkpoint files — it advances on every
+            # eval, so the stall watchdog can catch a wedge well inside a
+            # long chkptIter interval.
+            ckpts = None
+            if cfg.chkpt_dir and os.path.isdir(cfg.chkpt_dir):
+                ckpts = tuple(sorted(
+                    f for f in os.listdir(cfg.chkpt_dir)
+                    if f.endswith(".npz")))
+            metrics = None
+            if extras["metrics"]:
+                try:
+                    with open(extras["metrics"]) as f:
+                        metrics = f.read()
+                except OSError:
+                    pass
+            if ckpts is None and metrics is None:
                 return None
-            return tuple(sorted(
-                f for f in os.listdir(cfg.chkpt_dir) if f.endswith(".npz")
-            ))
+            return (ckpts, metrics)
 
         stall = None
         if extras["stallTimeout"]:
@@ -308,9 +348,10 @@ def main(argv=None) -> int:
             if stall <= 0:
                 print("error: --stallTimeout must be > 0", file=sys.stderr)
                 return 2
-            if not cfg.chkpt_dir:
-                print("error: --stallTimeout watches checkpoint progress "
-                      "— it needs --chkptDir", file=sys.stderr)
+            if not cfg.chkpt_dir and not extras["metrics"]:
+                print("error: --stallTimeout watches checkpoint/metrics "
+                      "progress — it needs --chkptDir or --metrics",
+                      file=sys.stderr)
                 return 2
             if stall < 120:
                 # the watchdog cannot tell "compiling" from "wedged": a
@@ -325,6 +366,13 @@ def main(argv=None) -> int:
                       f">= 120s (and a --chkptIter the gang can reach "
                       f"within the timeout)", file=sys.stderr)
 
+        if extras["events"]:
+            # the supervisor's gang-restart events land in the SAME event
+            # JSONL worker 0 writes (whole-line appends interleave safely)
+            # — one machine-readable stream for the whole supervised run
+            from cocoa_tpu import telemetry
+
+            telemetry.get_bus().configure(jsonl_path=extras["events"])
         return elastic.supervise(
             elastic.strip_elastic_flags(argv), n_workers,
             resume=bool(cfg.chkpt_dir), progress_token=progress_token,
@@ -351,12 +399,33 @@ def main(argv=None) -> int:
 
     # echo flags, as the reference does (hingeDriver.scala:41-48) — with its
     # gamma-prints-beta bug (quirk #2) fixed
-    for f in dataclasses.fields(cfg):
-        print(f"{f.name}: {getattr(cfg, f.name)}")
+    if not quiet:
+        for f in dataclasses.fields(cfg):
+            print(f"{f.name}: {getattr(cfg, f.name)}")
 
     dtype = jnp.dtype(cfg.dtype)
     if dtype == jnp.float64:
         jax.config.update("jax_enable_x64", True)
+
+    # telemetry: the event bus + metrics textfile are owned by process 0
+    # (worker 0 of an elastic gang / host 0 of a pod inherits stdout the
+    # same way); the run manifest is the FULL flag surface — reference
+    # flags and TPU-native extras alike — so the config hash identifies
+    # the run end to end
+    from cocoa_tpu import telemetry
+
+    bus = telemetry.get_bus()
+    is_primary = (proc_id or 0) == 0
+    if is_primary and (extras["metrics"] or extras["events"]):
+        bus.configure(jsonl_path=extras["events"],
+                      metrics_path=extras["metrics"])
+    cfg_manifest = {**dataclasses.asdict(cfg),
+                    **{k: v for k, v in extras.items() if v is not None}}
+    run_meta = {"dataset": cfg.train_file, "seed": cfg.seed,
+                "config_hash": telemetry.events.config_hash(cfg_manifest)}
+    if bus.active():
+        bus.emit("run_start", manifest=telemetry.events.run_manifest(
+            cfg_manifest, dataset=cfg.train_file))
 
     try:
         data = load_libsvm(cfg.train_file, cfg.num_features)
@@ -414,7 +483,7 @@ def main(argv=None) -> int:
               f"(numSplits x fp; shard multiplexing is dp-only; have "
               f"{len(jax.devices())} devices)", file=sys.stderr)
         return 2
-    if not explicit and mesh_size * fp < len(jax.devices()):
+    if not explicit and not quiet and mesh_size * fp < len(jax.devices()):
         # inferred mesh leaves devices idle (prime/coprime K falls to the
         # largest divisor, worst case 1 — all shards on one chip).  A perf
         # cliff the user can fix by aligning K, so say so.
@@ -518,7 +587,7 @@ def main(argv=None) -> int:
         # dense always blocks; sparse blocks only when the in-kernel CSR
         # Gram path fits (a densified sparse block LOSES to the sequential
         # sparse kernel, benchmarks/KERNELS.md)
-        block_size = _resolve_auto_block(ds, mesh, k, dtype)
+        block_size = _resolve_auto_block(ds, mesh, k, dtype, quiet=quiet)
 
     bp = (extras["blockPipeline"] or "auto").lower()
     if bp not in ("auto", "on", "off"):
@@ -578,7 +647,8 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
         if block_auto:
-            block_size = _resolve_auto_block(ds_c, mesh, k, dtype)
+            block_size = _resolve_auto_block(ds_c, mesh, k, dtype,
+                                             quiet=quiet)
         d = data.num_features
         # same H = max(1, localIterFrac·n/K) law, over coordinates
         lasso_params = dataclasses.replace(
@@ -597,7 +667,7 @@ def main(argv=None) -> int:
                                  start_round=meta["round"] + 1)
         x, r, traj = run_prox_cocoa(
             ds_c, b, lasso_params, cfg.to_debug(), mesh=mesh, rng=cfg.rng,
-            sampling=cfg.sampling,
+            sampling=cfg.sampling, quiet=quiet,
             gap_target=gap_target, scan_chunk=cfg.scan_chunk,
             math=cfg.math, device_loop=cfg.device_loop,
             block_size=block_size, block_pipeline=block_pipeline,
@@ -607,6 +677,7 @@ def main(argv=None) -> int:
 
         final = [float(v) for v in
                  _metrics_fn(mesh, cfg.lam, l2)(r, x, ds_c.shard_arrays(), b)]
+        traj.meta.update(run_meta)
         traj.summary(final[0], gap=final[1], test_error=None)
         if extras["trajOut"]:
             traj.dump_jsonl(f"{extras['trajOut']}.ProxCoCoA+.jsonl")
@@ -649,13 +720,14 @@ def main(argv=None) -> int:
             if test_ds is not None
             else None
         )
+        traj.meta.update(run_meta)
         traj.summary(primal, gap=gap, test_error=err)
         if extras["trajOut"]:
             path = f"{extras['trajOut']}.{traj.algorithm.replace(' ', '_')}.jsonl"
             traj.dump_jsonl(path)
 
     common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng,
-                  sampling=cfg.sampling)
+                  sampling=cfg.sampling, quiet=quiet)
 
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
                     math=cfg.math, device_loop=cfg.device_loop,
@@ -690,11 +762,31 @@ def main(argv=None) -> int:
             finish(traj, w)
 
             w, traj = run_dist_gd(ds, params, debug, mesh=mesh,
-                                  test_ds=test_ds, **loop_kw,
+                                  test_ds=test_ds, quiet=quiet, **loop_kw,
                                   **restore("Dist SGD"))
             finish(traj, w)
 
-    if extras["profile"]:
+    if profile_window is not None:
+        # --profile=DIR,START,STOP: trace only the round window, triggered
+        # by the telemetry event stream — on the device-resident driver
+        # the io_callback bridge is what makes a mid-while_loop trigger
+        # possible at all (telemetry/profiling.py).  The windower is a bus
+        # subscriber, which also activates the bus (and with it the
+        # device event stream) for the duration of the run.
+        from cocoa_tpu.telemetry.profiling import RoundWindowProfiler
+
+        windower = RoundWindowProfiler(profile_dir, *profile_window)
+        bus.subscribe(windower)
+        try:
+            run_all()
+        finally:
+            windower.close()
+            bus.unsubscribe(windower)
+            if not quiet:
+                print(f"profiler trace of rounds "
+                      f"[{profile_window[0]}, {profile_window[1]}) "
+                      f"written to {profile_dir}")
+    elif profile_dir:
         # --profile=DIR: capture a device trace of the whole run, viewable
         # in TensorBoard/Perfetto (the reference has no profiler at all —
         # SURVEY.md §5 requires one as a debug flag).  try/finally so the
@@ -702,12 +794,13 @@ def main(argv=None) -> int:
         # when a solver raises.
         from jax import profiler
 
-        profiler.start_trace(extras["profile"])
+        profiler.start_trace(profile_dir)
         try:
             run_all()
         finally:
             profiler.stop_trace()
-            print(f"profiler trace written to {extras['profile']}")
+            if not quiet:
+                print(f"profiler trace written to {profile_dir}")
     else:
         run_all()
 

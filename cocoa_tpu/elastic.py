@@ -163,6 +163,15 @@ def supervise(
             return int(failed or 1)
         what = (f"gang made no progress for {stall_timeout_s:g}s"
                 if stalled else f"worker died (exit {failed})")
+        # machine-readable restart trace: the supervisor's bus (configured
+        # by the CLI's --events; inert otherwise) appends to the same
+        # JSONL the workers write — whole-line appends interleave safely
+        from cocoa_tpu.telemetry import events as _tele
+
+        _tele.get_bus().emit(
+            "restart", reason="gang_stalled" if stalled else "worker_died",
+            attempt=restarts, max_restarts=max_restarts,
+            exit_code=failed, generation=gen)
         print(f"elastic: {what}; restarting gang "
               f"(attempt {restarts}/{max_restarts}) from the latest "
               f"checkpoint", file=sys.stderr, flush=True)

@@ -187,6 +187,21 @@ def sched_host_step(sched, gap, stall_evals: int, n_stages: int):
     return s, backed
 
 
+def _emit_backoff(name, t, sigma_levels, stage, quiet, message=None):
+    """One σ′-anneal backoff: the typed ``sigma_backoff`` event (emitted
+    regardless of ``quiet`` — the machine-readable trace survives a
+    silenced console) plus the optional console line.  The host schedule
+    step bumps exactly one rung, so ``from_sigma`` is stage-1."""
+    from cocoa_tpu.telemetry import events as _tele
+
+    _tele.get_bus().emit(
+        "sigma_backoff", algorithm=name, t=int(t),
+        sigma=sigma_levels[stage], from_sigma=sigma_levels[stage - 1],
+        stage=int(stage))
+    if message and not quiet:
+        print(message)
+
+
 def resolve_divergence_guard(flag: str, mode: str, sigma: float, k: int,
                              gamma: float) -> bool:
     """Resolve the ``--divergenceGuard`` flag to an armed/disarmed bool.
@@ -331,29 +346,47 @@ def drive_chunked(
 
         if debug.debug_iter > 0 and end % debug.debug_iter == 0:
             primal, gap, test_err = eval_fn(state)
-            traj.log_round(end, primal=primal, gap=gap, test_error=test_err)
             anneal_on = (gap_target is not None and divergence_guard
                          and anneal)
+            hit = (gap_target is not None and gap is not None
+                   and gap <= gap_target)
+            sigma_val = stage = stall_v = None
+            backed = False
             if anneal_on:
-                # the σ′ this eval ran under (the device loop records the
-                # post-update stage too; on a target hit the update is
-                # moot — the run ends — so the current stage is exact)
-                traj.records[-1].sigma = sigma_levels[
-                    int(np.asarray(state[-1])[0])]
-            if gap_target is not None and gap is not None and gap <= gap_target:
+                if hit:
+                    # the σ′ this eval ran under: on a target hit the
+                    # schedule update is moot — the run ends and the state
+                    # is NOT advanced — but the emitted stall counter must
+                    # still be the device twin's (the device loop runs the
+                    # watch arithmetic before it notices done_tgt, with
+                    # the backoff suppressed), so preview it un-committed
+                    s = np.asarray(state[-1], dtype=np.float32)
+                    gv = (np.float32(np.inf)
+                          if gap is None or np.isnan(gap)
+                          else np.float32(gap))
+                    _, _, stl = _watch_update(np, gv, s[2], s[3], s[1],
+                                              np.float32(STALL_REL))
+                    stage = int(s[0])
+                    stall_v = int(stl)
+                else:
+                    sched, backed = sched_host_step(
+                        state[-1], gap, watch.n, len(sigma_levels))
+                    state = _sched_replace(state, sched)
+                    stage = int(sched[0])
+                    stall_v = int(sched[1])
+                sigma_val = sigma_levels[stage]
+            traj.log_round(end, primal=primal, gap=gap, test_error=test_err,
+                           sigma=sigma_val, sigma_stage=stage, stall=stall_v)
+            if backed:
+                _emit_backoff(name, end, sigma_levels, stage, quiet,
+                              f"{name}: σ′ anneal — gap stalled for "
+                              f"{watch.n} evals; backing off to "
+                              f"σ′={sigma_levels[stage]:g} at round "
+                              f"{end} (iterate kept, certificate exact)")
+            if hit:
                 traj.stopped = "target"
                 break
-            if anneal_on:
-                sched, backed = sched_host_step(
-                    state[-1], gap, watch.n, len(sigma_levels))
-                state = _sched_replace(state, sched)
-                traj.records[-1].sigma = sigma_levels[int(sched[0])]
-                if backed and not quiet:
-                    print(f"{name}: σ′ anneal — gap stalled for {watch.n} "
-                          f"evals; backing off to "
-                          f"σ′={sigma_levels[int(sched[0])]:g} at round "
-                          f"{end} (iterate kept, certificate exact)")
-            elif (gap_target is not None and divergence_guard
+            if (not anneal_on and gap_target is not None and divergence_guard
                     and watch.update(gap)):
                 traj.mark_diverged(end, watch.n)
                 break
@@ -433,7 +466,7 @@ class _Prefetch:
 
 def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                       mesh=None, stall_evals=STALL_EVALS,
-                      divergence_guard=True, n_stages=0):
+                      divergence_guard=True, n_stages=0, stream=False):
     import functools
 
     import jax.numpy as jnp
@@ -449,10 +482,17 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
     # into checkpoints), and firing BACKS OFF the schedule stage in place
     # instead of stopping the loop; the final stage is the safe K·γ bound,
     # so a scheduled run never stops "diverged" (see sched_host_step, the
-    # host twin).  The traj buffer gains a 4th column carrying the
-    # post-update stage so the host can report σ′ per eval.
+    # host twin).
     anneal = check_div and n_stages > 1
-    n_cols = 4 if anneal else 3
+    # every eval writes one [primal, gap, test_err, sigma_stage, stall]
+    # row: cols 0-2 are the eval metrics, col 3 the post-update σ′ ladder
+    # stage (NaN outside anneal mode), col 4 the post-update stall-watch
+    # counter.  The row feeds the trajectory buffer AND — with ``stream``
+    # — an ordered io_callback that posts it to the telemetry bus while
+    # the loop is still on device (side-effect-only: nothing in the loop
+    # carry reads it, so a streaming run is bit-identical to a
+    # non-streaming one — the fetch-fallback replays the same buffer).
+    n_cols = 5
 
     @functools.partial(jax.jit, donate_argnums=tuple(range(n_state)))
     def run(*args):
@@ -473,6 +513,7 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
             state = chunk_kernel(state, chunk, shard_arrays)
             metrics = eval_kernel(state, shard_arrays, test_arrays)
             done_tgt = metrics[1] <= tgt
+            nanv = jnp.asarray(jnp.nan, metrics.dtype)
             if anneal:
                 # in-state schedule/watch update (float32, exactly the
                 # sched_host_step arithmetic): a fired window at a
@@ -496,8 +537,8 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                 bpv = jnp.where(bo, inf32, bpv)
                 state = (*state[:-1],
                          jnp.stack([stg, stl, bst, bpv, sched[4]]))
-                metrics = jnp.concatenate(
-                    [metrics, stg.astype(metrics.dtype)[None]])
+                extra = jnp.stack([stg.astype(metrics.dtype),
+                                   stl.astype(metrics.dtype)])
             elif check_div:
                 # windowed no-improvement watch (the _GapWatch twin): NaN
                 # gaps (primal-only eval) map to +inf, leaving best — and
@@ -508,7 +549,21 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                     jnp, gv, best, best_prev, stall, STALL_REL)
                 # the target wins a tie (the host drivers check that order)
                 done_stall = (stall >= stall_evals) & jnp.logical_not(done_tgt)
-            traj = lax.dynamic_update_index_in_dim(traj, metrics, i, 0)
+                extra = jnp.stack([nanv, stall.astype(metrics.dtype)])
+            else:
+                extra = jnp.stack([nanv, jnp.zeros((), metrics.dtype)])
+            row = jnp.concatenate([metrics, extra])
+            if stream:
+                # side-effect-only event bridge: post this eval's row to
+                # the host WHILE THE LOOP RUNS.  Ordered, so the host sees
+                # evals in execution order; nothing downstream reads it,
+                # so the compute is untouched (telemetry/events.py).
+                from jax.experimental import io_callback
+
+                from cocoa_tpu.telemetry import events as _tele
+
+                io_callback(_tele._device_sink, None, i, row, ordered=True)
+            traj = lax.dynamic_update_index_in_dim(traj, row, i, 0)
             return (i + jnp.int32(1), done_tgt, done_stall, stall, best,
                     best_prev, state, traj)
 
@@ -583,27 +638,59 @@ def drive_on_device(
     given, the built jit executable is reused across calls — without it every
     call re-jits (closures have fresh identity) and pays ~1s of recompile.
     """
+    from cocoa_tpu.telemetry import events as _tele
+
     c = int(jax.tree.leaves(idxs_all)[0].shape[1])
     tgt = gap_target
     n_state = len(state)
     n_stages = len(sigma_levels) if sigma_levels is not None else 0
     anneal = (tgt is not None and divergence_guard and n_stages > 1)
 
-    run = _DEVICE_RUNS.get(cache_key) if cache_key is not None else None
+    # telemetry: with the bus active, each eval's row leaves the while_loop
+    # through an ordered io_callback AS IT HAPPENS (single-device paths;
+    # the callback placement under an explicit mesh is runtime-dependent,
+    # so mesh runs use the fetch replay below).  Where ordered callbacks
+    # are unsupported, the SAME tap replays the fetched buffer — identical
+    # events, emitted at the end-of-run sync instead of live.
+    bus = _tele.get_bus()
+    emit = bus.active()
+    stream = emit and mesh is None and _tele.io_callback_supported()
+    tap = None
+    if emit:
+        # seed backoff detection with the stage this dispatch ENTERS at
+        # (the sched leaf rides super-block boundaries), so a resumed or
+        # later-block run never fabricates a backoff on its first eval
+        init_stage = (int(np.asarray(state[-1])[0]) if anneal else None)
+        tap = _tele.DeviceTap(bus, name, start_round, c,
+                              sigma_levels if anneal else None,
+                              init_stage=init_stage)
+
+    run_key = None if cache_key is None else (cache_key, stream)
+    run = _DEVICE_RUNS.get(run_key) if run_key is not None else None
     if run is None:
         run = _build_device_run(
             chunk_kernel, eval_kernel, tgt, n_state, mesh=mesh,
             stall_evals=stall_evals, divergence_guard=divergence_guard,
-            n_stages=n_stages,
+            n_stages=n_stages, stream=stream,
         )
-        if cache_key is not None:
-            _DEVICE_RUNS[cache_key] = run
+        if run_key is not None:
+            _DEVICE_RUNS[run_key] = run
 
-    i, done_tgt, done_stall, state, traj_buf = run(
-        *state, idxs_all, shard_arrays, test_arrays)
-    # the single host sync of the whole run
-    n_done = int(i)
-    traj_host = np.asarray(traj_buf[:n_done])
+    with _tele.device_tap(tap if stream else None):
+        i, done_tgt, done_stall, state, traj_buf = run(
+            *state, idxs_all, shard_arrays, test_arrays)
+        # the single host sync of the whole run
+        n_done = int(i)
+        traj_host = np.asarray(traj_buf[:n_done])
+        if stream:
+            # join the callback stream before leaving the tap context —
+            # the fetch orders the computation, not the host callbacks
+            jax.effects_barrier()
+    if tap is not None and not stream:
+        # fetch-fallback bridge: replay the buffer through the same tap
+        # the stream path uses — same rows, same decode, same events
+        for j in range(n_done):
+            tap(j, traj_host[j])
 
     traj = Trajectory(name, quiet=quiet)
     prev_sigma = None
@@ -621,6 +708,9 @@ def drive_on_device(
             # dispatch and one fetch — don't fabricate flat timestamps
             wall_time=None,
             sigma=sigma,
+            # events for this run were already emitted by the tap (live
+            # stream or fetch replay) — don't double-emit
+            emit=False,
         )
         if (not quiet and anneal and prev_sigma is not None
                 and sigma != prev_sigma):
@@ -718,17 +808,29 @@ def drive_device_full(
         t = head_end + 1
         if head_end % c == 0:
             primal, gap, test_err = eval_fn(state)
-            traj.log_round(head_end, primal=primal, gap=gap,
-                           test_error=test_err)
+            sigma_val = stage = stall_v = None
+            backed = False
             if anneal:
                 # host-stepped eval feeds the SAME in-state watch the
                 # device loop reads (sched_host_step is its bit-twin)
-                sched, _ = sched_host_step(state[-1], gap, watch.n,
-                                           len(sigma_levels))
+                sched, backed = sched_host_step(state[-1], gap, watch.n,
+                                                len(sigma_levels))
                 state = _sched_replace(state, sched)
-                traj.records[-1].sigma = sigma_levels[int(sched[0])]
+                stage = int(sched[0])
+                sigma_val = sigma_levels[stage]
+                stall_v = int(sched[1])
             else:
                 watch.update(gap)
+            traj.log_round(head_end, primal=primal, gap=gap,
+                           test_error=test_err, sigma=sigma_val,
+                           sigma_stage=stage, stall=stall_v)
+            if backed:
+                _emit_backoff(name, head_end, sigma_levels, stage, quiet,
+                              f"{name}: σ′ anneal — gap stalled for "
+                              f"{watch.n} evals; backing off to "
+                              f"σ′={sigma_levels[stage]:g} at round "
+                              f"{head_end} (iterate kept, certificate "
+                              f"exact)")
         maybe_ckpt(head_end)
 
     n_full = max(0, (params.num_rounds - (t - 1)) // c)

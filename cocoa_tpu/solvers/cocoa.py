@@ -904,6 +904,13 @@ def run_cocoa(
                 m = stamp.match(f)
                 if m and int(m.group(1)) <= last:
                     _os.remove(_os.path.join(ckpt_dir, f))
+        from cocoa_tpu.telemetry import events as _tele
+
+        _tele.get_bus().emit(
+            "restart", reason="sigma_trial_diverged",
+            algorithm="CoCoA+" if plus else "CoCoA",
+            sigma_trial=trial.sigma, sigma_safe=ds.k * params.gamma,
+            round=traj.records[-1].round if traj.records else 0)
         if not quiet:
             print(f"sigma=auto: σ′=K·γ/2={trial.sigma:g} diverged; "
                   f"restarting with the safe σ′=K·γ={ds.k * params.gamma:g}")

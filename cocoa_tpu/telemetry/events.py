@@ -1,0 +1,305 @@
+"""The host-side event bus and the device→host event bridge.
+
+One process-global :class:`EventBus` carries every run's structured
+telemetry: typed records appended to a JSONL sink (one JSON object per
+line, ``seq``-ordered) and fanned out synchronously to subscribers (the
+metrics writer, the round-windowed profiler, tests).  The bus is inert
+until configured — ``emit`` on an inactive bus is a no-op costing one
+attribute read, so the training hot paths carry no telemetry tax by
+default.
+
+The device bridge: the device-resident driver (solvers/base.py
+``drive_on_device``) computes one ``[primal, gap, test_err, sigma_stage,
+stall]`` row per eval inside its ``lax.while_loop``.  With the bus
+active, an **ordered** ``jax.experimental.io_callback`` posts each row to
+:func:`_device_sink` WHILE THE LOOP IS STILL ON DEVICE — the host sees
+``round_eval`` (and decoded ``sigma_backoff``) events live, in eval
+order.  Where ordered callbacks are unavailable (probed once per process
+by :func:`io_callback_supported`), the driver replays the SAME rows
+through the SAME :class:`DeviceTap` from its end-of-run fetch — the
+fallback emits bit-identical events, just late.  Either way the callback
+only reads values the loop already computes: the loop-carried state is
+untouched, so telemetry cannot perturb the run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+
+EVENT_TYPES = (
+    "run_start",        # manifest: full config + config hash + jax/device info
+    "round_eval",       # one debugIter-cadence evaluation
+    "sigma_backoff",    # the σ′ anneal schedule backed off a stage
+    "checkpoint_write", # a round-stamped checkpoint landed on disk
+    "restart",          # sigma=auto trial rerun, or an elastic gang restart
+    "divergence",       # the stall watch bailed the run out
+    "run_end",          # final summary (primal, gap, stopped reason)
+)
+
+
+def _clean(v):
+    """JSON-safe scalars: numpy numerics → python, NaN → None (JSON has no
+    NaN; a NaN metric means 'not applicable' everywhere in this codebase)."""
+    if isinstance(v, np.ndarray) and v.ndim == 0:
+        v = v.item()
+    if isinstance(v, np.floating):
+        v = float(v)
+    if isinstance(v, np.integer):
+        v = int(v)
+    if isinstance(v, float) and math.isnan(v):
+        return None
+    if isinstance(v, dict):
+        return {k: _clean(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_clean(x) for x in v]
+    return v
+
+
+class EventBus:
+    """Ordered, typed event stream with a JSONL sink and subscribers.
+
+    ``emit`` is thread-safe: the device bridge fires from the runtime's
+    callback thread while the main thread blocks on the run's host fetch.
+    Subscriber callbacks run inline under the lock — they must be cheap
+    (the metrics writer's atomic rewrite is ~µs at these event rates).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.jsonl_path = None
+        self.metrics_path = None
+        self._subscribers = []
+        self._seq = 0
+
+    def configure(self, jsonl_path=None, metrics_path=None):
+        """Attach sinks; either may be None.  The metrics path attaches a
+        :class:`cocoa_tpu.telemetry.metrics.MetricsWriter` subscriber."""
+        with self._lock:
+            self.jsonl_path = jsonl_path or None
+            if metrics_path and metrics_path != self.metrics_path:
+                from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+                self.subscribe(MetricsWriter(metrics_path))
+                self.metrics_path = metrics_path
+        return self
+
+    def active(self) -> bool:
+        return bool(self.jsonl_path or self._subscribers)
+
+    def subscribe(self, fn):
+        with self._lock:
+            self._subscribers.append(fn)
+        return fn
+
+    def unsubscribe(self, fn):
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
+
+    def reset(self):
+        """Detach every sink and zero the sequence (tests)."""
+        with self._lock:
+            self.jsonl_path = None
+            self.metrics_path = None
+            self._subscribers = []
+            self._seq = 0
+
+    def emit(self, event: str, **fields):
+        """Append one typed record; returns it (or None when inactive).
+
+        The record is sanitized ONCE (numpy scalars → python, NaN → None)
+        so the JSONL line and every subscriber see identical values — the
+        io_callback-path vs fetch-fallback parity the tests pin rests on
+        this single normalization point."""
+        if not self.active():
+            return None
+        if event not in EVENT_TYPES:
+            raise ValueError(f"unknown event type {event!r}; "
+                             f"expected one of {EVENT_TYPES}")
+        with self._lock:
+            self._seq += 1
+            # pid identifies the EMITTER: a supervised run interleaves
+            # several processes' appends (elastic supervisor + worker
+            # generations, each with its own seq counter) in one JSONL,
+            # and the schema checker orders per emitter
+            rec = {"event": event, "seq": self._seq, "pid": os.getpid(),
+                   "ts": time.time(),
+                   **{k: _clean(v) for k, v in fields.items()}}
+            if self.jsonl_path:
+                # open-append per event: whole-line writes interleave
+                # safely with other emitters of the same file (the elastic
+                # supervisor appends restart events between generations)
+                with open(self.jsonl_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            for fn in list(self._subscribers):
+                fn(rec)
+        return rec
+
+
+_BUS = EventBus()
+
+
+def get_bus() -> EventBus:
+    """The process-global bus every emitter and sink shares."""
+    return _BUS
+
+
+# --- run manifest -----------------------------------------------------------
+
+
+def config_hash(config: dict) -> str:
+    """Stable short hash of a config mapping (the run's identity in the
+    manifest and the trajectory header)."""
+    blob = json.dumps(_clean(config), sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def environment_manifest() -> dict:
+    """jax/device provenance for the run manifest.  Requires the backend
+    to be selected already (callers emit after CLI setup)."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "process_count": jax.process_count(),
+    }
+
+
+def run_manifest(config: dict, dataset=None) -> dict:
+    """The ``run_start`` payload: the full config, its hash, and the
+    jax/device environment."""
+    return {
+        "dataset": dataset,
+        "config": _clean(config),
+        "config_hash": config_hash(config),
+        **environment_manifest(),
+    }
+
+
+# --- the device bridge ------------------------------------------------------
+
+_IO_CALLBACK_OK = None
+
+
+def io_callback_supported() -> bool:
+    """Whether ordered ``io_callback`` works inside a jitted
+    ``lax.while_loop`` on this jax/backend (probed once per process with a
+    trivial three-iteration loop).  When False, the device driver falls
+    back to replaying events from its end-of-run fetch — same events,
+    same values, just not live."""
+    global _IO_CALLBACK_OK
+    if _IO_CALLBACK_OK is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.experimental import io_callback
+
+            seen = []
+
+            def probe(x):
+                def body(s):
+                    i, x = s
+                    io_callback(lambda i, v: seen.append(int(i)), None,
+                                i, x, ordered=True)
+                    return i + 1, x + 1.0
+                return lax.while_loop(lambda s: s[0] < 3, body,
+                                      (jnp.int32(0), x))
+
+            jax.jit(probe)(jnp.float32(0.0))[0].block_until_ready()
+            jax.effects_barrier()
+            _IO_CALLBACK_OK = seen == [0, 1, 2]
+        except Exception:
+            _IO_CALLBACK_OK = False
+    return _IO_CALLBACK_OK
+
+
+_DEVICE_TAP = None
+
+
+def _device_sink(i, row):
+    """The io_callback target: forward one eval row to the installed tap.
+    A row arriving with no tap installed (e.g. a cached executable rerun
+    outside a telemetry context) is dropped — side-effect-only either way."""
+    tap = _DEVICE_TAP
+    if tap is not None:
+        tap(i, row)
+
+
+@contextlib.contextmanager
+def device_tap(tap):
+    """Install ``tap`` as the destination for in-flight device events for
+    the duration of one dispatch+fetch.  Runs are sequential within a
+    process (the driver's fetch joins the loop before returning), so a
+    single slot suffices."""
+    global _DEVICE_TAP
+    prev = _DEVICE_TAP
+    _DEVICE_TAP = tap
+    try:
+        yield tap
+    finally:
+        _DEVICE_TAP = prev
+
+
+class DeviceTap:
+    """Decode device eval rows into bus events.
+
+    One instance serves BOTH bridge paths — the live io_callback stream
+    and the end-of-run fetch replay feed rows through the same
+    ``__call__`` — so the two paths emit identical events by construction
+    (the parity the tests pin).
+
+    Row layout (solvers/base.py ``_build_device_run``):
+    ``[primal, gap, test_err, sigma_stage, stall]`` — gap/test_err NaN
+    when not applicable, sigma_stage NaN outside σ′-anneal runs.
+
+    ``init_stage`` seeds backoff detection with the stage the state
+    ENTERED this dispatch at (the sched leaf rides super-block
+    boundaries), so a resumed or multi-block run never fabricates a
+    backoff for its first eval.
+    """
+
+    def __init__(self, bus, algorithm: str, start_round: int, cadence: int,
+                 sigma_levels=None, init_stage=None):
+        self.bus = bus
+        self.algorithm = algorithm
+        self.start_round = start_round
+        self.cadence = cadence
+        self.levels = sigma_levels
+        self._prev_stage = init_stage
+        self.count = 0
+
+    def __call__(self, i, row):
+        r = np.asarray(row, dtype=np.float64)
+        t = self.start_round - 1 + (int(i) + 1) * self.cadence
+        primal, gap, test_err, stage_f, stall = (float(v) for v in r[:5])
+        stage = None if math.isnan(stage_f) else int(stage_f)
+        sigma = (self.levels[stage]
+                 if self.levels is not None and stage is not None else None)
+        self.bus.emit(
+            "round_eval", algorithm=self.algorithm, t=t, primal=primal,
+            gap=gap, test_error=test_err, sigma=sigma, sigma_stage=stage,
+            stall=None if math.isnan(stall) else int(stall),
+        )
+        if (stage is not None and self._prev_stage is not None
+                and stage != self._prev_stage):
+            self.bus.emit(
+                "sigma_backoff", algorithm=self.algorithm, t=t,
+                sigma=sigma, from_sigma=self.levels[self._prev_stage],
+                stage=stage,
+            )
+        if stage is not None:
+            self._prev_stage = stage
+        self.count += 1

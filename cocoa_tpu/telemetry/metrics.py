@@ -1,0 +1,116 @@
+"""Prometheus-style textfile metrics, refreshed on every bus event.
+
+The contract (docs/DESIGN.md §Observability): a single plain-text file in
+the Prometheus exposition format, rewritten ATOMICALLY (temp + rename, the
+node-exporter textfile-collector convention) on every event, so the
+elastic supervisor's stall watchdog and any external scraper can watch a
+run that is otherwise one opaque device dispatch:
+
+- ``cocoa_rounds_total``        counter — training rounds advanced
+- ``cocoa_evals_total``         counter — debugIter-cadence evaluations
+- ``cocoa_sigma_backoffs_total``counter — σ′ anneal backoffs
+- ``cocoa_restarts_total``      counter — trial reruns + gang restarts
+- ``cocoa_last_gap``            gauge   — most recent duality gap
+- ``cocoa_round_seconds``       histogram — observed per-round wall time
+  (host-clock deltas between consecutive evals divided by the rounds
+  between them; on the device-resident path these are the io_callback
+  arrival times — the only per-round timing that path can observe)
+
+Counters are process-lifetime (a CLI invocation runs several algorithms;
+their rounds accumulate).  The writer is a plain bus subscriber —
+``EventBus.configure(metrics_path=...)`` attaches it.
+"""
+
+from __future__ import annotations
+
+import os
+
+BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+           0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class MetricsWriter:
+    def __init__(self, path: str):
+        self.path = path
+        self.rounds_total = 0
+        self.evals_total = 0
+        self.sigma_backoffs_total = 0
+        self.restarts_total = 0
+        self.last_gap = None
+        self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
+        self.hist_sum = 0.0
+        self.hist_count = 0
+        # per-algorithm (last round, last event ts) — the round_seconds
+        # denominators; cleared on run_start so a restarted run's first
+        # eval never spans the gap across generations
+        self._prev = {}
+        self.write()
+
+    def _observe(self, seconds_per_round: float):
+        self.hist_sum += seconds_per_round
+        self.hist_count += 1
+        for j, b in enumerate(BUCKETS):
+            if seconds_per_round <= b:
+                self.bucket_counts[j] += 1
+                return
+        self.bucket_counts[-1] += 1
+
+    def __call__(self, rec: dict):
+        ev = rec.get("event")
+        if ev == "run_start":
+            self._prev.clear()
+        elif ev == "round_eval":
+            self.evals_total += 1
+            if rec.get("gap") is not None:
+                self.last_gap = float(rec["gap"])
+            t = rec.get("t")
+            alg = rec.get("algorithm")
+            if isinstance(t, int):
+                prev = self._prev.get(alg)
+                if prev is not None and t > prev[0]:
+                    dt_rounds = t - prev[0]
+                    self.rounds_total += dt_rounds
+                    self._observe((rec["ts"] - prev[1]) / dt_rounds)
+                # no prev: the first observed eval anchors the counter but
+                # adds nothing — a resumed run's t includes rounds a
+                # PREVIOUS process (or generation) executed, and crediting
+                # them here would re-count the whole history on every
+                # elastic restart.  Cost: up to one eval cadence of rounds
+                # per run goes uncounted — resume-safe beats exact-once.
+                self._prev[alg] = (t, rec["ts"])
+        elif ev == "sigma_backoff":
+            self.sigma_backoffs_total += 1
+        elif ev == "restart":
+            self.restarts_total += 1
+        self.write()
+
+    def render(self) -> str:
+        lines = [
+            "# TYPE cocoa_rounds_total counter",
+            f"cocoa_rounds_total {self.rounds_total}",
+            "# TYPE cocoa_evals_total counter",
+            f"cocoa_evals_total {self.evals_total}",
+            "# TYPE cocoa_sigma_backoffs_total counter",
+            f"cocoa_sigma_backoffs_total {self.sigma_backoffs_total}",
+            "# TYPE cocoa_restarts_total counter",
+            f"cocoa_restarts_total {self.restarts_total}",
+        ]
+        if self.last_gap is not None:
+            lines += ["# TYPE cocoa_last_gap gauge",
+                      f"cocoa_last_gap {self.last_gap!r}"]
+        lines.append("# TYPE cocoa_round_seconds histogram")
+        cum = 0
+        for b, c in zip(BUCKETS, self.bucket_counts):
+            cum += c
+            lines.append(f'cocoa_round_seconds_bucket{{le="{b}"}} {cum}')
+        lines.append(f'cocoa_round_seconds_bucket{{le="+Inf"}} '
+                     f"{cum + self.bucket_counts[-1]}")
+        lines.append(f"cocoa_round_seconds_sum {self.hist_sum!r}")
+        lines.append(f"cocoa_round_seconds_count {self.hist_count}")
+        return "\n".join(lines) + "\n"
+
+    def write(self):
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.render())
+        os.replace(tmp, self.path)

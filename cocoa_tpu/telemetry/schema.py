@@ -1,0 +1,219 @@
+"""JSONL schema checker for the telemetry artifacts.
+
+One dependency-free validator shared by tests/test_telemetry.py and the CI
+telemetry step, covering the three JSONL dialects this repo emits:
+
+- **event streams** (``--events``, telemetry/events.py): every line has
+  ``event``/``seq``/``ts``, per-type required fields, and ``seq`` is
+  strictly increasing — the ordering guarantee the ordered io_callback
+  bridge provides;
+- **trajectory dumps** (``--trajOut``, utils/logging.Trajectory): a
+  manifest header line followed by per-round records, ``stopped`` carried
+  on the final record;
+- **benchmark results** (benchmarks/results.jsonl): one config row per
+  line.
+
+Usage: ``python -m cocoa_tpu.telemetry.schema FILE...`` — the dialect is
+sniffed per file from its first line; exit code 1 on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+_NUM = (int, float)
+_OPT_NUM = (int, float, type(None))
+
+# event type -> {field: allowed types}; every event also needs seq/ts
+EVENT_FIELDS = {
+    "run_start": {"manifest": (dict,)},
+    "round_eval": {"algorithm": (str,), "t": (int,), "primal": _NUM,
+                   "gap": _OPT_NUM, "test_error": _OPT_NUM,
+                   "sigma": _OPT_NUM, "stall": _OPT_NUM},
+    "sigma_backoff": {"algorithm": (str,), "t": (int,), "sigma": _NUM,
+                      "from_sigma": _NUM},
+    "checkpoint_write": {"algorithm": (str,), "round": (int,),
+                         "path": (str,)},
+    "restart": {"reason": (str,)},
+    "divergence": {"algorithm": (str,), "t": (int,), "n_evals": (int,)},
+    "run_end": {"algorithm": (str,), "stopped": (str, type(None))},
+}
+
+TRAJ_RECORD_FIELDS = {
+    "algorithm": (str,),
+    "round": (int,),
+    "wall_time": _OPT_NUM,
+    "primal": _OPT_NUM,
+    "gap": _OPT_NUM,
+    "test_error": _OPT_NUM,
+    "sigma": _OPT_NUM,
+}
+
+# benchmarks/results.jsonl: "config" identifies the row; every OTHER known
+# key is type-checked when present (rows carry different column subsets —
+# svm vs lasso vs perf-accounting)
+RESULTS_FIELDS = {
+    "config": (str,), "n": (int,), "d": (int,), "k": (int,),
+    "lam": _NUM, "rounds": (int,), "gap": _NUM, "primal": _NUM,
+    "wallclock_s": _NUM, "fixed_s": _NUM, "l2": _NUM,
+    "vs_oracle": _NUM, "vs_oracle_same_gap": _NUM, "oracle_basis": (str,),
+    "type": (str,), "device": (str,), "ms_per_round": _NUM,
+    "us_per_step": _NUM, "useful_gflops": _NUM, "physical_gflops": _NUM,
+    "mfu_pct": _NUM, "physical_mfu_pct": _NUM, "hbm_floor_ms": _NUM,
+    "hbm_bound_pct": _NUM, "bound": (str,),
+    # h / gap_target are numeric but legacy rows carry e.g. "n/a"
+    "h": (int, str), "gap_target": (int, float, str),
+}
+
+
+def _typecheck(obj, fields, where, errors, required=True):
+    for name, types in fields.items():
+        if name not in obj:
+            if required:
+                errors.append(f"{where}: missing field {name!r}")
+            continue
+        v = obj[name]
+        if isinstance(v, bool) or not isinstance(v, types):
+            errors.append(f"{where}: field {name!r} has type "
+                          f"{type(v).__name__}, expected "
+                          f"{'/'.join(t.__name__ for t in types)}")
+
+
+def check_event_lines(objs) -> list:
+    """Validate an event stream; returns a list of error strings.
+
+    ``seq`` must be strictly increasing PER EMITTER (``pid``): a
+    supervised run interleaves several processes' whole-line appends in
+    one file — the elastic supervisor's restart events between worker
+    generations, each generation's fresh EventBus — and each emitter
+    counts its own seq from 1.  The ordering guarantee (the ordered
+    io_callback bridge) is per run, which is per emitter."""
+    errors = []
+    prev_seq = {}
+    for ln, obj in objs:
+        where = f"line {ln}"
+        ev = obj.get("event")
+        if ev not in EVENT_FIELDS:
+            errors.append(f"{where}: unknown event type {ev!r}")
+            continue
+        seq = obj.get("seq")
+        if not isinstance(seq, int) or isinstance(seq, bool):
+            errors.append(f"{where}: missing/invalid seq")
+        else:
+            # pre-pid streams validate as one emitter (pid None); a
+            # restarted worker generation is a NEW process with a new
+            # pid, so per-pid strict ordering covers supervised runs too
+            pid = obj.get("pid")
+            prev = prev_seq.get(pid, 0)
+            if seq <= prev:
+                errors.append(f"{where}: seq {seq} not increasing "
+                              f"(prev {prev} for pid {pid}) — event order "
+                              f"violated")
+            prev_seq[pid] = seq
+        if not isinstance(obj.get("ts"), _NUM):
+            errors.append(f"{where}: missing/invalid ts")
+        _typecheck(obj, EVENT_FIELDS[ev], where, errors)
+    return errors
+
+
+def check_trajectory_lines(objs) -> list:
+    """Validate a --trajOut dump: manifest header, per-round records,
+    ``stopped`` on the final record."""
+    errors = []
+    if not objs:
+        return ["empty trajectory file"]
+    ln0, head = objs[0]
+    man = head.get("manifest")
+    if not isinstance(man, dict):
+        errors.append(f"line {ln0}: first line must carry the run manifest")
+    else:
+        for name in ("algorithm", "config_hash", "jax_version", "backend"):
+            if name not in man:
+                errors.append(f"line {ln0}: manifest missing {name!r}")
+    for j, (ln, obj) in enumerate(objs[1:]):
+        _typecheck(obj, TRAJ_RECORD_FIELDS, f"line {ln}", errors)
+    if len(objs) > 1:
+        ln, last = objs[-1]
+        if "stopped" not in last:
+            errors.append(f"line {ln}: final record must carry 'stopped' "
+                          f"(null = ran its full round budget)")
+        elif not isinstance(last["stopped"], (str, type(None))):
+            errors.append(f"line {ln}: 'stopped' must be a string or null")
+    return errors
+
+
+def check_results_lines(objs) -> list:
+    """Validate benchmarks/results.jsonl rows."""
+    errors = []
+    for ln, obj in objs:
+        where = f"line {ln}"
+        if not isinstance(obj.get("config"), str):
+            errors.append(f"{where}: missing/invalid 'config'")
+        _typecheck(obj, RESULTS_FIELDS, where, errors, required=False)
+    return errors
+
+
+def sniff(objs) -> str:
+    """Dialect from the first line: 'events' | 'trajectory' | 'results'."""
+    if not objs:
+        return "events"
+    head = objs[0][1]
+    if "event" in head:
+        return "events"
+    if "manifest" in head:
+        return "trajectory"
+    return "results"
+
+
+_CHECKERS = {"events": check_event_lines,
+             "trajectory": check_trajectory_lines,
+             "results": check_results_lines}
+
+
+def check_file(path: str, kind: str = "auto") -> list:
+    """Parse + validate one JSONL file; returns a list of error strings."""
+    objs = []
+    errors = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                errors.append(f"line {ln}: invalid JSON ({e})")
+                continue
+            if not isinstance(obj, dict):
+                errors.append(f"line {ln}: expected a JSON object")
+                continue
+            objs.append((ln, obj))
+    if kind == "auto":
+        kind = sniff(objs)
+    if kind not in _CHECKERS:
+        raise ValueError(f"unknown dialect {kind!r}")
+    return errors + _CHECKERS[kind](objs)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m cocoa_tpu.telemetry.schema FILE...",
+              file=sys.stderr)
+        return 2
+    bad = 0
+    for path in argv:
+        errs = check_file(path)
+        if errs:
+            bad += 1
+            print(f"{path}: {len(errs)} schema violation(s)")
+            for e in errs[:20]:
+                print(f"  {e}")
+        else:
+            print(f"{path}: ok")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
