@@ -97,14 +97,15 @@ def main():
     rows = []
 
     def add(config, kernel, ds, params, k, *, layout, nnz, path, block=0,
-            max_nnz=None, **kw):
+            max_nnz=None, n_hot=0, coverage=0.0, **kw):
         if block:
             kw["block"] = block   # the parts-layer kwarg drives the kernel
         secs = measure(ds, params, k, **kw)
         model = perf.sdca_round_model(params.n, ds.num_features, k,
                                       params.local_iters, layout=layout,
                                       nnz=nnz, path=path, block=block,
-                                      max_nnz=max_nnz)
+                                      max_nnz=max_nnz, n_hot=n_hot,
+                                      coverage=coverage)
         row = perf.account(f"{config}/{kernel}", secs, model,
                            steps=k * params.local_iters)
         rows.append(row)
@@ -170,6 +171,30 @@ def main():
         path="sparse-block", block=128, pallas=False, block_chain="pallas",
         block_sparse_gram=True,
         max_nnz=int(rc.sp_indices.shape[-1]))
+    # the hot/cold column split (--hotCols, round 10): the hottest ~2k
+    # columns move into a dense MXU panel; the scalar-issue-bound stream
+    # merges (97.8% of the measured round) run only the cold residual.
+    # hybrid-seq A/Bs against pallas-seq, hybrid-block against
+    # sparse-block — same sampled streams, same math (trajectory parity
+    # pinned by tests/test_hybrid_sparse.py); the calibrated latency
+    # model (perf.predict_sparse_round_ms) expects the seq round to drop
+    # from the measured 6.16 ms to ~2.2 ms at 75% coverage.
+    from cocoa_tpu.data.hybrid import resolve_hot_cols
+
+    n_hot, split = resolve_hot_cols("auto", data, k, jnp.float32)
+    rc_h = shard_dataset(data, k=k, layout="sparse", dtype=jnp.float32,
+                         hot_cols=n_hot)
+    print(json.dumps({"config": "rcv1/hot-split", **{
+        kk: split[kk] for kk in ("hot_cols", "coverage",
+                                 "residual_mean_nnz", "residual_max_nnz",
+                                 "panel_bytes")}}))
+    add("rcv1", "hybrid-seq", rc_h, p_rc, k, layout="sparse", nnz=nnz,
+        path="hybrid-seq", pallas=True,
+        n_hot=n_hot, coverage=split["coverage"])
+    add("rcv1", "hybrid-block", rc_h, p_rc, k, layout="sparse", nnz=nnz,
+        path="hybrid-block", block=128, pallas=False, block_chain="pallas",
+        block_sparse_gram=True, max_nnz=int(rc_h.sp_indices.shape[-1]),
+        n_hot=n_hot, coverage=split["coverage"])
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "KERNELS.md")
@@ -244,6 +269,26 @@ def main():
                 f"path: {rdense / rsp:.2f}x over the densified blocks, "
                 f"{rseq / rsp:.2f}x vs sequential.  `--blockSize=auto` "
                 f"picks the right kernel per layout.\n"
+            )
+        hseq = eps_rows.get("rcv1/hybrid-seq")
+        hblk = eps_rows.get("rcv1/hybrid-block")
+        if rseq and hseq:
+            # predicted from the SAME resolved split the rows above ran
+            pred = perf.predict_sparse_round_ms(
+                k * p_rc.local_iters, nnz, n_hot=n_hot,
+                coverage=split["coverage"])
+            f.write(
+                f"\nHot/cold split A/B (`--hotCols=auto`, docs/DESIGN.md "
+                f"§3b-vi): `hybrid-seq` {hseq} ms vs `pallas-seq` {rseq} "
+                f"ms (**{rseq / hseq:.2f}x**)"
+                + (f"; `hybrid-block` {hblk} ms vs `sparse-block` {rsp} "
+                   f"ms (**{rsp / hblk:.2f}x**)" if hblk and rsp else "")
+                + f".  The calibrated slot-latency model predicted "
+                  f"~{pred:.1f} ms for the hybrid seq round "
+                  f"(perf.predict_sparse_round_ms).  Same sampled "
+                  f"streams, same math — the split permutes each "
+                  f"per-nonzero sum (tests/test_hybrid_sparse.py); "
+                  f"`--hotCols=off` is the bit-exact stream control.\n"
             )
     print(f"wrote {out}")
     return 0

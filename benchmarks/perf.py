@@ -61,8 +61,38 @@ def device_info():
     return kind, PEAKS.get(kind), HBM_BW.get(kind)
 
 
+# Calibrated per-slot cost of the sparse stream merge loops: the kernel
+# is scalar-issue-bound (~6 scalar ops per nonzero slot, docs/DESIGN.md
+# §3d), and TRACE.md measured the rcv1 stream round at 6.16 ms over
+# 2024 steps of 96 GROUP-rounded slots each (mean 73.6 nnz/row ->
+# ceil(73.6/32)*32 = 96) — so one slot costs ~31.7 ns regardless of W.
+SEQ_SLOT_NS = 6.16e6 / (2024 * 96)
+# one whole-(1, 128)-lane-row VPU op (the hybrid panel's unit of work)
+PANEL_LANE_ROW_NS = 3.0
+
+
+def predict_sparse_round_ms(steps, nnz, *, n_hot=0, coverage=0.0,
+                            group=32):
+    """Latency prediction for the scalar-issue-bound sparse sequential
+    paths, from the calibrated per-slot cost: per step the stream loops
+    pay ceil(nnz_cold / GROUP)·GROUP slots at :data:`SEQ_SLOT_NS`, and a
+    hot panel (``n_hot > 0``, the hybrid layout) adds two whole-array
+    VPU passes over n_hot/128 lane-rows (margin reduce + Δw axpy).  The
+    pure-stream case (coverage 0) reproduces the measured 6.16 ms rcv1
+    round by construction; the hybrid prediction is what the split is
+    expected to buy before a TPU measures it (benchmarks/kernels.py
+    ``rcv1/hybrid-seq``)."""
+    import math
+
+    cold = nnz * (1.0 - coverage)
+    slots = math.ceil(cold / group) * group if cold > 0 else 0
+    panel_ns = 2.0 * (n_hot / 128.0) * PANEL_LANE_ROW_NS if n_hot else 0.0
+    return steps * (slots * SEQ_SLOT_NS + panel_ns) * 1e-6
+
+
 def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
-                     block=0, itemsize=4, max_nnz=None):
+                     block=0, itemsize=4, max_nnz=None, n_hot=0,
+                     coverage=0.0):
     """FLOP and HBM-byte model of ONE outer round of the SDCA family.
 
     Returns a dict with ``useful_flops``, ``physical_flops``, ``hbm_bytes``.
@@ -93,6 +123,13 @@ def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
       kernel).
     - ``"exact"`` — like fast but the margin dot reads w directly (same
       counts; no margins pass, the x·w dot replaces the x·Δw dot).
+    - ``"hybrid-seq"`` / ``"hybrid-block"`` — the hot/cold column split
+      (``--hotCols``; ``n_hot`` panel lanes covering ``coverage`` of the
+      nonzeros): the panel majority runs at MXU/VPU rates, only the
+      residual tail (``nnz·(1−coverage)`` mean, padded width ``max_nnz``
+      = the RESIDUAL width) pays the 128x-physical stream price.  Useful
+      work is the reference's per-nonzero math — the split permutes
+      sums, it never adds math.
     """
     nnz = d if nnz is None else nnz
     row_bytes = (2 * itemsize if layout == "sparse" else itemsize) * nnz
@@ -151,6 +188,51 @@ def sdca_round_model(n, d, k, h, *, layout="dense", nnz=None, path="fast",
     if path == "exact":
         return dict(useful_flops=useful, physical_flops=useful,
                     hbm_bytes=steps * row_bytes)
+    if path in ("hybrid-seq", "hybrid-block"):
+        # the hot/cold column split (--hotCols, docs/DESIGN.md §3b-vi):
+        # ``coverage`` of the nonzeros ride the dense hot panel (n_hot
+        # lanes) at MXU/VPU rates; only the residual tail pays the
+        # 128x-physical scalar-port stream price.  Useful work is the
+        # reference's per-nonzero math, unchanged by the split.
+        nnz_cold = nnz * (1.0 - coverage)
+        margins = 2.0 * nnz * steps
+        cold_bytes = 2 * itemsize * nnz_cold        # residual CSR idx+val
+        panel_row = n_hot * itemsize                # one row's panel slice
+        if path == "hybrid-seq":
+            # per step: residual margin dot + axpy on the streams
+            # ((4+2)·nnz_cold slots, each a 128-lane masked op) + the
+            # panel's margin reduce (2 passes of n_hot MACs: w and Δw)
+            # and Δw axpy (1 pass) as whole-array VPU work.  HBM: the
+            # residual stream tables + the gathered panel row
+            # (gather write + kernel read).
+            physical = (6.0 * nnz_cold * 128 + 6.0 * n_hot) * steps
+            hbm = steps * (cold_bytes + 2 * panel_row)
+            return dict(useful_flops=useful + margins, physical_flops=physical,
+                        hbm_bytes=hbm)
+        from cocoa_tpu.ops.pallas_sparse import seg_rows
+
+        # hybrid-block: the residual streams run the sparse-block Gram
+        # machinery (same accounting as "sparse-block", on the COLD
+        # width), and the panel adds per step 2·B·n_hot Gram MACs +
+        # 2·n_hot margin + 2·n_hot apply on the MXU.  HBM: residual
+        # streams per segment pair + [w|Δw] operands + the panel tile
+        # (gather write + the three einsums' reads).
+        b = max(1, block)
+        gram_cold = 2.0 * b * nnz_cold * steps
+        physical = ((4.0 * nnz_cold + 2.0 * nnz_cold + gram_cold / steps)
+                    * 128 + 2.0 * b * n_hot + 4.0 * n_hot) * steps
+        s = seg_rows(b, int(max_nnz if max_nnz is not None else nnz_cold)) \
+            or b
+        ns = b // s
+        pairs = ns * (ns + 1) // 2
+        d_pad = -(-d // 128) * 128
+        blocks = steps / b
+        wd_bytes = 2 * d_pad * itemsize
+        hbm = (steps * cold_bytes * (pairs + ns) / ns
+               + blocks * (pairs * wd_bytes + ns * 2 * wd_bytes)
+               + steps * 4 * panel_row)
+        return dict(useful_flops=useful + margins, physical_flops=physical,
+                    hbm_bytes=hbm)
     raise ValueError(f"unknown path {path!r}")
 
 

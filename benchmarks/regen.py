@@ -13,8 +13,11 @@ What it runs (exactly the marked surface, nothing else):
 
 - ``benchmarks/kernels.py`` — regenerates KERNELS.md including the
   pipelined-vs-serial A/B rows (``block-128`` vs ``block-128-serial``,
-  distinct twins) and the B ∈ {128, 256, 512} sweep behind
-  ``--blockSize=auto``'s measured ranking;
+  distinct twins), the B ∈ {128, 256, 512} sweep behind
+  ``--blockSize=auto``'s measured ranking, and the round-10 hot/cold
+  split A/B rows (``rcv1/hybrid-seq`` vs ``rcv1/pallas-seq``,
+  ``rcv1/hybrid-block`` vs ``rcv1/sparse-block`` — currently model
+  predictions, never measured);
 - ``benchmarks/run.py --only epsilon,losses`` — the ⚠ block rows
   (epsilon-cocoa+(block128), permuted+block128, smooth_hinge/logistic
   block rows);

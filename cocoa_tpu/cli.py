@@ -59,6 +59,18 @@ processes, restart-from-checkpoint on any death), and
 ``--stallTimeout=S`` (with --elastic: also restart a gang that stops
 making checkpoint progress for S seconds without any process dying).
 
+``--hotCols=auto|off|<n>`` (sparse layout only) builds the HYBRID
+hot/cold column-split layout (data/hybrid.py, docs/DESIGN.md §3b-vi):
+the globally hottest columns move into a dense MXU-friendly panel and
+the padded-CSR keeps only the cold residual — the scalar-issue-bound
+stream merges (97.8% of the measured rcv1 round) shrink by the
+coverage fraction.  ``auto`` resolves a 75%-coverage panel under an
+explicit HBM budget (panel bytes reported); ``off`` keeps the stream
+layout bit-exactly as the A/B control.  ``--evalDense`` additionally
+accepts ``auto``: materialize the dense eval twin only when it fits
+the HBM budget, otherwise (with a hot panel) the certificate margins
+ride the panel matvec + residual stream.
+
 ``--objective=lasso`` switches to the ProxCoCoA+ L1 family
 (solvers/prox_cocoa.py): labels become the regression target b,
 ``--lambda`` the L1 weight, ``--l2`` the optional elastic-net weight;
@@ -87,7 +99,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "profile", "objective", "l2", "blockSize",
                 "blockPipeline", "divergenceGuard",
                 "sigmaSchedule", "warmStart",
-                "elastic", "stallTimeout", "evalDense",
+                "elastic", "stallTimeout", "evalDense", "hotCols",
                 "metrics", "events", "quiet")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
@@ -411,7 +423,9 @@ def main(argv=None) -> int:
     # (worker 0 of an elastic gang / host 0 of a pod inherits stdout the
     # same way); the run manifest is the FULL flag surface — reference
     # flags and TPU-native extras alike — so the config hash identifies
-    # the run end to end
+    # the run end to end.  The ``run_start`` emit itself waits until the
+    # data layout is resolved (below) so the manifest can record the
+    # hot/cold split provenance; cfg/extras are not mutated in between.
     from cocoa_tpu import telemetry
 
     bus = telemetry.get_bus()
@@ -423,9 +437,6 @@ def main(argv=None) -> int:
                     **{k: v for k, v in extras.items() if v is not None}}
     run_meta = {"dataset": cfg.train_file, "seed": cfg.seed,
                 "config_hash": telemetry.events.config_hash(cfg_manifest)}
-    if bus.active():
-        bus.emit("run_start", manifest=telemetry.events.run_manifest(
-            cfg_manifest, dataset=cfg.train_file))
 
     try:
         data = load_libsvm(cfg.train_file, cfg.num_features)
@@ -501,9 +512,74 @@ def main(argv=None) -> int:
         return 2
 
     # same bare-flag/boolean convention as --deviceLoop: present (or any
-    # value except "false") enables it
-    eval_dense = (extras["evalDense"] is not None
-                  and str(extras["evalDense"]).lower() != "false")
+    # value except "false") enables it — except the new "auto", which
+    # resolves per dataset below (twin only when it fits the HBM budget)
+    ed_spec = ("false" if extras["evalDense"] is None
+               else str(extras["evalDense"]).lower())
+    eval_dense = ed_spec not in ("false", "auto")
+
+    # --hotCols=auto|off|<n>: the hot/cold column split (sparse layout
+    # only, data/hybrid.py).  Resolved HERE — against the measured column
+    # histogram, with the panel's HBM bytes accounted explicitly — so the
+    # run_start manifest records the split the run actually trains on.
+    from cocoa_tpu.data import resolve_hot_cols, resolve_layout
+
+    hot_n = 0
+    layout_split = None
+    if objective == "lasso" and extras["hotCols"] is not None:
+        # column shards transpose the roles (the shard "rows" ARE
+        # columns); a row-space hot panel has no meaning there
+        print("error: --hotCols does not apply to --objective=lasso "
+              "(column shards already partition the feature axis)",
+              file=sys.stderr)
+        return 2
+    if objective == "svm":
+        resolved_layout = resolve_layout(data, cfg.layout, mesh)
+        if extras["hotCols"] is not None and resolved_layout != "sparse":
+            print("error: --hotCols (the hot/cold column split) only "
+                  "applies to the sparse layout", file=sys.stderr)
+            return 2
+        if resolved_layout == "sparse":
+            try:
+                hot_n, layout_split = resolve_hot_cols(
+                    extras["hotCols"], data, k, dtype)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            if ed_spec == "auto":
+                # materialize the dense eval twin only when it fits the
+                # HBM budget; otherwise the certificate margins ride the
+                # hot panel + residual stream when a panel exists
+                # (ops/rows.eval_margins), or the plain gather without one
+                from cocoa_tpu.data.sharding import eval_dense_fits
+
+                eval_dense = eval_dense_fits(n, cfg.num_features, k, dtype)
+                if not quiet:
+                    fallback = ("hot panel + residual stream" if hot_n
+                                else "per-nonzero gather (no hot panel — "
+                                     "consider --hotCols=auto)")
+                    print(f"evalDense=auto: "
+                          f"{'dense twin' if eval_dense else fallback} "
+                          f"for the certificate margins")
+            if hot_n and not quiet:
+                print(f"hotCols={layout_split['spec']}: panel {hot_n} "
+                      f"columns, {layout_split['coverage'] * 100:.1f}% "
+                      f"nonzero coverage, "
+                      f"{layout_split['panel_bytes'] / 2**20:.1f} MiB HBM, "
+                      f"residual mean nnz "
+                      f"{layout_split['residual_mean_nnz']:.1f} (max "
+                      f"{layout_split['residual_max_nnz']})")
+
+    if layout_split is not None:
+        cfg_manifest["layout_split"] = layout_split
+        run_meta["config_hash"] = telemetry.events.config_hash(cfg_manifest)
+    if bus.active():
+        manifest = telemetry.events.run_manifest(cfg_manifest,
+                                                 dataset=cfg.train_file)
+        if layout_split is not None:
+            manifest["layout_split"] = dict(layout_split)
+        bus.emit("run_start", manifest=manifest)
+
     try:
         ds = test_ds = None
         if objective == "svm":
@@ -512,12 +588,14 @@ def main(argv=None) -> int:
             # matvec instead of an every-nonzero w-gather (31% of the
             # rcv1 production round); costs K*n_shard*d*itemsize HBM
             ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype,
-                               mesh=mesh, eval_dense=eval_dense)
+                               mesh=mesh, eval_dense=eval_dense,
+                               hot_cols=hot_n)
             if cfg.test_file:
                 test_data = load_libsvm(cfg.test_file, cfg.num_features)
                 test_ds = shard_dataset(test_data, k=k, layout=cfg.layout,
                                         dtype=dtype, mesh=mesh,
-                                        eval_dense=eval_dense)
+                                        eval_dense=eval_dense,
+                                        hot_cols=hot_n)
     except (OSError, ValueError) as e:  # e.g. --layout=sparse with --fp>1
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -1,5 +1,10 @@
 from cocoa_tpu.data.libsvm import load_libsvm, LibsvmData  # noqa: F401
-from cocoa_tpu.data.sharding import ShardedDataset, shard_dataset  # noqa: F401
+from cocoa_tpu.data.sharding import (  # noqa: F401
+    ShardedDataset,
+    resolve_layout,
+    shard_dataset,
+)
+from cocoa_tpu.data.hybrid import resolve_hot_cols  # noqa: F401
 from cocoa_tpu.data.columns import shard_columns  # noqa: F401
 from cocoa_tpu.data.synth import (  # noqa: F401
     synth_dense,

@@ -1,0 +1,232 @@
+"""Hot/cold column split for the sparse (padded-CSR) layout.
+
+Sparse text workloads (rcv1-like) have Zipf column popularity: a small
+set of globally hot columns carries the majority of all nonzeros (the
+hottest ~2-4k of rcv1's 47k columns cover ~3/4 of the entries).  The
+sparse kernels pay a *scalar-issue-bound* merge loop per nonzero
+(~6 scalar ops each — docs/DESIGN.md §3d), so every nonzero moved out of
+the streams and into a dense panel is paid for at MXU/VPU rates instead.
+
+The split (docs/DESIGN.md §3b-vi):
+
+- a **hot panel** ``X_hot`` (K, n_shard, n_hot): each row's values at the
+  globally hottest ``n_hot`` columns, dense (zero where the row lacks the
+  column), lane-aligned (n_hot a multiple of 128).  ``hot_cols``
+  (n_hot,) maps panel lanes back to original column ids.
+- a **cold residual** padded-CSR holding only the surviving tail
+  nonzeros — the scalar merge loops shrink proportionally to
+  1 − coverage, and the padded width drops with the tail's max.
+
+The panel is **global and static** — chosen ONCE from the whole
+dataset's column-frequency histogram, identical for every shard and
+every sampled block.  This is what survives the §3b-iv refutation of
+per-block compact supports: a 128-row block still touches ~4.4k distinct
+columns, but under Zipf most of those *occurrences* land in the same few
+thousand globally-hot columns, so one fixed panel serves every block.
+
+The split is a partition of each row's nonzeros by column — a
+permutation of every per-nonzero sum the solvers compute — so the math
+is unchanged (identical in real arithmetic; floating point reassociates,
+so trajectories are pinned at f64 against the sequential chain exactly
+like the round-6 kernel was, tests/test_hybrid_sparse.py).
+
+``--hotCols=auto|off|<n>`` resolves through :func:`resolve_hot_cols`
+under explicit HBM accounting: the panel costs
+``K · n_shard · n_hot · itemsize`` bytes (~166 MB at rcv1 scale with
+n_hot=2048), reported up front and rejected when it exceeds the budget.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cocoa_tpu.data.libsvm import LibsvmData
+
+PANEL_LANES = 128            # panel width granularity (TPU lane width)
+HOT_COVERAGE_TARGET = 0.75   # --hotCols=auto aims at this nonzero coverage
+HOT_PANEL_HBM_BUDGET = 2 << 30   # 2 GiB — the panel is capacity buying
+                                 # scalar-port relief, same trade as the
+                                 # eval twin (docs/DESIGN.md §3d-ii)
+
+
+def pad_panel(n: int) -> int:
+    """Panel width rounded up to whole 128-lane blocks (padded columns
+    carry value 0 everywhere and column id 0 — inert in every dot,
+    scatter, and gather, the standing inertness trick)."""
+    return -(-n // PANEL_LANES) * PANEL_LANES
+
+
+def column_counts(data: LibsvmData) -> np.ndarray:
+    """(d,) global column-frequency histogram — how many rows carry each
+    column.  The measured basis every resolution decision reads."""
+    return np.bincount(data.indices, minlength=data.num_features)
+
+
+def hottest_columns(counts: np.ndarray, n_hot: int) -> np.ndarray:
+    """The ``n_hot`` most frequent column ids, returned SORTED ASCENDING
+    (deterministic: count descending, id ascending tiebreak, then sorted
+    by id so the panel's lane order is reproducible and gathers walk w
+    monotonically)."""
+    n_hot = min(int(n_hot), len(counts))
+    if n_hot <= 0:
+        return np.zeros(0, dtype=np.int32)
+    order = np.lexsort((np.arange(len(counts)), -counts))
+    return np.sort(order[:n_hot]).astype(np.int32)
+
+
+def hot_rank(num_features: int, hot_ids: np.ndarray) -> np.ndarray:
+    """(d,) lookup: column id -> panel lane, or -1 for cold columns."""
+    rank = np.full(num_features, -1, dtype=np.int64)
+    rank[hot_ids] = np.arange(len(hot_ids))
+    return rank
+
+
+def split_stats(data: LibsvmData, hot_ids: np.ndarray) -> dict:
+    """Measured facts of one candidate split: nonzero coverage and the
+    residual's per-row nnz distribution (mean and max — the max IS the
+    residual padded-CSR width the streams will pay)."""
+    rank = hot_rank(data.num_features, hot_ids)
+    is_hot = rank[data.indices] >= 0
+    row_nnz = np.diff(data.indptr)
+    rows = np.repeat(np.arange(data.n, dtype=np.int64), row_nnz)
+    cold_per_row = np.bincount(rows[~is_hot], minlength=data.n)
+    total = max(1, int(data.indptr[-1]))
+    return {
+        "coverage": float(is_hot.sum() / total),
+        "residual_mean_nnz": float(cold_per_row.mean()) if data.n else 0.0,
+        "residual_max_nnz": int(cold_per_row.max(initial=0)),
+        "total_nnz": int(data.indptr[-1]),
+    }
+
+
+def panel_bytes(n_hot: int, k: int, n_shard: int, itemsize: int) -> int:
+    """HBM cost of the (K, n_shard, n_hot) hot panel."""
+    return k * n_shard * n_hot * itemsize
+
+
+def resolve_hot_cols(
+    spec,
+    data: LibsvmData,
+    k: int,
+    dtype,
+    *,
+    coverage_target: float = HOT_COVERAGE_TARGET,
+    budget: "int | None" = None,   # None -> HOT_PANEL_HBM_BUDGET (read at
+                                   # call time so tests can patch it)
+):
+    """Resolve ``--hotCols=auto|off|<n>`` to a panel width, with explicit
+    HBM accounting.  Returns ``(n_hot, stats)``: ``n_hot`` the lane-padded
+    panel width (0 = keep the pure stream layout), ``stats`` the
+    machine-readable split record the run manifest carries (hot_cols,
+    coverage, residual_mean_nnz, residual_max_nnz, panel_bytes).
+
+    - ``auto``: the smallest 128-multiple panel whose hottest columns
+      cover ``coverage_target`` of all nonzeros (measured from the column
+      histogram), clamped DOWN to the largest width the HBM ``budget``
+      admits; resolves to 0 (off) when even one 128-lane block does not
+      fit.
+    - ``<n>``: explicit width (padded up to 128 lanes), REJECTED with the
+      accounting when the panel exceeds the budget — an explicit ask that
+      cannot be honored must fail loudly, not silently degrade.
+    - ``off``/``0``: the unchanged stream layout (the A/B control).
+    """
+    from cocoa_tpu.data.sharding import pad_rows, split_sizes
+
+    if budget is None:
+        budget = HOT_PANEL_HBM_BUDGET
+    spec_s = ("off" if spec is None else str(spec)).strip().lower()
+    if spec_s in ("off", "false", "0", "none", ""):
+        return 0, {"spec": "off", "hot_cols": 0, "coverage": 0.0,
+                   "residual_mean_nnz": (float(np.diff(data.indptr).mean())
+                                         if data.n else 0.0),
+                   "residual_max_nnz": int(np.diff(data.indptr).max(initial=0)),
+                   "panel_bytes": 0,
+                   "total_nnz": int(data.indptr[-1])}
+
+    counts = column_counts(data)
+    d = data.num_features
+    itemsize = np.dtype(dtype).itemsize
+    n_shard = pad_rows(int(split_sizes(data.n, k).max())) if k > 0 else 0
+    per_lane_block = panel_bytes(PANEL_LANES, k, n_shard, itemsize)
+
+    if spec_s == "auto":
+        desc = np.sort(counts)[::-1]
+        cums = np.cumsum(desc)
+        total = max(1, int(cums[-1]) if len(cums) else 1)
+        need = int(np.searchsorted(cums, coverage_target * total)) + 1
+        real = min(need, d)
+        width = pad_panel(real)
+        max_width = (budget // per_lane_block) * PANEL_LANES \
+            if per_lane_block > 0 else width
+        width = min(width, max_width)
+        if width < PANEL_LANES:
+            # not even one lane block fits the budget — keep the streams
+            return 0, {"spec": "auto", "hot_cols": 0, "coverage": 0.0,
+                       "residual_mean_nnz": float(np.diff(data.indptr).mean())
+                       if data.n else 0.0,
+                       "residual_max_nnz":
+                           int(np.diff(data.indptr).max(initial=0)),
+                       "panel_bytes": 0,
+                       "total_nnz": int(data.indptr[-1])}
+    else:
+        try:
+            n = int(spec_s)
+        except ValueError:
+            raise ValueError(f"--hotCols must be auto|off|<n>, "
+                             f"got {spec!r}") from None
+        if n <= 0:
+            raise ValueError(f"--hotCols must be auto|off|<positive n>, "
+                             f"got {spec!r}")
+        width = pad_panel(min(n, d))
+        pb = panel_bytes(width, k, n_shard, itemsize)
+        if pb > budget:
+            raise ValueError(
+                f"--hotCols={n}: the hot panel needs {pb / 2**20:.1f} MiB "
+                f"of HBM (K={k} x n_shard={n_shard} x {width} lanes x "
+                f"{itemsize} B) against the {budget / 2**20:.0f} MiB "
+                f"budget; lower --hotCols or use --hotCols=auto"
+            )
+
+    hot_ids = hottest_columns(counts, width)
+    stats = split_stats(data, hot_ids)
+    stats.update(spec=spec_s, hot_cols=int(width),
+                 panel_bytes=panel_bytes(width, k, n_shard, itemsize))
+    return int(width), stats
+
+
+def split_slab(
+    data: LibsvmData,
+    lo: int,
+    hi: int,
+    n_shard: int,
+    rank: np.ndarray,      # hot_rank(d, hot_ids)
+    n_hot: int,            # lane-padded panel width
+    width_res: int,        # residual padded-CSR width (global max cold nnz)
+    np_dtype,
+):
+    """One shard's hybrid slabs for rows [lo, hi): the dense hot panel
+    plus the cold-residual padded-CSR.  The residual preserves the
+    original within-row slot order of the surviving nonzeros, so the
+    stream kernels' per-slot summation order over the tail is exactly the
+    pre-split order with the hot entries deleted."""
+    m = hi - lo
+    a, b = data.indptr[lo], data.indptr[hi]
+    row_nnz = np.diff(data.indptr[lo:hi + 1])
+    rows = np.repeat(np.arange(m, dtype=np.int64), row_nnz)
+    cols = np.asarray(data.indices[a:b], dtype=np.int64)
+    vals = np.asarray(data.values[a:b])
+    lanes = rank[cols]
+    hot = lanes >= 0
+
+    X_hot = np.zeros((n_shard, n_hot), np_dtype)
+    X_hot[rows[hot], lanes[hot]] = vals[hot]
+
+    crows = rows[~hot]
+    cold_per_row = np.bincount(crows, minlength=m)
+    cptr = np.concatenate([[0], np.cumsum(cold_per_row)])
+    slots = np.arange(len(crows), dtype=np.int64) - cptr[crows]
+    spi = np.zeros((n_shard, width_res), np.int32)
+    spv = np.zeros((n_shard, width_res), np_dtype)
+    spi[crows, slots] = cols[~hot]
+    spv[crows, slots] = vals[~hot]
+    return X_hot, spi, spv

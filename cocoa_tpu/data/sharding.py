@@ -39,6 +39,36 @@ def pad_rows(n_rows: int) -> int:
     return -(-n_rows // 16) * 16
 
 
+def resolve_layout(data, layout: str, mesh=None) -> str:
+    """The one place the ``layout="auto"`` rule lives: sparse below 10%
+    density (rcv1-like), dense otherwise (epsilon-like); feature-parallel
+    meshes are dense-only.  Shared by :func:`shard_dataset` and the CLI
+    (which must know the resolved layout before it can resolve
+    sparse-only knobs like ``--hotCols``)."""
+    if layout != "auto":
+        return layout
+    nnz = int(data.indptr[-1])
+    density = nnz / max(1, data.n * data.num_features)
+    if mesh_lib.has_fp(mesh):
+        return "dense"  # fp sharding is dense-only (see shard_dataset)
+    return "sparse" if density < 0.10 else "dense"
+
+
+# HBM budget for the OPT-IN dense eval twin (``--evalDense=auto``): the
+# twin costs K·n_shard·d·itemsize (~3.8 GB at rcv1 scale) — auto
+# materializes it only under this bound and otherwise lets the eval ride
+# the hot panel + residual stream (ops/rows.eval_margins).
+EVAL_DENSE_HBM_BUDGET = 2 << 30
+
+
+def eval_dense_fits(n: int, d: int, k: int, dtype,
+                    budget: int = EVAL_DENSE_HBM_BUDGET) -> bool:
+    """Whether the sparse layout's dense eval twin fits the HBM budget —
+    the ``--evalDense=auto`` accounting (twin bytes vs budget)."""
+    n_shard = pad_rows(int(split_sizes(n, k).max())) if k > 0 else 0
+    return k * n_shard * d * np.dtype(dtype).itemsize <= budget
+
+
 def segment_sq_norms(values, ptr) -> np.ndarray:
     """Exact per-segment f64 Σv² for CSR/CSC-style ``(values, ptr)``.
 
@@ -104,10 +134,25 @@ class ShardedDataset:
                                       #   every-nonzero w-gather (31% of the
                                       #   rcv1 production round); costs
                                       #   K*n_shard*d*itemsize HBM
+    X_hot: Optional[jax.Array] = None   # hybrid sparse layout (hot/cold
+                                      #   column split, data/hybrid.py):
+                                      #   (K, n_shard, n_hot) dense panel
+                                      #   over the globally hottest columns;
+                                      #   sp_indices/sp_values then hold
+                                      #   ONLY the cold residual
+    hot_cols: Optional[jax.Array] = None  # (K, n_hot) int32 panel lane ->
+                                      #   original column id (identical per
+                                      #   shard; K-leading so it rides the
+                                      #   fan-out plumbing like every leaf)
 
     @property
     def k(self) -> int:
         return self.labels.shape[0]
+
+    @property
+    def n_hot(self) -> int:
+        """Hot-panel width (0 = pure stream layout)."""
+        return 0 if self.X_hot is None else self.X_hot.shape[-1]
 
     @property
     def n_shard(self) -> int:
@@ -129,6 +174,9 @@ class ShardedDataset:
         else:
             out["sp_indices"] = self.sp_indices
             out["sp_values"] = self.sp_values
+            if self.X_hot is not None:
+                out["X_hot"] = self.X_hot
+                out["hot_cols"] = self.hot_cols
             if self.X_eval is not None:
                 out["X_eval"] = self.X_eval
         return out
@@ -139,13 +187,15 @@ class ShardedDataset:
         children = (
             self.labels, self.mask, self.sq_norms,
             self.X, self.sp_indices, self.sp_values, self.X_eval,
+            self.X_hot, self.hot_cols,
         )
         aux = (self.layout, self.n, self.num_features, tuple(self.counts))
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        labels, mask, sq_norms, X, sp_indices, sp_values, X_eval = children
+        (labels, mask, sq_norms, X, sp_indices, sp_values, X_eval,
+         X_hot, hot_cols) = children
         layout, n, num_features, counts = aux
         return cls(
             layout=layout,
@@ -159,6 +209,8 @@ class ShardedDataset:
             sp_indices=sp_indices,
             sp_values=sp_values,
             X_eval=X_eval,
+            X_hot=X_hot,
+            hot_cols=hot_cols,
         )
 
 
@@ -264,6 +316,7 @@ def shard_dataset(
     mesh: Optional[jax.sharding.Mesh] = None,
     max_nnz: Optional[int] = None,
     eval_dense: bool = False,
+    hot_cols: int = 0,
 ) -> ShardedDataset:
     """Partition ``data`` into K balanced contiguous shards and device_put them.
 
@@ -279,17 +332,19 @@ def shard_dataset(
     of the round time.  Opt-in because the twin costs K·n_shard·d·itemsize of HBM
     (~3.8 GB at rcv1 scale); training paths never touch it.
 
+    ``hot_cols > 0`` (sparse layout only; flag ``--hotCols``) builds the
+    HYBRID layout (data/hybrid.py): a dense (K, n_shard, hot_cols) panel
+    over the globally hottest columns — chosen once from the column
+    histogram — plus the cold-residual padded-CSR.  The split partitions
+    each row's nonzeros by column, so every consumer's per-row sum is a
+    permutation of the unsplit one (docs/DESIGN.md §3b-vi).
+
     Multi-process runs (``jax.process_count() > 1`` with a dp mesh)
     materialize only each process's own shards host-side — see
     :func:`_shard_dataset_distributed`.
     """
     n, d = data.n, data.num_features
-    if layout == "auto":
-        nnz = int(data.indptr[-1])
-        density = nnz / max(1, n * d)
-        layout = "sparse" if density < 0.10 else "dense"
-        if mesh_lib.has_fp(mesh):
-            layout = "dense"  # fp sharding is dense-only (see below)
+    layout = resolve_layout(data, layout, mesh)
     if layout == "sparse" and mesh_lib.has_fp(mesh):
         # padded-CSR rows index the full feature space; splitting them over
         # fp would need per-device re-bucketing of each row's nnz (ragged) —
@@ -314,6 +369,33 @@ def shard_dataset(
             raise ValueError(
                 f"row nnz {int(row_nnz.max())} exceeds max_nnz {width}"
             )
+
+    hot_ids = None
+    rank = None
+    n_hot = 0
+    if hot_cols:
+        from cocoa_tpu.data import hybrid
+
+        if layout != "sparse":
+            raise ValueError("hot_cols (the hot/cold column split) only "
+                             "applies to the sparse layout")
+        if max_nnz is not None:
+            raise ValueError("hot_cols and max_nnz cannot combine: the "
+                             "residual width is measured from the split")
+        n_hot = hybrid.pad_panel(min(int(hot_cols), d))
+        # the hot set derives from the same deterministic
+        # hottest_columns(column_counts(data), n) that resolve_hot_cols
+        # measured, so the manifest's split stats describe THIS layout
+        # (lockstep pinned by tests/test_hybrid_sparse.py)
+        hot_ids = hybrid.hottest_columns(hybrid.column_counts(data), n_hot)
+        rank = hybrid.hot_rank(d, hot_ids)
+        # the residual padded-CSR width is the max COLD nnz across rows —
+        # the whole point: the stream kernels' padded width drops to the
+        # tail's max, not the full row's
+        cold_rows = np.repeat(np.arange(n, dtype=np.int64),
+                              row_nnz)[rank[data.indices] < 0]
+        width = max(1, int(np.bincount(cold_rows, minlength=max(1, n))
+                           .max(initial=0)))
 
     if eval_dense and layout != "sparse":
         raise ValueError("eval_dense only applies to the sparse layout "
@@ -345,6 +427,9 @@ def shard_dataset(
         if eval_dense:
             raise ValueError("eval_dense is not supported on the "
                              "multi-process sharding path yet")
+        if n_hot:
+            raise ValueError("hot_cols is not supported on the "
+                             "multi-process sharding path yet")
         return _shard_dataset_distributed(
             data, k, layout, np_dtype, mesh, sizes, offsets, n_shard,
             # mirror the replicated path: only the dense layout pads d
@@ -373,8 +458,16 @@ def shard_dataset(
     else:
         sp_idx = np.zeros((k, n_shard, width), dtype=np.int32)
         sp_val = np.zeros((k, n_shard, width), dtype=np_dtype)
+        X_hot = np.zeros((k, n_shard, n_hot), dtype=np_dtype) if n_hot \
+            else None
         for s in range(k):
             lo, hi = offsets[s], offsets[s + 1]
+            if n_hot:
+                from cocoa_tpu.data import hybrid
+
+                X_hot[s], sp_idx[s], sp_val[s] = hybrid.split_slab(
+                    data, lo, hi, n_shard, rank, n_hot, width, np_dtype)
+                continue
             a, b = data.indptr[lo], data.indptr[hi]
             rows = np.repeat(np.arange(hi - lo), row_nnz[lo:hi])
             cols = np.arange(a, b) - np.repeat(data.indptr[lo:hi], row_nnz[lo:hi])
@@ -382,6 +475,14 @@ def shard_dataset(
             sp_val[s][rows, cols] = data.values[a:b]
         kwargs["sp_indices"] = sp_idx
         kwargs["sp_values"] = sp_val
+        if n_hot:
+            # panel lanes past the real hot count (d < n_hot after lane
+            # padding) carry column id 0 and all-zero values — inert in
+            # every gather and scatter, the standing padding trick
+            hc = np.zeros(n_hot, dtype=np.int32)
+            hc[:len(hot_ids)] = hot_ids
+            kwargs["X_hot"] = X_hot
+            kwargs["hot_cols"] = np.tile(hc[None], (k, 1))
         if eval_dense:
             Xe = np.zeros((k, n_shard, d), dtype=np_dtype)
             for s in range(k):
@@ -411,4 +512,6 @@ def shard_dataset(
         sp_indices=put(kwargs["sp_indices"]) if "sp_indices" in kwargs else None,
         sp_values=put(kwargs["sp_values"]) if "sp_values" in kwargs else None,
         X_eval=put(kwargs["X_eval"]) if "X_eval" in kwargs else None,
+        X_hot=put(kwargs["X_hot"]) if "X_hot" in kwargs else None,
+        hot_cols=put(kwargs["hot_cols"]) if "hot_cols" in kwargs else None,
     )

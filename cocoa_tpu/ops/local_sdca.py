@@ -270,6 +270,13 @@ def local_sdca_block(
             spv = shard["sp_values"][bidx]
             xb = jnp.zeros((block, d), dtype).at[
                 jnp.arange(block)[:, None], spi].add(spv)
+            if "X_hot" in shard:
+                # hybrid layout: the residual scatter above misses the
+                # hot-panel nonzeros — add them at their column ids
+                # (disjoint from every residual id, so adds never collide)
+                xb = xb.at[jnp.arange(block)[:, None],
+                           shard["hot_cols"][None, :]].add(
+                    shard["X_hot"][bidx])
         yb = labels[bidx]
         m0b = margins0[bidx]
         qb = sq_norms[bidx] * qf
@@ -379,7 +386,13 @@ def local_sdca_block_batched(
     ``False`` disables.  Same math as the split path — the chain kernel
     consumes the identical (scal, gq) contract — so trajectory parity
     carries over; the α update stays per-block (``distinct`` is a fused-
-    path-only license).
+    path-only license).  On a HYBRID layout (the ``--hotCols`` hot/cold
+    column split — ``X_hot``/``hot_cols`` in the shard dict, docs/DESIGN.md
+    §3b-vi) this path becomes the hybrid branch: the streams carry only
+    the cold residual, and the hot-panel majority of nonzeros joins the
+    Gram as one MXU (B, n_hot)·(n_hot, B) panel matmul, the margin base
+    as a panel matvec, and the apply as coefᵀ·panel into a separate hot
+    Δw — same chain, same contract, exact column-partitioned split.
 
     ``pipeline`` (None = auto: on whenever the round spans more than one
     block) software-pipelines the dense block scan into a two-phase
@@ -431,16 +444,24 @@ def local_sdca_block_batched(
 
     def gather_rows(bidx):
         """(K, B, d) dense row tile for one block (sparse rows densify —
-        padded slots carry index 0 / value 0 and scatter harmlessly)."""
+        padded slots carry index 0 / value 0 and scatter harmlessly; the
+        hybrid layout's hot panel scatters at its disjoint column ids)."""
         if "X" in shards:
             return jnp.take_along_axis(shards["X"], bidx[:, :, None], axis=1)
         spi = jnp.take_along_axis(shards["sp_indices"], bidx[:, :, None],
                                   axis=1)
         spv = jnp.take_along_axis(shards["sp_values"], bidx[:, :, None],
                                   axis=1)
-        return jnp.zeros((k, block, d), dtype).at[
+        tile = jnp.zeros((k, block, d), dtype).at[
             jnp.arange(k)[:, None, None],
             jnp.arange(block)[None, :, None], spi].add(spv)
+        if "X_hot" in shards:
+            xh = jnp.take_along_axis(shards["X_hot"], bidx[:, :, None],
+                                     axis=1)
+            tile = tile.at[jnp.arange(k)[:, None, None],
+                           jnp.arange(block)[None, :, None],
+                           shards["hot_cols"][:, None, :]].add(xh)
+        return tile
 
     gat = lambda v, bidx: jnp.take_along_axis(v, bidx, axis=1)  # noqa: E731
 
@@ -475,9 +496,28 @@ def local_sdca_block_batched(
             row_len = row_lengths(sp_val)
         frozen = mode == "frozen"
         wd0 = wd_stack(w, k)
+        # HYBRID branch (hot/cold column split, docs/DESIGN.md §3b-vi):
+        # the CSR streams above are the COLD RESIDUAL only; the hot-panel
+        # majority of the nonzeros rides the MXU — per block one
+        # (B, n_hot)·(n_hot, B) panel Gram matmul, one panel margin-base
+        # matvec against [w_hot + σ′Δw_hot], and one coefᵀ·panel apply
+        # into a separately-carried (K, n_hot) hot Δw.  Columns partition
+        # between panel and streams, so gram/mbase/Δw each split exactly
+        # (hot + cold permutes the per-nonzero sums; parity pinned by
+        # tests/test_hybrid_sparse.py).
+        hybrid = "X_hot" in shards
+        if hybrid:
+            xh_all = shards["X_hot"]                  # (K, n_shard, n_hot)
+            hot_cols_k = shards["hot_cols"]           # (K, n_hot)
+            wh = jnp.take_along_axis(
+                jnp.broadcast_to(w[None], (k, d)), hot_cols_k, axis=1)
+            dwh0 = jnp.zeros_like(wh)                 # (K, n_hot)
 
         def sparse_block_step(carry, inp):
-            wd, a_vec = carry            # (K, d/128, 2·128), (K, n_shard)
+            if hybrid:
+                wd, dwh, a_vec = carry
+            else:
+                wd, a_vec = carry        # (K, d/128, 2·128), (K, n_shard)
             bidx, bmask = inp            # (K, B), (B,)
             gidx = jnp.take_along_axis(sp_idx, bidx[:, :, None], axis=1)
             gvals = jnp.take_along_axis(sp_val, bidx[:, :, None], axis=1) \
@@ -495,6 +535,17 @@ def local_sdca_block_batched(
                 wd, gidx, gvals, cnts, sig_eff=sig_eff, frozen=frozen,
                 interpret=interpret,
             )
+            if hybrid:
+                xh_b = jnp.take_along_axis(xh_all, bidx[:, :, None],
+                                           axis=1)   # (K, B, n_hot)
+                v_hot = wh if frozen else wh + sig_c * dwh
+                mbase = mbase + jnp.einsum("kbh,kh->kb", xh_b, v_hot,
+                                           precision=mm)
+                if not frozen:
+                    # full panel Gram; the chain reads only i < j entries,
+                    # exactly as the split dense path's full einsum Gram
+                    gram = gram + jnp.einsum("kjh,kih->jki", xh_b, xh_b,
+                                             precision=mm)
             eq_t = (bidx.T[:, :, None] == bidx[None, :, :]).astype(dtype)
             gq = eq_t if frozen else jnp.concatenate([gram, eq_t], axis=1)
             scal = jnp.stack([
@@ -513,8 +564,21 @@ def local_sdca_block_batched(
             a_vec = a_vec.at[jnp.arange(k)[:, None], bidx].add(delta)
             wd = sparse_block_apply(wd, gidx, gvals, cnts, coefs,
                                     interpret=interpret)
+            if hybrid:
+                dwh = dwh + jnp.einsum("kb,kbh->kh", coefs, xh_b,
+                                       precision=hi)
+                return (wd, dwh, a_vec), None
             return (wd, a_vec), None
 
+        if hybrid:
+            (wd, dwh, alpha_final), _ = lax.scan(
+                sparse_block_step, (wd0, dwh0, alpha), (idxs_b, mask_b)
+            )
+            dw = wd_delta(wd, d)
+            # hot and cold columns are disjoint; panel-padding lanes carry
+            # value 0 at column 0, so this scatter-add is exact
+            dw = dw.at[jnp.arange(k)[:, None], hot_cols_k].add(dwh)
+            return alpha_final - alpha, dw
         (wd, alpha_final), _ = lax.scan(
             sparse_block_step, (wd0, alpha), (idxs_b, mask_b)
         )

@@ -91,25 +91,30 @@ VMEM_BUDGET = 12 << 20
 
 
 def sparse_vmem_estimate(n_shard: int, d: int, max_nnz: int, itemsize: int,
-                         k: int = 1) -> int:
+                         k: int = 1, n_hot: int = 0) -> int:
     """All K shards resident (the interleaved grid): per shard the
     (n_dblk, 2·128) w|Δw array ×3 (input, scratch, output with
     double-buffer slack) + the (n_blocks, 3·128) scalar stack ×3, plus the
-    double-buffered (8, max_nnz) value blocks."""
+    double-buffered (8, max_nnz) value blocks.  The hybrid layout
+    (``n_hot > 0``, the hot/cold split) adds per shard the (n_hot/128,
+    128) hot-Δw array ×3 plus the shared w_hot operand and the per-step
+    hot row's double buffer."""
     n_pad = -(-n_shard // LANES) * LANES
     d_pad = -(-d // LANES) * LANES
     del max_nnz  # values ride SMEM now (module docstring)
-    return itemsize * k * (6 * d_pad + 9 * n_pad)
+    return itemsize * (k * (6 * d_pad + 9 * n_pad)
+                       + n_hot * (3 * k + 1) + 2 * k * n_hot)
 
 
 def sparse_kernel_fits(k: int, n_shard: int, d: int, max_nnz: int, h: int,
-                       itemsize: int) -> bool:
+                       itemsize: int, n_hot: int = 0) -> bool:
     """VMEM feasibility (the SMEM index-table limit is handled by splitting
     the round into segments — see :func:`pallas_sparse_sdca_round`)."""
     del h
     return (
         segment_len(k, max_nnz) >= 1
-        and sparse_vmem_estimate(n_shard, d, max_nnz, itemsize, k)
+        and (n_hot == 0 or n_hot % LANES == 0)
+        and sparse_vmem_estimate(n_shard, d, max_nnz, itemsize, k, n_hot)
         <= VMEM_BUDGET
     )
 
@@ -128,7 +133,7 @@ def _kernel(
     gidx_ref,        # scalar-prefetch: (K, H_seg, W) int32 feature indices
     svals_ref,       # scalar-prefetch: (K, H_seg, W) f32 nonzero values
     cnts_ref,        # scalar-prefetch: (K, H_seg) int32 per-row nnz counts
-    *refs,           # wd_in, st_in, 2 outs, 2K+1 scratch
+    *refs,           # wd_in, st_in[, hot refs], outs, scratch
     lam_n: float,
     coef_div: float,
     sig_eff: float,
@@ -139,17 +144,34 @@ def _kernel(
     loss: str,
     smoothing: float,
     k: int,
+    n_hblk: int = 0,
 ):
     # refs layout (see module docstring for the concatenated layouts):
     #   wd_in         (K, n_dblk, 2·LANES): [w | Δw_carried] per shard
     #   st_in         (K, n_blocks, 3·LANES): [labels | ‖x‖² | α] per shard
-    #   wd_out, st_out — same shapes (flushed at segment end; Δw and α
-    #                    carry to the next segment through them)
-    #   wd_scs[kk], st_scs[kk] — per-shard scratch (separate refs: chains
-    #                    must not alias)
-    wd_in, st_in, wd_out, st_out = refs[:4]
-    wd_scs = refs[4:4 + k]
-    st_scs = refs[4 + k:4 + 2 * k]
+    #   hybrid (n_hblk > 0 — the hot/cold split, docs/DESIGN.md §3b-vi):
+    #   hw_in         (n_hblk, LANES): w at the hot columns, read-only and
+    #                    shared by all shards (the kernel never writes w)
+    #   hd_in         (K, n_hblk, LANES): hot Δw carried between segments
+    #   hrow_ref      (K, 1, n_hblk, LANES): THIS step's sampled rows' hot
+    #                    panel slices (per-step BlockSpec — the pipeline
+    #                    double-buffers the next step's rows automatically)
+    #   wd_out, st_out[, hd_out] — flushed at segment end; Δw and α carry
+    #                    to the next segment through them
+    #   wd_scs[kk], st_scs[kk][, hd_scs[kk]] — per-shard scratch (separate
+    #                    refs: chains must not alias)
+    hot = n_hblk > 0
+    if hot:
+        wd_in, st_in, hw_in, hd_in, hrow_ref = refs[:5]
+        wd_out, st_out, hd_out = refs[5:8]
+        scs = refs[8:]
+        wd_scs, st_scs = scs[:k], scs[k:2 * k]
+        hd_scs = scs[2 * k:3 * k]
+    else:
+        wd_in, st_in, wd_out, st_out = refs[:4]
+        wd_scs = refs[4:4 + k]
+        st_scs = refs[4 + k:4 + 2 * k]
+        hd_scs = None
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -157,6 +179,8 @@ def _kernel(
         for kk in range(k):
             wd_scs[kk][...] = wd_in[kk]
             st_scs[kk][...] = st_in[kk]
+            if hot:
+                hd_scs[kk][...] = hd_in[kk]
 
     lane2 = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * LANES), 1)
     lane3 = jax.lax.broadcasted_iota(jnp.int32, (1, 3 * LANES), 1)
@@ -206,6 +230,17 @@ def _kernel(
         margin = jax.lax.fori_loop(0, n_trips, margin_body,
                                    jnp.asarray(0.0, dtype))
 
+        if hot:
+            # hot-panel margin term: two whole-array VPU multiply-reduces
+            # against the lane-blocked w_hot / Δw_hot — O(n_hot/128)
+            # lane-rows where the stream loop pays ~6 scalar ops PER
+            # nonzero; the cold stream above covered only the residual
+            hrow = hrow_ref[kk, 0]                # (n_hblk, LANES)
+            mh = jnp.sum(hrow * hw_in[...])
+            if not frozen:
+                mh = mh + sig_eff * jnp.sum(hrow * hd_scs[kk][...])
+            margin = margin + mh
+
         new_a = losses.alpha_step(loss, a, y * margin, sq * qii_factor,
                                   lam_n, smoothing=smoothing)
         coef = y * (new_a - a) / coef_div
@@ -228,6 +263,15 @@ def _kernel(
 
         jax.lax.fori_loop(0, n_trips, scatter_body, jnp.int32(0))
 
+        if hot:
+            # hot-panel Δw axpy: one whole-array VPU op (vs a masked row
+            # update per nonzero on the stream side).  Gated off on
+            # padding steps — the stream loops self-gate through their
+            # zero trip counts, but this is a full-array op
+            @pl.when(cnt >= 0)
+            def _hot_scatter():
+                hd_scs[kk][...] = hd_scs[kk][...] + coef * hrow
+
         # cnt < 0 marks a padding step (the segment scan pads the round to
         # whole segments): its margin/scatter loops already ran 0 trips,
         # and the alpha write is gated off so the step is a true no-op
@@ -242,6 +286,8 @@ def _kernel(
         for kk in range(k):
             wd_out[kk] = wd_scs[kk][...]
             st_out[kk] = st_scs[kk][...]
+            if hot:
+                hd_out[kk] = hd_scs[kk][...]
 
 
 def row_lengths(sp_values: jax.Array) -> jax.Array:
@@ -278,12 +324,23 @@ def pallas_sparse_sdca_round(
     loss: str = "hinge",
     smoothing: float = 1.0,
     row_len: jax.Array = None,   # (K, n_shard) int32, see row_lengths
+    hot_cols: jax.Array = None,  # hybrid: (K, n_hot) int32 panel columns
+    hot_panel: jax.Array = None,  # hybrid: (K, n_shard, n_hot) hot panel
 ):
     """One sparse SDCA round for K shards on this chip.  Returns
     (dw, alpha_inner): dw (K, d) unreduced per-shard updates (dense — Δw is
     dense in the reference too, CoCoA.scala:145); alpha_inner (K, n_shard)
     the locally-advanced alpha.  Unlike the dense kernel no margins input is
     needed: the kernel reads x·w from the VMEM-resident w.
+
+    ``hot_panel``/``hot_cols`` select the HYBRID branch (the hot/cold
+    column split, docs/DESIGN.md §3b-vi): ``sp_indices``/``sp_values``
+    then hold only the cold residual (narrower W → shorter stream loops),
+    and each step adds the sampled row's hot-panel slice — streamed
+    through VMEM one step ahead by a per-step BlockSpec — against the
+    lane-blocked [w_hot] operand and per-shard Δw_hot scratch as
+    whole-array VPU ops.  Same math: columns partition, so hot + cold
+    permutes the per-nonzero sums.
 
     When H exceeds the SMEM index-table budget the round is split into
     segments of :func:`segment_len` steps, each one ``pallas_call``; the
@@ -333,6 +390,12 @@ def pallas_sparse_sdca_round(
     idxs = idxs.astype(jnp.int32)
     if row_len is None:
         row_len = row_lengths(sp_values)
+    hot = hot_panel is not None
+    n_hot = int(hot_panel.shape[-1]) if hot else 0
+    if hot and n_hot % LANES != 0:
+        raise ValueError(f"hot panel width must be a multiple of {LANES}, "
+                         f"got {n_hot} (data/hybrid.pad_panel owns this)")
+    n_hblk = n_hot // LANES
 
     full_wd = pl.BlockSpec(
         (k, n_dblk, 2 * LANES),
@@ -374,6 +437,15 @@ def pallas_sparse_sdca_round(
         .swapaxes(0, 1)  # noqa: E731
     xs = (seg_shape(idxs_p), seg_shape(gidx), seg_shape(svals),
           seg_shape(cnts))
+    if hot:
+        # the sampled rows' hot-panel slices, gathered per round like the
+        # CSR streams and lane-blocked for the kernel's per-step BlockSpec
+        hrows = jnp.take_along_axis(
+            hot_panel, idxs_p[:, :, None], axis=1).astype(dtype) \
+            .reshape(k, h_pad, n_hblk, LANES)
+        xs = (*xs, seg_shape(hrows))
+        hw = jnp.take(w, hot_cols[0]).reshape(n_hblk, LANES)
+        hd = jnp.zeros((k, n_hblk, LANES), dtype)
 
     kernel = functools.partial(
         _kernel,
@@ -387,45 +459,80 @@ def pallas_sparse_sdca_round(
         loss=losses.validate(loss, smoothing),
         smoothing=float(smoothing),
         k=k,
+        n_hblk=n_hblk,
     )
+    in_specs = [
+        full_wd,   # [w | Δw] (Δw carried between segments)
+        full_st,   # [labels | ‖x‖² | α]
+    ]
+    out_specs = [full_wd, full_st]
+    out_shape = [
+        jax.ShapeDtypeStruct((k, n_dblk, 2 * LANES), dtype),
+        jax.ShapeDtypeStruct((k, n_blocks, 3 * LANES), dtype),
+    ]
+    scratch = (
+        [pltpu.VMEM((n_dblk, 2 * LANES), dtype)] * k
+        + [pltpu.VMEM((n_blocks, 3 * LANES), dtype)] * k
+    )
+    if hot:
+        full_hw = pl.BlockSpec((n_hblk, LANES), lambda i, *_: (0, 0))
+        full_hd = pl.BlockSpec((k, n_hblk, LANES), lambda i, *_: (0, 0, 0))
+        # ONE step's hot rows per grid iteration — the pipeline
+        # double-buffers step i+1's block while step i runs
+        step_hr = pl.BlockSpec((k, 1, n_hblk, LANES),
+                               lambda i, *_: (0, i, 0, 0))
+        in_specs += [full_hw, full_hd, step_hr]
+        out_specs += [full_hd]
+        out_shape += [jax.ShapeDtypeStruct((k, n_hblk, LANES), dtype)]
+        scratch += [pltpu.VMEM((n_hblk, LANES), dtype)] * k
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(h_seg,),
-        in_specs=[
-            full_wd,   # [w | Δw] (Δw carried between segments)
-            full_st,   # [labels | ‖x‖² | α]
-        ],
-        out_specs=[full_wd, full_st],
-        scratch_shapes=(
-            [pltpu.VMEM((n_dblk, 2 * LANES), dtype)] * k
-            + [pltpu.VMEM((n_blocks, 3 * LANES), dtype)] * k
-        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
     call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((k, n_dblk, 2 * LANES), dtype),
-            jax.ShapeDtypeStruct((k, n_blocks, 3 * LANES), dtype),
-        ],
+        out_shape=out_shape,
         compiler_params=COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
     )
 
-    def seg_body(carry, seg_xs):
-        wd_c, st_c = carry
-        si, sg, sv, sc = seg_xs
-        wd_c, st_c = call(si, sg, sv, sc, wd_c, st_c)
-        return (wd_c, st_c), None
+    if hot:
+        def seg_body(carry, seg_xs):
+            wd_c, st_c, hd_c = carry
+            si, sg, sv, sc, hr = seg_xs
+            wd_c, st_c, hd_c = call(si, sg, sv, sc, wd_c, st_c, hw, hd_c,
+                                    hr)
+            return (wd_c, st_c, hd_c), None
+
+        carry0 = (wd, st, hd)
+    else:
+        def seg_body(carry, seg_xs):
+            wd_c, st_c = carry
+            si, sg, sv, sc = seg_xs
+            wd_c, st_c = call(si, sg, sv, sc, wd_c, st_c)
+            return (wd_c, st_c), None
+
+        carry0 = (wd, st)
 
     if n_seg == 1:
-        (wd, st), _ = seg_body((wd, st), jax.tree.map(lambda a: a[0], xs))
+        carry, _ = seg_body(carry0, jax.tree.map(lambda a: a[0], xs))
     else:
-        (wd, st), _ = jax.lax.scan(seg_body, (wd, st), xs)
+        carry, _ = jax.lax.scan(seg_body, carry0, xs)
+    wd, st = carry[0], carry[1]
 
     dw = wd[:, :, LANES:].reshape(k, d_pad)[:, :d]
+    if hot:
+        # fold the hot Δw back into the full Δw at its column ids — hot
+        # and cold columns are disjoint, and inert panel-padding lanes
+        # carry value 0 at column 0, so the scatter-add is exact
+        dw = dw.at[jnp.arange(k)[:, None], hot_cols].add(
+            carry[2].reshape(k, n_hot))
     alpha_inner = st[:, :, 2 * LANES:].reshape(k, n_pad)[:, :n_shard]
     return dw, alpha_inner
 
@@ -507,6 +614,24 @@ def sparse_chain_fits(k: int, n_shard: int, d: int, max_nnz: int, b: int,
         and s > 0
         and chain_fits(k, b, itemsize)
         and sparse_block_vmem(d, b, s, itemsize) <= VMEM_BUDGET
+    )
+
+
+def hybrid_fits(k: int, n_shard: int, d: int, max_nnz: int, b: int,
+                n_hot: int, itemsize: int) -> bool:
+    """Feasibility of the HYBRID block path (hot/cold split,
+    docs/DESIGN.md §3b-vi): the cold residual runs through the exact
+    CSR-stream machinery :func:`sparse_chain_fits` gates (``max_nnz`` is
+    the RESIDUAL width — narrower than the unsplit streams, so the split
+    only widens feasibility), and the hot panel must be lane-aligned; its
+    Gram/margin/apply terms are XLA MXU einsum tiles, not VMEM-resident
+    kernel state, so the panel adds no VMEM constraint here (the
+    SEQUENTIAL kernel's panel accounting lives in
+    :func:`sparse_kernel_fits` via ``n_hot``)."""
+    return (
+        n_hot > 0
+        and n_hot % LANES == 0
+        and sparse_chain_fits(k, n_shard, d, max_nnz, b, itemsize)
     )
 
 

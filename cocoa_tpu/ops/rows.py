@@ -1,4 +1,4 @@
-"""Row access over the two shard layouts (dense / padded-CSR).
+"""Row access over the shard layouts (dense / padded-CSR / hybrid).
 
 The sequential local solvers touch one example per step: a row gather, one or
 two dots against d-vectors, and a scaled row-axpy back into d-vectors
@@ -9,6 +9,12 @@ layout-independent form:
 - sparse: the row is (max_nnz,) index/value arrays; dot is gather+reduce;
   axpy is scatter-add.  Padded slots carry index 0 / value 0, so they
   contribute exactly 0 to every dot and axpy — no masking needed.
+- hybrid (the hot/cold column split, data/hybrid.py ``--hotCols``): the row
+  additionally carries its dense (n_hot,) hot-panel slice; dot and axpy add
+  the panel term through the ``hot_cols`` lane→column map.  Columns
+  partition between panel and residual, so hot + cold is a permutation of
+  the unsplit per-nonzero sum — identical real arithmetic, fp reassociated
+  (docs/DESIGN.md §3b-vi).
 
 Layout choice is static (Python-level), so each jit specialization contains
 only its own code path.
@@ -27,14 +33,23 @@ class Row(NamedTuple):
     dense: Optional[jax.Array] = None    # (d,)
     idx: Optional[jax.Array] = None      # (max_nnz,) int32
     val: Optional[jax.Array] = None      # (max_nnz,)
+    hot: Optional[jax.Array] = None      # hybrid: (n_hot,) panel values
+    hot_cols: Optional[jax.Array] = None  # hybrid: (n_hot,) int32 column ids
 
 
 def get_row(shard: dict, i) -> Row:
     if "X" in shard:
         return Row(dense=jax.lax.dynamic_index_in_dim(shard["X"], i, 0, keepdims=False))
+    hot = hot_cols = None
+    if "X_hot" in shard:
+        hot = jax.lax.dynamic_index_in_dim(shard["X_hot"], i, 0,
+                                           keepdims=False)
+        hot_cols = shard["hot_cols"]
     return Row(
         idx=jax.lax.dynamic_index_in_dim(shard["sp_indices"], i, 0, keepdims=False),
         val=jax.lax.dynamic_index_in_dim(shard["sp_values"], i, 0, keepdims=False),
+        hot=hot,
+        hot_cols=hot_cols,
     )
 
 
@@ -42,14 +57,22 @@ def row_dot(row: Row, vec: jax.Array) -> jax.Array:
     """x · vec."""
     if row.dense is not None:
         return row.dense @ vec
-    return vec[row.idx] @ row.val
+    d = vec[row.idx] @ row.val
+    if row.hot is not None:
+        d = d + row.hot @ vec[row.hot_cols]
+    return d
 
 
 def row_axpy(row: Row, coef, vec: jax.Array) -> jax.Array:
     """vec + coef * x."""
     if row.dense is not None:
         return vec + coef * row.dense
-    return vec.at[row.idx].add(coef * row.val)
+    vec = vec.at[row.idx].add(coef * row.val)
+    if row.hot is not None:
+        # hot and cold columns are disjoint (the split partitions by
+        # column), so the two scatters never race on a coordinate
+        vec = vec.at[row.hot_cols].add(coef * row.hot)
+    return vec
 
 
 def shard_margins(w: jax.Array, shard: dict) -> jax.Array:
@@ -57,8 +80,10 @@ def shard_margins(w: jax.Array, shard: dict) -> jax.Array:
 
     The batched counterpart of ``row_dot`` — on the dense layout a single
     MXU matvec; on padded-CSR a gather + reduction (padded slots contribute
-    0).  Shared by the vectorized inner solver (ops/subgradient.py) and
-    the fast-math margins pass so layout dispatch lives in one place.
+    0); on the hybrid layout the residual gather-sum PLUS the hot panel as
+    one MXU matvec against the gathered hot w slice.  Shared by the
+    vectorized inner solver (ops/subgradient.py) and the fast-math margins
+    pass so layout dispatch lives in one place.
 
     TRAINING-side: deliberately ignores the dense eval twin ``X_eval`` a
     sparse shard may carry — the twin's float summation order differs
@@ -67,7 +92,10 @@ def shard_margins(w: jax.Array, shard: dict) -> jax.Array:
     """
     if "X" in shard:
         return shard["X"] @ w
-    return (w[shard["sp_indices"]] * shard["sp_values"]).sum(-1)
+    m = (w[shard["sp_indices"]] * shard["sp_values"]).sum(-1)
+    if "X_hot" in shard:
+        m = m + shard["X_hot"] @ w[shard["hot_cols"]]
+    return m
 
 
 def eval_margins(w: jax.Array, shard: dict) -> jax.Array:
@@ -76,9 +104,14 @@ def eval_margins(w: jax.Array, shard: dict) -> jax.Array:
     certificate's full margins pass then rides one MXU matvec instead of
     an every-nonzero w-gather.  Measured through the production rcv1
     device-loop path, the gather-based eval was 31% of the round time
-    (9.42 -> 6.46 ms/round with the twin).  Eval-only by construction:
-    training uses :func:`shard_margins`, which never reads the twin, so
-    trained (w, α) are bit-identical with or without it."""
+    (9.42 -> 6.46 ms/round with the twin).  Without the twin, a HYBRID
+    shard (``--hotCols`` + ``--evalDense=auto`` when the twin exceeds the
+    HBM budget) still gets most of that win structurally: the falls-through
+    :func:`shard_margins` runs the hot majority of nonzeros as one MXU
+    panel matvec and gathers only the residual tail.  Eval-only by
+    construction: training uses :func:`shard_margins` directly, which
+    never reads the twin, so trained (w, α) are bit-identical with or
+    without it."""
     if "X_eval" in shard:
         return shard["X_eval"] @ w
     return shard_margins(w, shard)

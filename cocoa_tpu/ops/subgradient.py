@@ -40,5 +40,9 @@ def subgradient_pass(w_init: jax.Array, shard: dict, lam: float,
         flat_idx = shard["sp_indices"].reshape(-1)
         flat_val = (shard["sp_values"] * coef[:, None]).reshape(-1)
         dw = jnp.zeros_like(w_init).at[flat_idx].add(flat_val)
+        if "X_hot" in shard:
+            # hybrid layout: the hot-panel majority as one MXU matvec,
+            # scattered at the (disjoint) hot column ids
+            dw = dw.at[shard["hot_cols"]].add(coef @ shard["X_hot"])
 
     return dw - lam * w_init

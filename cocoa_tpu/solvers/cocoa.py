@@ -39,10 +39,14 @@ def _pallas_batched(w, alpha, idxs_kh, shards, params, mode, sigma,
     if "sp_indices" in shards:
         from cocoa_tpu.ops.pallas_sparse import pallas_sparse_sdca_round
 
+        # hybrid layouts (--hotCols) pass the hot panel through: the
+        # kernel then streams each step's panel slice through VMEM and
+        # merges only the cold residual (docs/DESIGN.md §3b-vi)
         return pallas_sparse_sdca_round(
             w, alpha, shards["sp_indices"], shards["sp_values"],
             shards["labels"], shards["sq_norms"], idxs_kh,
             params.lam, params.n, row_len=shards.get("sp_row_len"),
+            hot_cols=shards.get("hot_cols"), hot_panel=shards.get("X_hot"),
             **common,
         )
     from cocoa_tpu.ops.pallas_sdca import pallas_sdca_round
@@ -82,7 +86,7 @@ def auto_block_size(ds: ShardedDataset, m_local: int, dtype) -> int:
     from cocoa_tpu.ops.pallas_chain import (
         BLOCK_SIZE_PREFERENCE, chain_fits, fused_fits,
     )
-    from cocoa_tpu.ops.pallas_sparse import sparse_chain_fits
+    from cocoa_tpu.ops.pallas_sparse import hybrid_fits, sparse_chain_fits
 
     itemsize = jnp.dtype(dtype).itemsize
     if itemsize != 4:
@@ -93,13 +97,21 @@ def auto_block_size(ds: ShardedDataset, m_local: int, dtype) -> int:
         if ds.layout == "sparse":
             # same precedence as the block dispatch: the fused kernel
             # first (densify is cheap when the half-tile fits), the CSR
-            # Gram path when it cannot (the rcv1 regime)
+            # Gram path when it cannot (the rcv1 regime); hybrid layouts
+            # gate on the RESIDUAL streams + panel alignment
+            # (hybrid_fits), which the narrower residual only loosens
+            width = int(ds.sp_indices.shape[-1])
+            stream_ok = (
+                hybrid_fits(m_local, ds.n_shard, ds.num_features, width,
+                            b, ds.n_hot, itemsize)
+                if ds.n_hot else
+                sparse_chain_fits(m_local, ds.n_shard, ds.num_features,
+                                  width, b, itemsize)
+            )
             if not (
                 fused_fits(m_local, b, ds.num_features, itemsize,
                            ds.n_shard)
-                or sparse_chain_fits(
-                    m_local, ds.n_shard, ds.num_features,
-                    int(ds.sp_indices.shape[-1]), b, itemsize)
+                or stream_ok
             ):
                 continue
         return b
@@ -511,10 +523,13 @@ def run_sdca_family(
                                params.local_iters) > 0
         else:
             # sparse kernel: the SMEM feature-index table and the
-            # lane-blocked d-vectors must fit (pallas_sparse docstring)
+            # lane-blocked d-vectors must fit (pallas_sparse docstring);
+            # hybrid layouts additionally account the hot panel's VMEM
+            # (per-shard Δw_hot + the per-step panel row buffers)
             fits = sparse_kernel_fits(
                 m_local, ds.n_shard, ds.num_features,
                 int(ds.sp_indices.shape[-1]), params.local_iters, itemsize,
+                n_hot=ds.n_hot,
             )
         pallas = (
             math == "fast"

@@ -25,6 +25,19 @@ import sys
 _NUM = (int, float)
 _OPT_NUM = (int, float, type(None))
 
+# the run_start manifest's layout-split record (--hotCols provenance,
+# data/hybrid.resolve_hot_cols): present on sparse-layout svm runs so
+# benchmark provenance is machine-readable — which panel the run trained
+# on, what it covered, and what the residual streams still pay
+LAYOUT_SPLIT_FIELDS = {
+    "spec": (str,),
+    "hot_cols": (int,),
+    "coverage": _NUM,
+    "residual_mean_nnz": _NUM,
+    "residual_max_nnz": (int,),
+    "panel_bytes": (int,),
+}
+
 # event type -> {field: allowed types}; every event also needs seq/ts
 EVENT_FIELDS = {
     "run_start": {"manifest": (dict,)},
@@ -114,6 +127,15 @@ def check_event_lines(objs) -> list:
         if not isinstance(obj.get("ts"), _NUM):
             errors.append(f"{where}: missing/invalid ts")
         _typecheck(obj, EVENT_FIELDS[ev], where, errors)
+        if ev == "run_start":
+            man = obj.get("manifest")
+            split = man.get("layout_split") if isinstance(man, dict) else None
+            if split is not None:
+                if not isinstance(split, dict):
+                    errors.append(f"{where}: layout_split must be an object")
+                else:
+                    _typecheck(split, LAYOUT_SPLIT_FIELDS,
+                               f"{where}: layout_split", errors)
     return errors
 
 

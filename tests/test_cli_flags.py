@@ -118,6 +118,37 @@ def test_cli_bad_flags_exit_cleanly(argv, capsys):
     assert "error:" in capsys.readouterr().err
 
 
+def test_cli_hot_cols_flag_parsing(tmp_path, capsys):
+    """--hotCols/--evalDense land in the run-level extras; bad values and
+    layout mismatches fail with the CLI convention (error + exit 2)."""
+    cfg, extras = parse_args(["--hotCols=auto", "--evalDense=auto"])
+    assert extras["hotCols"] == "auto"
+    assert extras["evalDense"] == "auto"
+
+    from cocoa_tpu.cli import main
+    from cocoa_tpu.data.synth import synth_sparse, write_libsvm
+
+    path = str(tmp_path / "t.dat")
+    write_libsvm(synth_sparse(64, 400, nnz_mean=8, seed=0), path)
+    base = [f"--trainFile={path}", "--numFeatures=400", "--numSplits=4",
+            "--mesh=1"]
+    assert main(base + ["--hotCols=garbage"]) == 2
+    assert "auto|off" in capsys.readouterr().err
+    assert main(base + ["--hotCols=-3"]) == 2
+    assert "error:" in capsys.readouterr().err
+    # oversized explicit panel: rejected with the HBM accounting
+    import cocoa_tpu.data.hybrid as hybrid
+
+    orig = hybrid.HOT_PANEL_HBM_BUDGET
+    hybrid.HOT_PANEL_HBM_BUDGET = 1024
+    try:
+        assert main(base + ["--hotCols=256"]) == 2
+        err = capsys.readouterr().err
+        assert "HBM" in err and "MiB" in err
+    finally:
+        hybrid.HOT_PANEL_HBM_BUDGET = orig
+
+
 def test_cli_sigma_schedule_and_warm_start_flags():
     """--sigmaSchedule / --warmStart land in the run-level extras (they
     are run_cocoa kwargs, not RunConfig fields)."""

@@ -1,0 +1,470 @@
+"""Hot/cold column split (round 10 tentpole) — the hybrid sparse layout:
+MXU hot panel + cold residual streams (data/hybrid.py, docs/DESIGN.md
+§3b-vi), consumed by the row accessors (ops/rows.py), the sparse
+block-chain path (the panel Gram matmul joining the residual stream
+merges in local_sdca_block_batched), and the sequential sparse kernel
+(per-step panel rows through VMEM, ops/pallas_sparse.py).
+
+The split partitions each row's nonzeros by column — a permutation of
+every per-nonzero sum — so the contract mirrors tests/test_sparse_block.py:
+the hybrid paths consume the SAME sampled index stream as the sequential
+fast path on the UNSPLIT layout and are identical to it in real
+arithmetic; trajectory parity (f64 at ~1e-12, f32 at fp tolerance) is
+pinned in CPU interpret mode across the block, sequential, and
+SMEM-segmented split-fallback branches, all three SDCA modes, the driver
+integration, and the `--hotCols` resolution (auto coverage target,
+explicit HBM accounting, `off` as the bit-exact stream control).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data import hybrid
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.data.synth import synth_sparse
+from cocoa_tpu.ops.local_sdca import local_sdca_block_batched, local_sdca_fast
+from cocoa_tpu.ops.pallas_sparse import pallas_sparse_sdca_round
+from cocoa_tpu.ops.rows import shard_margins
+from cocoa_tpu.solvers import run_cocoa
+from cocoa_tpu.utils.prng import sample_indices_per_shard
+
+K = 4
+N_HOT = 256
+
+
+@pytest.fixture(scope="module")
+def zipf_data():
+    """Distribution-faithful rcv1-like synth (Zipf columns, log-normal row
+    lengths, tf-idf values) at CI scale — the regime the split exists for."""
+    return synth_sparse(300, 800, nnz_mean=20, seed=3)
+
+
+def _pair(data, dtype=jnp.float64, n_hot=N_HOT, k=K):
+    """(unsplit, hybrid) shardings of the same data."""
+    plain = shard_dataset(data, k=k, layout="sparse", dtype=dtype)
+    hyb = shard_dataset(data, k=k, layout="sparse", dtype=dtype,
+                        hot_cols=n_hot)
+    return plain, hyb
+
+
+def _compare_vs_fast(da_h, dw_h, plain, w, alpha, idxs, n, mode, sigma,
+                     rtol, atol):
+    """Pin hybrid outputs against the sequential fast path on the UNSPLIT
+    layout — the same oracle the round-6 sparse-block kernel was pinned
+    against."""
+    sa = plain.shard_arrays()
+    d = w.shape[0]
+    for s in range(alpha.shape[0]):
+        shard = {kk: v[s] for kk, v in sa.items()}
+        da_f, dw_f = local_sdca_fast(
+            shard_margins(w, shard), alpha[s], shard, idxs[s], 0.01, n,
+            jnp.zeros(d, w.dtype), mode=mode, sigma=sigma,
+        )
+        np.testing.assert_allclose(np.asarray(da_h[s]), np.asarray(da_f),
+                                   rtol=rtol, atol=atol)
+        np.testing.assert_allclose(np.asarray(dw_h[s]), np.asarray(dw_f),
+                                   rtol=rtol, atol=atol)
+
+
+# --------------------------------------------------------------------------
+# the layout itself
+# --------------------------------------------------------------------------
+
+
+def test_split_is_exact_partition(zipf_data):
+    """Hot panel + cold residual reconstruct exactly the unsplit rows —
+    the split moves nonzeros, it never changes or duplicates them."""
+    plain, hyb = _pair(zipf_data)
+    d = zipf_data.num_features
+    spi0, spv0 = np.asarray(plain.sp_indices), np.asarray(plain.sp_values)
+    spi1, spv1 = np.asarray(hyb.sp_indices), np.asarray(hyb.sp_values)
+    xh, hc = np.asarray(hyb.X_hot), np.asarray(hyb.hot_cols)
+    assert hyb.n_hot == N_HOT and hc.shape == (K, N_HOT)
+    # the residual width is the max COLD nnz — strictly under the unsplit
+    # width on Zipf data
+    assert spi1.shape[-1] < spi0.shape[-1]
+    for s in range(K):
+        for i in range(hyb.n_shard):
+            full = np.zeros(d)
+            np.add.at(full, spi0[s, i], spv0[s, i])
+            split = np.zeros(d)
+            np.add.at(split, spi1[s, i], spv1[s, i])
+            np.add.at(split, hc[s], xh[s, i])
+            np.testing.assert_array_equal(split, full)
+    # hot ids are the top-count columns of the measured histogram
+    counts = hybrid.column_counts(zipf_data)
+    expect = hybrid.hottest_columns(counts, N_HOT)
+    np.testing.assert_array_equal(hc[0][:len(expect)], expect)
+
+
+def test_hot_cols_off_is_bit_exact_control(zipf_data):
+    """hot_cols=0 must leave every array of today's stream layout
+    untouched — the A/B control the flag promises."""
+    plain = shard_dataset(zipf_data, k=K, layout="sparse")
+    off = shard_dataset(zipf_data, k=K, layout="sparse", hot_cols=0)
+    assert off.X_hot is None and off.hot_cols is None
+    np.testing.assert_array_equal(np.asarray(off.sp_indices),
+                                  np.asarray(plain.sp_indices))
+    np.testing.assert_array_equal(np.asarray(off.sp_values),
+                                  np.asarray(plain.sp_values))
+
+
+def test_shard_margins_and_eval_match(zipf_data):
+    """The hybrid row accessors reproduce the unsplit margins to f64
+    reassociation tolerance (the split permutes each row's sum)."""
+    plain, hyb = _pair(zipf_data)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=zipf_data.num_features))
+    sa_p, sa_h = plain.shard_arrays(), hyb.shard_arrays()
+    for s in range(K):
+        m0 = shard_margins(w, {kk: v[s] for kk, v in sa_p.items()})
+        mh = shard_margins(w, {kk: v[s] for kk, v in sa_h.items()})
+        np.testing.assert_allclose(np.asarray(mh), np.asarray(m0),
+                                   rtol=1e-12, atol=1e-12)
+
+
+def test_resolve_hot_cols(zipf_data):
+    """--hotCols resolution: auto hits the coverage target under the HBM
+    budget, explicit widths pad to lanes, oversized panels are REJECTED
+    with the accounting, off resolves to the stream layout."""
+    n_hot, stats = hybrid.resolve_hot_cols("auto", zipf_data, K,
+                                           jnp.float32)
+    assert n_hot % 128 == 0 and n_hot > 0
+    assert stats["coverage"] >= hybrid.HOT_COVERAGE_TARGET
+    assert stats["panel_bytes"] > 0
+    assert stats["residual_mean_nnz"] < 20  # the tail is a fraction
+
+    n_off, stats_off = hybrid.resolve_hot_cols("off", zipf_data, K,
+                                               jnp.float32)
+    assert n_off == 0 and stats_off["hot_cols"] == 0
+
+    n_x, stats_x = hybrid.resolve_hot_cols("100", zipf_data, K, jnp.float32)
+    assert n_x == 128  # padded to whole lane blocks
+
+    # resolve and build must stay in lockstep: both derive the hot set
+    # from hybrid.hottest_columns(column_counts(data), n), so the
+    # manifest's residual stats describe the layout actually built
+    ds = shard_dataset(zipf_data, k=K, layout="sparse", hot_cols=n_hot)
+    assert int(ds.sp_indices.shape[-1]) == stats["residual_max_nnz"]
+
+    with pytest.raises(ValueError, match="HBM|budget"):
+        hybrid.resolve_hot_cols("256", zipf_data, K, jnp.float32,
+                                budget=1024)
+    with pytest.raises(ValueError, match="auto|off"):
+        hybrid.resolve_hot_cols("garbage", zipf_data, K, jnp.float32)
+
+    # auto under a tiny budget: clamps down, and to 0 when nothing fits
+    n_clamped, _ = hybrid.resolve_hot_cols(
+        "auto", zipf_data, K, jnp.float32,
+        budget=hybrid.panel_bytes(128, K, 80, 4))
+    assert n_clamped == 128
+    n_none, _ = hybrid.resolve_hot_cols("auto", zipf_data, K, jnp.float32,
+                                        budget=1024)
+    assert n_none == 0
+
+
+def test_hot_cols_rejects_dense_layout(zipf_data):
+    with pytest.raises(ValueError, match="sparse"):
+        shard_dataset(zipf_data, k=K, layout="dense", hot_cols=128)
+
+
+# --------------------------------------------------------------------------
+# the hybrid BLOCK branch (panel Gram matmul + residual stream merges)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
+                                        ("frozen", 1.0)])
+def test_hybrid_block_matches_fast(zipf_data, mode, sigma):
+    """f32 interpret-mode parity vs the sequential fast path on the
+    UNSPLIT layout — masked tail (H=37 vs B=128) and duplicate draws
+    included, all three SDCA modes."""
+    plain, hyb = _pair(zipf_data, dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    d = zipf_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, hyb.n_shard)) * 0.3 + 0.3, 0, 1),
+        jnp.float32,
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 37, hyb.counts)[:, 0, :]
+    )
+    da_h, dw_h = local_sdca_block_batched(
+        w, alpha, hyb.shard_arrays(), idxs, 0.01, zipf_data.n, mode=mode,
+        sigma=sigma, block=128, interpret=True, sparse_gram=True,
+    )
+    _compare_vs_fast(da_h, dw_h, plain, w, alpha, idxs, zipf_data.n,
+                     mode, sigma, rtol=2e-4, atol=1e-6)
+
+
+def test_hybrid_block_f64(zipf_data):
+    """f64 pins the algebra at ~1e-12 — the same 'bit-comparable at f64'
+    contract the round-6 kernel carries (fp reassociation is the entire
+    difference; the split adds no math)."""
+    plain, hyb = _pair(zipf_data, dtype=jnp.float64)
+    rng = np.random.default_rng(11)
+    d = zipf_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, hyb.n_shard)) * 0.3 + 0.3, 0, 1))
+    idxs = jnp.asarray(
+        sample_indices_per_shard(3, range(1, 2), 37, hyb.counts)[:, 0, :]
+    )
+    da_h, dw_h = local_sdca_block_batched(
+        w, alpha, hyb.shard_arrays(), idxs, 0.01, zipf_data.n, mode="plus",
+        sigma=4.0, block=128, interpret=True, sparse_gram=True,
+    )
+    _compare_vs_fast(da_h, dw_h, plain, w, alpha, idxs, zipf_data.n,
+                     "plus", 4.0, rtol=1e-9, atol=1e-12)
+
+
+def test_hybrid_block_split_fallback_segmented(zipf_data, monkeypatch):
+    """The SMEM split-fallback branch: shrink the budget so the residual
+    Gram runs in (S, S) row-segment tiles, and span two blocks (H=200)
+    so the Δw carry — including the separately-carried hot Δw — crosses
+    block boundaries."""
+    import cocoa_tpu.ops.pallas_sparse as ps
+
+    plain, hyb = _pair(zipf_data, dtype=jnp.float32)
+    w_nnz = int(hyb.sp_indices.shape[-1])
+    group = min(ps.GROUP, w_nnz)
+    w_r = -(-w_nnz // group) * group
+    monkeypatch.setattr(ps, "SMEM_IDX_BUDGET", 16 * 32 * w_r)
+    assert ps.seg_rows(128, w_nnz) == 32
+    rng = np.random.default_rng(5)
+    d = zipf_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, hyb.n_shard)) * 0.3 + 0.3, 0, 1),
+        jnp.float32,
+    )
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 200, hyb.counts)[:, 0, :]
+    )
+    da_h, dw_h = local_sdca_block_batched(
+        w, alpha, hyb.shard_arrays(), idxs, 0.01, zipf_data.n, mode="plus",
+        sigma=4.0, block=128, interpret=True, sparse_gram=True,
+    )
+    _compare_vs_fast(da_h, dw_h, plain, w, alpha, idxs, zipf_data.n,
+                     "plus", 4.0, rtol=2e-4, atol=1e-6)
+
+
+def test_hybrid_densified_fallback(zipf_data):
+    """The densified (non-sparse-Gram) block fallback gathers hybrid rows
+    correctly too: hot panel scatters join the residual scatter in the
+    (K, B, d) tile."""
+    plain, hyb = _pair(zipf_data, dtype=jnp.float64)
+    rng = np.random.default_rng(2)
+    d = zipf_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, hyb.n_shard)) * 0.3 + 0.3, 0, 1))
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 24, hyb.counts)[:, 0, :]
+    )
+    da_h, dw_h = local_sdca_block_batched(
+        w, alpha, hyb.shard_arrays(), idxs, 0.01, zipf_data.n, mode="plus",
+        sigma=4.0, block=128, interpret=True, sparse_gram=False,
+    )
+    _compare_vs_fast(da_h, dw_h, plain, w, alpha, idxs, zipf_data.n,
+                     "plus", 4.0, rtol=1e-9, atol=1e-12)
+
+
+# --------------------------------------------------------------------------
+# the hybrid SEQUENTIAL kernel branch
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
+                                        ("frozen", 1.0)])
+def test_hybrid_seq_kernel_matches_fast(zipf_data, mode, sigma):
+    """The sequential sparse kernel's hybrid branch (per-step panel rows
+    through VMEM + residual streams), f64 interpret mode, all modes."""
+    plain, hyb = _pair(zipf_data, dtype=jnp.float64)
+    rng = np.random.default_rng(0)
+    d = zipf_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, hyb.n_shard)) * 0.3 + 0.3, 0, 1))
+    idxs = jnp.asarray(
+        sample_indices_per_shard(7, range(1, 2), 37, hyb.counts)[:, 0, :]
+    )
+    sa = hyb.shard_arrays()
+    dw_h, a_h = pallas_sparse_sdca_round(
+        w, alpha, sa["sp_indices"], sa["sp_values"], sa["labels"],
+        sa["sq_norms"], idxs, 0.01, zipf_data.n, mode=mode, sigma=sigma,
+        interpret=True, hot_cols=sa["hot_cols"], hot_panel=sa["X_hot"],
+    )
+    _compare_vs_fast(a_h - alpha, dw_h, plain, w, alpha, idxs, zipf_data.n,
+                     mode, sigma, rtol=1e-9, atol=1e-12)
+
+
+def test_hybrid_seq_kernel_segmented(zipf_data, monkeypatch):
+    """SMEM segmentation of the sequential hybrid round: the hot Δw must
+    carry across segment boundaries exactly like [w | Δw] does."""
+    import cocoa_tpu.ops.pallas_sparse as ps
+
+    monkeypatch.setattr(ps, "SMEM_IDX_BUDGET", 8 * K * 32 * 10)
+    plain, hyb = _pair(zipf_data, dtype=jnp.float64)
+    rng = np.random.default_rng(0)
+    d = zipf_data.num_features
+    w = jnp.asarray(rng.normal(size=d) * 0.1)
+    alpha = jnp.asarray(
+        np.clip(rng.normal(size=(K, hyb.n_shard)) * 0.3 + 0.3, 0, 1))
+    idxs = jnp.asarray(
+        sample_indices_per_shard(9, range(1, 2), 64, hyb.counts)[:, 0, :]
+    )
+    sa = hyb.shard_arrays()
+    dw_h, a_h = pallas_sparse_sdca_round(
+        w, alpha, sa["sp_indices"], sa["sp_values"], sa["labels"],
+        sa["sq_norms"], idxs, 0.01, zipf_data.n, mode="plus", sigma=4.0,
+        interpret=True, hot_cols=sa["hot_cols"], hot_panel=sa["X_hot"],
+    )
+    _compare_vs_fast(a_h - alpha, dw_h, plain, w, alpha, idxs, zipf_data.n,
+                     "plus", 4.0, rtol=1e-9, atol=1e-12)
+
+
+# --------------------------------------------------------------------------
+# dispatch + fits
+# --------------------------------------------------------------------------
+
+
+def test_hybrid_fits_accounting():
+    from cocoa_tpu.ops.pallas_sparse import (
+        hybrid_fits, sparse_chain_fits, sparse_kernel_fits,
+    )
+
+    # rcv1-flagship shapes: the RESIDUAL width (214 at 75% coverage) only
+    # loosens the stream constraint the unsplit width already passes
+    assert sparse_chain_fits(8, 2544, 47236, 548, 128, 4)
+    assert hybrid_fits(8, 2544, 47236, 214, 128, 2048, 4)
+    assert not hybrid_fits(8, 2544, 47236, 214, 128, 0, 4)     # no panel
+    assert not hybrid_fits(8, 2544, 47236, 214, 128, 100, 4)   # unaligned
+    assert not hybrid_fits(8, 2544, 47236, 5000, 128, 2048, 4)  # streams
+    # sequential kernel: the panel adds VMEM; a huge panel fails the fit
+    assert sparse_kernel_fits(8, 2544, 47236, 214, 253, 4, n_hot=2048)
+    assert not sparse_kernel_fits(8, 2544, 47236, 214, 253, 4,
+                                  n_hot=1 << 20)
+
+
+def test_auto_block_size_hybrid(zipf_data):
+    """--blockSize=auto accepts the hybrid layout through hybrid_fits
+    (the residual streams are narrower, so a split layout never resolves
+    worse than the unsplit one)."""
+    from cocoa_tpu.solvers.cocoa import auto_block_size
+
+    plain, hyb = _pair(zipf_data, dtype=jnp.float32)
+    assert auto_block_size(hyb, K, jnp.float32) == \
+        auto_block_size(plain, K, jnp.float32) == 128
+
+
+# --------------------------------------------------------------------------
+# driver + eval integration
+# --------------------------------------------------------------------------
+
+
+def test_hybrid_through_driver_block(zipf_data):
+    """run_cocoa on the hybrid layout (sparse-Gram block path) reproduces
+    the unsplit fast-path trajectory, including the final duality gap."""
+    plain, hyb = _pair(zipf_data, dtype=jnp.float32)
+    p = Params(n=zipf_data.n, num_rounds=6, local_iters=20, lam=0.01)
+    dbg = DebugParams(debug_iter=3, seed=0)
+    w_f, a_f, traj_f = run_cocoa(plain, p, dbg, plus=True, quiet=True,
+                                 math="fast", pallas=False)
+    w_h, a_h, traj_h = run_cocoa(hyb, p, dbg, plus=True, quiet=True,
+                                 math="fast", block_size=128,
+                                 block_chain="pallas_interpret",
+                                 block_sparse_gram=True)
+    np.testing.assert_allclose(np.asarray(w_h), np.asarray(w_f),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a_h), np.asarray(a_f),
+                               rtol=2e-4, atol=1e-6)
+    assert traj_h.records[-1].gap == pytest.approx(
+        traj_f.records[-1].gap, rel=1e-3)
+
+
+def test_hybrid_through_driver_fast_xla(zipf_data):
+    """The plain fast path (no kernels) handles the hybrid layout through
+    the row accessors alone — the structural guarantee that oversized
+    panels can always fall back without losing the layout."""
+    plain, hyb = _pair(zipf_data, dtype=jnp.float64)
+    p = Params(n=zipf_data.n, num_rounds=4, local_iters=12, lam=0.01)
+    dbg = DebugParams(debug_iter=2, seed=0)
+    w_f, a_f, _ = run_cocoa(plain, p, dbg, plus=True, quiet=True,
+                            math="fast", pallas=False)
+    w_h, a_h, _ = run_cocoa(hyb, p, dbg, plus=True, quiet=True,
+                            math="fast", pallas=False)
+    np.testing.assert_allclose(np.asarray(w_h), np.asarray(w_f),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a_h), np.asarray(a_f),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_eval_dense_auto_trained_state_bit_identical(zipf_data):
+    """--evalDense on/off over the SAME hybrid layout: eval routing (dense
+    twin vs hot panel + residual stream) may change logged metrics only by
+    rounding order — the TRAINED (w, alpha) must be bit-identical, proving
+    no training path reads either eval structure."""
+    hyb = shard_dataset(zipf_data, k=K, layout="sparse",
+                        dtype=jnp.float64, hot_cols=N_HOT)
+    hyb_twin = shard_dataset(zipf_data, k=K, layout="sparse",
+                             dtype=jnp.float64, hot_cols=N_HOT,
+                             eval_dense=True)
+    p = Params(n=zipf_data.n, num_rounds=4, local_iters=8, lam=0.01)
+    dbg = DebugParams(debug_iter=2, seed=0)
+    w_p, a_p, traj_p = run_cocoa(hyb, p, dbg, plus=True, quiet=True,
+                                 math="fast")
+    w_t, a_t, traj_t = run_cocoa(hyb_twin, p, dbg, plus=True, quiet=True,
+                                 math="fast")
+    np.testing.assert_array_equal(np.asarray(w_t), np.asarray(w_p))
+    np.testing.assert_array_equal(np.asarray(a_t), np.asarray(a_p))
+    for rp, rt in zip(traj_p.records, traj_t.records):
+        np.testing.assert_allclose(rt.gap, rp.gap, rtol=1e-12, atol=1e-12)
+
+
+def test_subgradient_and_sgd_handle_hybrid(zipf_data):
+    """DistGD's vectorized subgradient pass (and with it the SGD family's
+    shard_margins) reproduces the unsplit result on the hybrid layout."""
+    from cocoa_tpu.ops.subgradient import subgradient_pass
+
+    plain, hyb = _pair(zipf_data, dtype=jnp.float64)
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=zipf_data.num_features))
+    sa_p, sa_h = plain.shard_arrays(), hyb.shard_arrays()
+    for s in range(K):
+        g_p = subgradient_pass(w, {kk: v[s] for kk, v in sa_p.items()}, 0.01)
+        g_h = subgradient_pass(w, {kk: v[s] for kk, v in sa_h.items()}, 0.01)
+        np.testing.assert_allclose(np.asarray(g_h), np.asarray(g_p),
+                                   rtol=1e-9, atol=1e-12)
+
+
+def test_cli_hot_cols_end_to_end(tmp_path, capsys):
+    """--hotCols=auto through the CLI: the resolution note prints the
+    panel accounting, the run completes, and --hotCols on a dense layout
+    is rejected."""
+    from cocoa_tpu import cli
+    from cocoa_tpu.data.synth import write_libsvm
+
+    path = str(tmp_path / "train.dat")
+    write_libsvm(synth_sparse(200, 600, nnz_mean=15, seed=1), path)
+    rc = cli.main([
+        f"--trainFile={path}", "--numFeatures=600", "--numSplits=4",
+        "--numRounds=3", "--localIterFrac=0.2", "--lambda=.01",
+        "--debugIter=3", "--mesh=1", "--hotCols=auto", "--evalDense=auto",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "hotCols=auto: panel" in out
+    assert "nonzero coverage" in out and "MiB HBM" in out
+    assert "evalDense=auto:" in out
+
+    rc = cli.main([
+        f"--trainFile={path}", "--numFeatures=600", "--layout=dense",
+        "--hotCols=64",
+    ])
+    assert rc == 2
+    assert "sparse layout" in capsys.readouterr().err

@@ -345,6 +345,61 @@ def test_schema_checker_rejects_malformed(tmp_path):
     assert tele_schema.check_file(str(traj), kind="trajectory") != []
 
 
+def test_run_start_layout_split_schema(tmp_path):
+    """The run_start manifest's layout_split record (--hotCols provenance,
+    ISSUE 5 satellite): a well-formed record validates; wrong-typed fields
+    and a non-object record are schema violations."""
+    split = {"spec": "auto", "hot_cols": 2048, "coverage": 0.75,
+             "residual_mean_nnz": 18.4, "residual_max_nnz": 214,
+             "panel_bytes": 166723584}
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(
+        {"event": "run_start", "seq": 1, "ts": 1.0,
+         "manifest": {"config": {}, "config_hash": "x",
+                      "layout_split": split}}) + "\n")
+    assert tele_schema.check_file(str(good)) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"event": "run_start", "seq": 1, "ts": 1.0,
+         "manifest": {"layout_split": {**split, "coverage": "high",
+                                       "hot_cols": 2048.5}}}) + "\n")
+    errs = tele_schema.check_file(str(bad))
+    assert any("coverage" in e for e in errs)
+    assert any("hot_cols" in e for e in errs)
+    worse = tmp_path / "worse.jsonl"
+    worse.write_text(json.dumps(
+        {"event": "run_start", "seq": 1, "ts": 1.0,
+         "manifest": {"layout_split": [1, 2]}}) + "\n")
+    assert any("layout_split" in e
+               for e in tele_schema.check_file(str(worse)))
+
+
+def test_cli_emits_layout_split_in_run_start(tmp_path):
+    """A sparse --hotCols CLI run records the resolved split in its
+    run_start manifest — machine-readable benchmark provenance."""
+    from cocoa_tpu import cli
+    from cocoa_tpu.data.synth import synth_sparse, write_libsvm
+
+    path = str(tmp_path / "train.dat")
+    write_libsvm(synth_sparse(120, 500, nnz_mean=10, seed=2), path)
+    ev = str(tmp_path / "events.jsonl")
+    rc = cli.main([
+        f"--trainFile={path}", "--numFeatures=500", "--numSplits=4",
+        "--numRounds=2", "--localIterFrac=0.2", "--debugIter=2",
+        "--mesh=1", "--quiet", "--hotCols=128", f"--events={ev}",
+    ])
+    assert rc == 0
+    assert tele_schema.check_file(ev) == []
+    starts = [json.loads(ln) for ln in open(ev)
+              if json.loads(ln)["event"] == "run_start"]
+    assert len(starts) == 1
+    split = starts[0]["manifest"]["layout_split"]
+    assert split["hot_cols"] == 128
+    assert 0.0 < split["coverage"] <= 1.0
+    assert split["residual_mean_nnz"] >= 0.0
+    assert split["panel_bytes"] > 0
+
+
 def test_inactive_bus_is_inert():
     """With no sink configured, emit() is a no-op and solver runs stay on
     the non-streaming executable (no tap, no events, no files)."""
