@@ -879,6 +879,8 @@ def _oracle_rounds_per_s_lasso(A, bvec, lam, h, k, rounds=2, l2=0.0):
     sizes = split_sizes(d, k)
     offs = np.concatenate([[0], np.cumsum(sizes)])
     sigma = float(k)
+    # jaxlint: allow=f64 -- the pinned CPU oracle is Breeze-faithful f64
+    # by definition; it is what the f32 TPU runs are measured against
     r = -bvec.astype(np.float64)
     x = np.zeros(d)
 
@@ -930,6 +932,8 @@ def bench_lasso(results, perf_rows, quick):
     indptr = np.arange(0, (n + 1) * d, d, dtype=np.int64)
     # values stay f32: shard_columns casts to the compute dtype anyway, and
     # an f64 copy of the dense design would be a ~2 GB host transient
+    # jaxlint: allow=f64 -- LibsvmData labels ride the container's f64
+    # host contract (cast at shard time)
     data = LibsvmData(labels=bvec.astype(np.float64), indptr=indptr,
                       indices=np.tile(np.arange(d, dtype=np.int32), n),
                       values=A.reshape(-1), num_features=d)

@@ -1,0 +1,732 @@
+"""jaxlint static rules — this repo's proven JAX failure classes, as AST
+checks.
+
+Rules (ids are what ``jaxlint: allow=<rule>`` and the baseline key on):
+
+- ``donation`` — donation audit.  Every ``donate_argnums`` must name a
+  positional argument the jitted fn actually consumes; step-shaped jit
+  sites in ``solvers/`` must donate their loop-carried state; and the
+  PR-2 bug shape — ``x.at[...].op(...) ± x`` on a loop-carried buffer,
+  which forces XLA to keep both the old and new buffer live and silently
+  defeats donation with a full copy — is an error anywhere in traced
+  code (the fix shape: scatter the delta into ``zeros_like`` instead).
+- ``host-sync`` — device→host syncs inside traced code: ``float()`` /
+  ``int()`` / ``bool()`` on traced values, ``.item()`` / ``.tolist()`` /
+  ``jax.device_get``, ``np.asarray``/``np.array`` of traced values, bare
+  ``if``/``while`` on a traced value, and host ``print`` of traced
+  values.  The sanctioned escape hatch — the ordered ``io_callback``
+  telemetry tap (telemetry/events.py) — is allowlisted by construction:
+  callbacks passed to ``io_callback``/``pure_callback``/``jax.debug.*``
+  run on the host and are never treated as traced.
+- ``f64`` — float64 leaks.  Repo policy (DESIGN.md §6): compute dtype is
+  f32; float64 belongs only in parity tests and ``evals`` certificate
+  math.  Anything else is either a bug or needs a justified
+  ``jaxlint: allow=f64`` (host-side exact parsing in the data loaders).
+- ``mesh-api`` — inventory of every mesh/shard_map call site using an
+  API surface that does not exist on the pinned jax 0.4.37
+  (``jax.shard_map``, ``lax.pcast``/``pvary``, ``jax.sharding.AxisType``,
+  ``jax.make_mesh(axis_types=...)``).  These are exactly the sites behind
+  the tier-1 suite's standing 40+14 mesh failures; the findings ARE the
+  ROADMAP item 4 worklist, each with its supported-API replacement.
+- ``pallas-budget`` — the AST half of the Pallas memory accounting
+  (``pallas_budget.py`` holds the numeric half): every ``pl.pallas_call``
+  must live in a module that declares a VMEM budget constant and a
+  ``*_fits`` gate, and every gate must actually be consulted outside its
+  own module (a gate nobody calls protects nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from cocoa_tpu.analysis.core import Finding, SourceFile
+
+# --- shared AST infrastructure ---------------------------------------------
+
+# callees whose function-valued arguments are traced (control flow and this
+# repo's own fan-out combinators)
+_TRACED_ARG_CALLEES = {
+    "while_loop", "scan", "fori_loop", "cond", "switch", "associative_scan",
+    "fanout", "chunk_fanout", "vmap", "pmap", "shard_map", "grad",
+    "value_and_grad", "checkpoint", "remat", "custom_vjp", "custom_jvp",
+}
+
+# callees whose function-valued arguments run on the HOST (the sanctioned
+# device→host escape hatches; the io_callback telemetry tap rides these)
+_CALLBACK_CALLEES = {
+    "io_callback", "pure_callback", "debug_callback", "callback",
+}
+
+_STEP_NAME_RE = re.compile(r"^(round_step|chunk_step|step|run)$")
+
+_NP_MODULES = {"np", "numpy", "onp"}
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'jax.lax.while_loop' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _callee_tail(call: ast.Call) -> str:
+    chain = _attr_chain(call.func)
+    return chain.rsplit(".", 1)[-1] if chain else ""
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return _attr_chain(node) in ("jax.jit", "jit")
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[tuple]:
+    """Evaluate a donate_argnums value when it is a literal; None when the
+    expression is dynamic (e.g. ``tuple(range(n_state))``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+class JitSite:
+    """One jax.jit application with a resolvable target function."""
+
+    def __init__(self, node: ast.AST, target: Optional[ast.AST],
+                 donate: Optional[tuple], has_donate_kw: bool,
+                 assigned_name: Optional[str], static_names=frozenset()):
+        self.node = node                # the Call / decorated FunctionDef
+        self.target = target            # FunctionDef | Lambda | None
+        self.donate = donate            # tuple of ints | None (dynamic)
+        self.has_donate_kw = has_donate_kw
+        self.assigned_name = assigned_name
+        self.static_names = static_names  # static_argnames/argnums params
+
+
+class ModuleIndex(ast.NodeVisitor):
+    """One pass over a module: def tables per scope, parent links, jit
+    sites, traced-context seeds, callback targets."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.parent_def: dict = {}      # def node -> enclosing def | None
+        self.defs: list = []            # every FunctionDef/Lambda
+        self.scope_defs: dict = {}      # scope node (def|Module) -> {name: def}
+        self.jit_sites: list = []
+        self.traced_seeds: set = set()  # def ids seeded traced (lax/combinators)
+        self.callback_targets: set = set()  # def ids that run on the host
+        self.static_params: dict = {}   # def id -> static (untraced) params
+        self._scope_stack: list = []
+        self._assign_target: Optional[str] = None
+
+    # -- scope bookkeeping
+
+    def index(self):
+        self.scope_defs[self.src.tree] = {}
+        self._scope_stack = [self.src.tree]
+        self.visit(self.src.tree)
+        return self
+
+    def _current_scope(self):
+        return self._scope_stack[-1]
+
+    def _resolve(self, name: str) -> Optional[ast.AST]:
+        for scope in reversed(self._scope_stack):
+            d = self.scope_defs.get(scope, {})
+            if name in d:
+                return d[name]
+        return None
+
+    def _resolve_fn_arg(self, node: ast.AST) -> Optional[ast.AST]:
+        if isinstance(node, ast.Name):
+            return self._resolve(node.id)
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Call):
+            # functools.partial(f, ...) — resolve through to f
+            if _callee_tail(node) == "partial" and node.args:
+                return self._resolve_fn_arg(node.args[0])
+        return None
+
+    # -- visitors
+
+    def visit_FunctionDef(self, node):
+        self._handle_def(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._handle_def(node, None)
+
+    def _handle_def(self, node, name):
+        parent = self._scope_stack[-1]
+        self.parent_def[node] = parent if parent is not self.src.tree else None
+        self.defs.append(node)
+        if name is not None:
+            self.scope_defs.setdefault(parent, {})[name] = node
+        self.scope_defs.setdefault(node, {})
+        # jit decorators
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                site = self._jit_from_decorator(dec, node)
+                if site is not None:
+                    self.jit_sites.append(site)
+        self._scope_stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._scope_stack.pop()
+
+    def _jit_from_decorator(self, dec, fn) -> Optional[JitSite]:
+        if _is_jax_jit(dec):
+            return self._make_site(fn, fn, None, fn.name)
+        if isinstance(dec, ast.Call):
+            # @jax.jit(...) or @functools.partial(jax.jit, ...)
+            if not (_is_jax_jit(dec.func)
+                    or (_callee_tail(dec) == "partial" and dec.args
+                        and _is_jax_jit(dec.args[0]))):
+                return None
+            return self._make_site(fn, fn, dec, fn.name)
+        return None
+
+    def _make_site(self, node, target, call: Optional[ast.Call],
+                   assigned_name) -> JitSite:
+        donate, has_kw = (self._donate_of(call) if call is not None
+                          else ((), False))
+        static = (self._static_of(call, target) if call is not None
+                  else frozenset())
+        site = JitSite(node, target, donate=donate, has_donate_kw=has_kw,
+                       assigned_name=assigned_name, static_names=static)
+        if target is not None and static:
+            prev = self.static_params.setdefault(id(target), set())
+            prev |= static
+        return site
+
+    @staticmethod
+    def _donate_of(call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                if kw.arg == "donate_argnames":
+                    return None, True  # names not modeled; presence counts
+                return _const_int_tuple(kw.value), True
+        return (), False
+
+    @staticmethod
+    def _static_of(call: ast.Call, target) -> frozenset:
+        """Parameter names the jit treats as compile-time constants —
+        host-sync and donation checks must not treat them as traced."""
+        names: set = set()
+        params = (_params_of(target)
+                  if isinstance(target, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) else [])
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                elts = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                        else [v])
+                for e in elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str):
+                        names.add(e.value)
+            elif kw.arg == "static_argnums":
+                idxs = _const_int_tuple(kw.value) or ()
+                for i in idxs:
+                    if 0 <= i < len(params):
+                        names.add(params[i])
+        return frozenset(names)
+
+    def visit_Assign(self, node):
+        prev = self._assign_target
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            self._assign_target = node.targets[0].id
+        self.generic_visit(node)
+        self._assign_target = prev
+
+    def visit_Call(self, node):
+        tail = _callee_tail(node)
+        if _is_jax_jit(node.func) and node.args:
+            target = self._resolve_fn_arg(node.args[0])
+            self.jit_sites.append(self._make_site(
+                node, target, node, self._assign_target))
+        elif tail in _CALLBACK_CALLEES and node.args:
+            t = self._resolve_fn_arg(node.args[0])
+            if t is not None:
+                self.callback_targets.add(id(t))
+        elif tail in _TRACED_ARG_CALLEES:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                t = self._resolve_fn_arg(a)
+                if t is not None:
+                    self.traced_seeds.add(id(t))
+        self.generic_visit(node)
+
+    # -- traced-context resolution
+
+    def traced_defs(self) -> set:
+        """ids of defs whose bodies are traced: jit targets and
+        control-flow/combinator callees, plus everything lexically nested
+        in a traced def — minus host-callback targets."""
+        traced = set(self.traced_seeds)
+        for site in self.jit_sites:
+            if site.target is not None:
+                traced.add(id(site.target))
+        traced -= self.callback_targets
+        changed = True
+        while changed:
+            changed = False
+            for d in self.defs:
+                if id(d) in traced or id(d) in self.callback_targets:
+                    continue
+                p = self.parent_def.get(d)
+                if p is not None and id(p) in traced:
+                    traced.add(id(d))
+                    changed = True
+        return traced
+
+    def traced_params(self, node, traced: set) -> set:
+        """Parameter names of ``node`` and every TRACED enclosing def —
+        the first-order 'this value is traced here' name set.  The walk
+        stops at the first non-traced ancestor: a host-side builder's
+        params (mesh, params, flags) are trace-time constants, and
+        ``float(params.lam)`` in a kernel it builds is legal."""
+        names: set = set()
+        d = node
+        while d is not None:
+            a = d.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                names.add(arg.arg)
+            names -= self.static_params.get(id(d), set())
+            d = self.parent_def.get(d)
+            if d is not None and id(d) not in traced:
+                break
+        return names
+
+
+def _params_of(fn) -> list:
+    a = fn.args
+    return [arg.arg for arg in a.posonlyargs + a.args]
+
+
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "sharding"}
+
+
+def _mentions(expr: ast.AST, names: set) -> bool:
+    """Whether ``expr`` reads a traced VALUE from ``names`` — mentions
+    under static metadata attributes (``x.shape``, ``x.dtype``, ...) are
+    trace-time Python and don't count."""
+    def walk(node):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Name) and node.id in names:
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return walk(expr)
+
+
+def _nearest_def(node, parents) -> Optional[ast.AST]:
+    p = parents.get(node)
+    while p is not None and not isinstance(
+            p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        p = parents.get(p)
+    return p
+
+
+def _build_parents(tree) -> dict:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+# --- rule: donation ---------------------------------------------------------
+
+
+def _at_update_root(expr: ast.AST) -> Optional[str]:
+    """The name X when ``expr`` is an ``X.at[...].meth(...)`` chain (with
+    any number of trailing method calls), else None."""
+    node = expr
+    while True:
+        if isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Attribute):
+            if node.attr == "at" and isinstance(node.value, ast.Name):
+                return node.value.id
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            return None
+
+
+def check_donation(src: SourceFile, index: ModuleIndex) -> list:
+    findings = []
+    in_solvers = "/solvers/" in f"/{src.path}"
+
+    for site in index.jit_sites:
+        fn = site.target
+        loc = fn if fn is not None else site.node
+        if fn is None:
+            continue
+        params = _params_of(fn) if not isinstance(fn, ast.Lambda) else \
+            [a.arg for a in fn.args.args]
+        name = site.assigned_name or getattr(fn, "name", None) or "<lambda>"
+        if (in_solvers and not site.has_donate_kw
+                and _STEP_NAME_RE.match(name or "")):
+            findings.append(Finding(
+                rule="donation", severity="error", path=src.path,
+                line=loc.lineno, col=loc.col_offset,
+                message=(
+                    f"jit step `{name}` in solvers/ donates nothing — "
+                    f"loop-carried solver state in the drive* ladder must "
+                    f"ride donate_argnums (every round otherwise pays a "
+                    f"full-state copy in HBM)")))
+        if site.donate:
+            for idx in site.donate:
+                if idx >= len(params) or idx < 0:
+                    findings.append(Finding(
+                        rule="donation", severity="error", path=src.path,
+                        line=loc.lineno, col=loc.col_offset,
+                        message=(
+                            f"donate_argnums index {idx} is out of range "
+                            f"for `{name}` ({len(params)} positional "
+                            f"args) — donation silently misses")))
+                    continue
+                pname = params[idx]
+                body = fn.body if isinstance(fn.body, list) else [fn.body]
+                used = sum(
+                    1 for stmt in body for n in ast.walk(stmt)
+                    if isinstance(n, ast.Name) and n.id == pname)
+                if used == 0:
+                    findings.append(Finding(
+                        rule="donation", severity="error", path=src.path,
+                        line=loc.lineno, col=loc.col_offset,
+                        message=(
+                            f"`{name}` donates arg {idx} (`{pname}`) but "
+                            f"never reads it — the donated buffer cannot "
+                            f"be the one the output aliases, so the "
+                            f"donation is a no-op")))
+
+    # the PR-2 shape, anywhere traced: X.at[...].op(...) ± X forces XLA to
+    # keep old and new X live at once — the output cannot alias the input
+    # buffer, so donation silently degrades to a full copy
+    traced = index.traced_defs()
+    parents = _build_parents(src.tree)
+    for d in index.defs:
+        if id(d) not in traced:
+            continue
+        pnames = index.traced_params(d, traced)
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                nd = _nearest_def(node, parents)
+                if nd is not d:
+                    continue
+                if not isinstance(node, ast.BinOp) or not isinstance(
+                        node.op, (ast.Add, ast.Sub)):
+                    continue
+                for a, b in ((node.left, node.right),
+                             (node.right, node.left)):
+                    x = _at_update_root(a)
+                    if x is not None and x in pnames and _mentions(
+                            b, {x}):
+                        findings.append(Finding(
+                            rule="donation", severity="error",
+                            path=src.path, line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"`{x}.at[...] ± {x}` keeps both the old "
+                                f"and new `{x}` live — donation of the "
+                                f"buffer silently becomes a full copy "
+                                f"(the PR-2 α bug shape); scatter the "
+                                f"delta into `jnp.zeros_like({x})` "
+                                f"instead")))
+                        break
+    return findings
+
+
+# --- rule: host-sync --------------------------------------------------------
+
+_SYNC_METHODS = {"item", "tolist"}
+
+
+def check_host_sync(src: SourceFile, index: ModuleIndex) -> list:
+    findings = []
+    traced = index.traced_defs()
+    parents = _build_parents(src.tree)
+
+    def flag(node, msg, severity="error"):
+        findings.append(Finding(
+            rule="host-sync", severity=severity, path=src.path,
+            line=node.lineno, col=node.col_offset, message=msg))
+
+    for d in index.defs:
+        if id(d) not in traced:
+            continue
+        pnames = index.traced_params(d, traced)
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if _nearest_def(node, parents) is not d:
+                    continue  # nested defs are visited as themselves
+                if isinstance(node, ast.Call):
+                    chain = _attr_chain(node.func) or ""
+                    tail = chain.rsplit(".", 1)[-1]
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in _SYNC_METHODS:
+                        flag(node,
+                             f"`.{node.func.attr}()` inside traced code is "
+                             f"a device→host sync per call — fetch once on "
+                             f"the host after the dispatch, or route "
+                             f"through the io_callback tap")
+                    elif chain in ("jax.device_get", "device_get"):
+                        flag(node,
+                             "`jax.device_get` inside traced code syncs "
+                             "the device every call — hoist the fetch to "
+                             "the driver")
+                    elif tail in ("asarray", "array") and \
+                            chain.split(".")[0] in _NP_MODULES and \
+                            any(_mentions(a, pnames) for a in node.args):
+                        flag(node,
+                             f"`{chain}` of a traced value materializes it "
+                             f"on the host (silent sync + recompile "
+                             f"hazard) — use jnp, or fetch after the "
+                             f"dispatch")
+                    elif isinstance(node.func, ast.Name) and \
+                            node.func.id in ("float", "int", "bool") and \
+                            node.args and _mentions(node.args[0], pnames):
+                        flag(node,
+                             f"`{node.func.id}()` of a traced value blocks "
+                             f"on the device (one ~100ms round-trip per "
+                             f"call through a tunneled TPU) — keep it as "
+                             f"an array, or fetch once after the dispatch")
+                    elif isinstance(node.func, ast.Name) and \
+                            node.func.id == "print" and \
+                            any(_mentions(a, pnames) for a in node.args):
+                        flag(node,
+                             "`print` of a traced value syncs and runs "
+                             "only at trace time — use jax.debug.print "
+                             "or the telemetry event stream",
+                             severity="warning")
+                elif isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    if isinstance(test, ast.UnaryOp) and isinstance(
+                            test.op, ast.Not):
+                        test = test.operand
+                    if isinstance(test, ast.Name) and test.id in pnames:
+                        flag(node,
+                             f"`if {test.id}:` on a traced value is an "
+                             f"implicit bool() sync (TracerBoolConversion "
+                             f"at best, a silent host round-trip at "
+                             f"worst) — use lax.cond/jnp.where")
+    return findings
+
+
+# --- rule: f64 --------------------------------------------------------------
+
+# float64 is policy-legal only here (DESIGN.md §6): exact certificate
+# arithmetic and the parity tests.  tests/ is outside the scan surface.
+_F64_ALLOWED_PREFIXES = ("cocoa_tpu/evals/",)
+
+
+def check_f64(src: SourceFile, index: ModuleIndex) -> list:
+    if src.path.startswith(_F64_ALLOWED_PREFIXES):
+        return []
+    findings = []
+
+    def flag(node, what):
+        findings.append(Finding(
+            rule="f64", severity="error", path=src.path, line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"{what} — repo numerics policy keeps float64 in parity "
+                f"tests and evals/ certificate math only (DESIGN.md §6); "
+                f"fix the dtype or add a justified `jaxlint: allow=f64`")))
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            root = _attr_chain(node)
+            if root:
+                flag(node, f"`{root}`")
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func) or ""
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if chain.endswith("config.update") and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value == "jax_enable_x64":
+                flag(node, "`jax_enable_x64` flipped at runtime")
+            elif any(isinstance(a, ast.Constant) and a.value == "float64"
+                     for a in args):
+                flag(node, '"float64" dtype argument')
+    return findings
+
+
+# --- rule: mesh-api ---------------------------------------------------------
+
+# API surface absent on the pinned jax 0.4.37 -> supported replacement.
+# These sites are the tier-1 suite's standing 40 fails + 14 errors and
+# the ROADMAP item 4 refactor worklist.
+_MESH_ATTRS = {
+    "jax.shard_map": (
+        "jax.experimental.shard_map.shard_map on jax<0.5 — route through "
+        "a versioned adapter (parallel/compat) so both jaxes pass"),
+    "lax.pcast": (
+        "no pre-0.5 equivalent (VMA types arrived with the new "
+        "shard_map) — the adapter must fall back to lax.pvary or a "
+        "no-op cast"),
+    "jax.lax.pcast": (
+        "no pre-0.5 equivalent — see lax.pcast"),
+}
+
+_MESH_FALLBACK_ATTRS = {
+    # present in the tree as the 'older jax' branch of a hasattr guard,
+    # but itself absent on 0.4.37 — the guard still lands on a missing API
+    "lax.pvary": (
+        "absent on jax 0.4.37 too — the <0.5 branch must drop the VMA "
+        "cast entirely (plain identity) under the adapter"),
+    "jax.lax.pvary": ("absent on jax 0.4.37 — see lax.pvary"),
+}
+
+
+def check_mesh_api(src: SourceFile, index: ModuleIndex) -> list:
+    findings = []
+    seen_lines = set()
+
+    def flag(node, api, replacement):
+        key = (node.lineno, api)
+        if key in seen_lines:
+            return
+        seen_lines.add(key)
+        findings.append(Finding(
+            rule="mesh-api", severity="inventory", path=src.path,
+            line=node.lineno, col=node.col_offset,
+            message=(f"`{api}` does not exist on the pinned jax 0.4.37 "
+                     f"(the mesh-suite failure class)"),
+            replacement=replacement))
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Attribute):
+            chain = _attr_chain(node)
+            if chain in _MESH_ATTRS:
+                flag(node, chain, _MESH_ATTRS[chain])
+            elif chain in _MESH_FALLBACK_ATTRS:
+                flag(node, chain, _MESH_FALLBACK_ATTRS[chain])
+            elif chain and chain.startswith("AxisType."):
+                flag(node, f"jax.sharding.{chain.split('.')[0]}",
+                     "unavailable before jax 0.5 — gate fp meshes (as "
+                     "mesh.py does) or build the Mesh from a device "
+                     "ndarray without axis_types")
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func) or ""
+            if chain in ("jax.make_mesh",) and any(
+                    kw.arg == "axis_types" for kw in node.keywords):
+                flag(node, "jax.make_mesh(axis_types=...)",
+                     "axis_types lands in jax 0.5 — construct "
+                     "jax.sharding.Mesh(np.array(devices).reshape(...), "
+                     "axis_names) for the <0.5 branch")
+    return findings
+
+
+# --- rule: pallas-budget (AST half) ----------------------------------------
+
+
+def check_pallas_budget_ast(src: SourceFile, index: ModuleIndex,
+                            all_sources: dict) -> list:
+    """Every ``pl.pallas_call`` module must declare a VMEM budget constant
+    and a ``*_fits`` gate; every gate must be consulted outside its own
+    module.  The numeric half (estimates vs budgets vs physical caps)
+    lives in pallas_budget.py."""
+    calls = [n for n in ast.walk(src.tree)
+             if isinstance(n, ast.Call)
+             and (_attr_chain(n.func) or "").endswith("pallas_call")]
+    if not calls:
+        return []
+    findings = []
+    budget_names = set()
+    fits_names = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and "BUDGET" in t.id:
+                    budget_names.add(t.id)
+        elif isinstance(node, ast.FunctionDef) and (
+                # both gate spellings this repo uses: boolean *_fits
+                # gates, and pick_* sizers whose 0 return means "does
+                # not fit" (pallas_sdca's unroll/interleave pickers)
+                node.name.endswith("_fits") or node.name.startswith(
+                    "pick_")):
+            fits_names.add(node.name)
+    if not budget_names:
+        findings.append(Finding(
+            rule="pallas-budget", severity="error", path=src.path,
+            line=calls[0].lineno, col=calls[0].col_offset,
+            message=("module calls pl.pallas_call but declares no "
+                     "*_BUDGET constant — SMEM/VMEM overflows become "
+                     "runtime surprises instead of lint errors")))
+    if not fits_names:
+        findings.append(Finding(
+            rule="pallas-budget", severity="error", path=src.path,
+            line=calls[0].lineno, col=calls[0].col_offset,
+            message=("module calls pl.pallas_call but exposes no *_fits "
+                     "gate — dispatch cannot account the kernel's "
+                     "memory before committing to it")))
+    # gates must be consulted by the dispatch layer, not just declared
+    for gate in sorted(fits_names):
+        consulted = False
+        for other_path, other in all_sources.items():
+            if other_path == src.path:
+                continue
+            for n in ast.walk(other.tree):
+                if isinstance(n, ast.Name) and n.id == gate:
+                    consulted = True
+                    break
+                if isinstance(n, ast.Attribute) and n.attr == gate:
+                    consulted = True
+                    break
+            if consulted:
+                break
+        if not consulted:
+            gate_def = next(
+                n for n in ast.walk(src.tree)
+                if isinstance(n, ast.FunctionDef) and n.name == gate)
+            findings.append(Finding(
+                rule="pallas-budget", severity="warning", path=src.path,
+                line=gate_def.lineno, col=gate_def.col_offset,
+                message=(f"fits gate `{gate}` is never consulted outside "
+                         f"{os.path.basename(src.path)} — a gate the "
+                         f"dispatch does not call protects nothing")))
+    return findings
+
+
+# --- registry ---------------------------------------------------------------
+
+RULES = ("donation", "host-sync", "f64", "mesh-api", "pallas-budget")
+
+
+def run_static_rules(sources: dict) -> list:
+    """Run every AST rule over {path: SourceFile}; returns findings."""
+    findings = []
+    for path, src in sources.items():
+        index = ModuleIndex(src).index()
+        findings += check_donation(src, index)
+        findings += check_host_sync(src, index)
+        findings += check_f64(src, index)
+        findings += check_mesh_api(src, index)
+        findings += check_pallas_budget_ast(src, index, sources)
+    return findings

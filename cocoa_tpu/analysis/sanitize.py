@@ -1,0 +1,280 @@
+"""Dynamic sanitizers: the compile-once and no-silent-transfer invariants.
+
+The static rules (rules.py) catch host syncs and recompiles you can see
+in the source; this module catches the ones you can't — a shape that
+quietly retraces per super-block, a scalar read that blocks on the
+device inside the round loop — by wiring two runtime probes around the
+drive loops:
+
+- **compile watch** — every XLA compile is observable.  jax logs
+  ``Finished XLA compilation of jit(<name>) ...`` on the
+  ``jax._src.dispatch`` logger at DEBUG (independent of the
+  ``jax_log_compiles`` flag); :func:`watch_compiles` captures those
+  records, and :func:`install_compile_events` bridges them onto the
+  telemetry bus as typed ``compile`` events for the production
+  ``--metrics`` counters.  The invariant the tests pin: the device loop
+  executable compiles exactly ONCE per config — a second identical run
+  compiles nothing.
+- **transfer guard** — :func:`sanitizer(strict="all")` arms the
+  device-loop contract: inside each dispatch→fetch region (which the
+  driver marks via :func:`device_loop_guard`) jax's transfer guards
+  disallow EVERY host↔device crossing on the driving thread, so any
+  un-sanctioned sync raises at its exact line; the drivers mark their
+  deliberate fetch points with :func:`intended_fetch`, which re-allows
+  the transfer, counts it, and emits a ``host_transfer`` event when
+  telemetry is active.  The invariant: zero unintended device→host
+  transfers inside the round loop, telemetry-on and -off.  (On CPU,
+  whole-array device→host reads are zero-copy and unguarded, but the
+  host→device half of an accidental ``float(x[i])`` — the index-constant
+  upload — still trips, so the CPU fixtures are a real gate and the TPU
+  run of the same fixtures is strictly stricter, never looser.)
+
+Both probes are observational: neither changes what the run computes,
+and ``intended_fetch`` costs one context-manager enter per super-block
+fetch — nothing rides the per-round path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import re
+import threading
+
+_DISPATCH_LOGGER = "jax._src.dispatch"
+_COMPILE_RE = re.compile(
+    r"Finished XLA compilation of (?:jit\(|pmap\()?([^)]+?)\)? in "
+    r"([0-9.eE+-]+) sec")
+
+# process-lifetime count of sanctioned device→host fetches (the
+# production mirror of what a sanitizer context observes per run)
+_counters_lock = threading.Lock()
+intended_fetches_total = 0
+
+
+@dataclasses.dataclass
+class CompileRecord:
+    name: str
+    seconds: float
+
+
+class _CompileLogWatch(logging.Handler):
+    """Capture per-executable compile records off the dispatch logger."""
+
+    def __init__(self, sink):
+        super().__init__(level=logging.DEBUG)
+        self._sink = sink
+
+    def emit(self, record):
+        try:
+            m = _COMPILE_RE.search(record.getMessage())
+        except Exception:   # never let logging break the run
+            return
+        if m:
+            self._sink(CompileRecord(name=m.group(1),
+                                     seconds=float(m.group(2))))
+
+
+def _mute_passthrough_handlers() -> list:
+    """jax installs a NOTSET StreamHandler on its root logger; once we
+    lower the dispatch logger to DEBUG, that handler would echo every
+    compile record to stderr.  Raise NOTSET handlers to WARNING (their
+    de-facto threshold under default levels — observable behavior is
+    unchanged, including ``jax_log_compiles``' WARNING-level lines) and
+    return an undo list."""
+    undo = []
+    for h in logging.getLogger("jax").handlers:
+        if h.level == logging.NOTSET:
+            h.setLevel(logging.WARNING)
+            undo.append(h)
+    return undo
+
+
+@contextlib.contextmanager
+def watch_compiles():
+    """Yield a list that accumulates one :class:`CompileRecord` per XLA
+    compile finishing while the context is open.  Lowers the dispatch
+    logger to DEBUG for the duration (console output is unchanged — see
+    :func:`_mute_passthrough_handlers`)."""
+    records: list = []
+    handler = _CompileLogWatch(records.append)
+    logger = logging.getLogger(_DISPATCH_LOGGER)
+    prev_level = logger.level
+    muted = _mute_passthrough_handlers()
+    logger.addHandler(handler)
+    if logger.getEffectiveLevel() > logging.DEBUG:
+        logger.setLevel(logging.DEBUG)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+        if _BUS_BRIDGE is None:
+            logger.setLevel(prev_level)
+            for h in muted:
+                h.setLevel(logging.NOTSET)
+        # else: the process-lifetime compile→event bridge (installed
+        # while this watch was open, or before it) needs the DEBUG level
+        # and the muted passthroughs to keep counting — leave them
+
+
+_BUS_BRIDGE = None
+
+
+def install_compile_events(bus) -> None:
+    """Bridge compile records onto the telemetry bus as ``compile``
+    events (idempotent; installed by ``EventBus.configure`` so any run
+    with ``--metrics``/``--events`` gets ``compiles_total`` for free).
+    The handler stays attached for the process lifetime — ``emit`` on an
+    inactive bus is a no-op, so there is no tax once sinks detach.
+
+    Known tradeoff: the dispatch logger stays at DEBUG from here on, so
+    an application that attached its own DEBUG-level root handler will
+    start seeing jax dispatch debug lines once telemetry was enabled
+    (the default root handler drops them; ``jax_log_compiles`` output is
+    unaffected)."""
+    global _BUS_BRIDGE
+    if _BUS_BRIDGE is not None:
+        return
+
+    def sink(rec: CompileRecord):
+        bus.emit("compile", name=rec.name, seconds=rec.seconds)
+
+    handler = _CompileLogWatch(sink)
+    logger = logging.getLogger(_DISPATCH_LOGGER)
+    _mute_passthrough_handlers()
+    logger.addHandler(handler)
+    if logger.getEffectiveLevel() > logging.DEBUG:
+        logger.setLevel(logging.DEBUG)
+    _BUS_BRIDGE = handler
+
+
+@contextlib.contextmanager
+def intended_fetch(label: str):
+    """Mark a deliberate device→host sync point (the driver's one fetch
+    per super-block, the eval fetch on host-stepped paths).  Inside a
+    :func:`no_host_transfers` guard this is the ONLY way data may cross
+    device→host; each use is counted and — when telemetry is active —
+    emitted as a ``host_transfer`` event so production runs expose
+    ``host_transfers_total``."""
+    import jax
+
+    from cocoa_tpu.telemetry import events as _tele
+
+    global intended_fetches_total
+    # allow every guard axis: the fetch itself is d2h, but decoding it
+    # (scalar indexing) can upload index constants — all sanctioned here
+    with jax.transfer_guard("allow"):
+        yield
+    with _counters_lock:
+        intended_fetches_total += 1
+    bus = _tele.get_bus()
+    if bus.active():
+        bus.emit("host_transfer", label=label)
+
+
+@contextlib.contextmanager
+def allow_transfers():
+    """Plain un-counted allow — for runtime machinery of sanctioned
+    paths (the ordered io_callback's zero-byte effect-token handshake at
+    dispatch), which is neither a host fetch nor a leak."""
+    import jax
+
+    with jax.transfer_guard("allow"):
+        yield
+
+
+@contextlib.contextmanager
+def no_host_transfers():
+    """Disallow device→host transfers except through
+    :func:`intended_fetch` — an unintended sync raises XlaRuntimeError
+    at the exact offending line (thread-local, so the io_callback
+    telemetry tap's rows, which arrive on the runtime's callback thread,
+    stay unaffected — that path is sanctioned by design)."""
+    import jax
+
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+_tls = threading.local()
+
+
+def device_loop_guard():
+    """The guard the device-resident driver wraps its dispatch→fetch
+    region in (solvers/base.py ``drive_on_device``).  Inert (a
+    nullcontext) unless a :func:`sanitizer` with ``strict="all"`` is
+    active on this thread: solver SETUP legitimately uploads (state
+    init, shard placement, index staging), so the no-transfer contract
+    starts where the loop does — after the last staged argument, ending
+    at the sanctioned fetch."""
+    if getattr(_tls, "arm_device_loop", False):
+        return no_transfers()
+    return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def _arm_device_loop():
+    prev = getattr(_tls, "arm_device_loop", False)
+    _tls.arm_device_loop = True
+    try:
+        yield
+    finally:
+        _tls.arm_device_loop = prev
+
+
+@contextlib.contextmanager
+def no_transfers():
+    """Disallow transfers on EVERY guard axis except through
+    :func:`intended_fetch`.  This is the device-loop contract: once the
+    dispatch is in flight, nothing crosses the host↔device boundary on
+    the driving thread until the sanctioned fetch — no index-constant
+    uploads from stray scalar reads, no implicit device math on host
+    values.  (It is also what gives the sanitizer teeth on CPU, where
+    array device→host reads are zero-copy and unguarded but the
+    host→device half of an accidental ``float(x[i])`` still trips.)
+    Host-side staging that legitimately uploads (the index-table
+    prefetch) runs on its own daemon thread, which the thread-local
+    guard deliberately does not cover."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@dataclasses.dataclass
+class SanitizerStats:
+    compiles: list                  # CompileRecord per XLA compile
+    fetches_before: int = 0
+
+    def compile_count(self, name_substr: str = "") -> int:
+        return sum(1 for c in self.compiles if name_substr in c.name)
+
+    @property
+    def intended_fetches(self) -> int:
+        return intended_fetches_total - self.fetches_before
+
+
+@contextlib.contextmanager
+def sanitizer(strict="all"):
+    """The combined harness the sanitizer fixtures run drive loops
+    under: compile watch + a transfer guard.  ``strict="all"`` arms the
+    device-loop contract — inside each dispatch→fetch region (marked by
+    the driver via :func:`device_loop_guard`) NOTHING crosses
+    host↔device outside :func:`intended_fetch`; solver setup/staging
+    outside the loop is unconstrained.  ``"d2h"`` disallows device→host
+    reads across the whole context instead (host-stepped paths, which
+    legitimately upload index tables from the driving thread each
+    chunk).  ``False`` = compile watch only.  Yields
+    :class:`SanitizerStats`; an unintended transfer raises from the
+    guarded code itself, so "zero unintended transfers" is simply "the
+    run completed"."""
+    with contextlib.ExitStack() as stack:
+        records = stack.enter_context(watch_compiles())
+        stats = SanitizerStats(compiles=records,
+                               fetches_before=intended_fetches_total)
+        if strict in (True, "all"):
+            stack.enter_context(_arm_device_loop())
+        elif strict == "d2h":
+            stack.enter_context(no_host_transfers())
+        yield stats

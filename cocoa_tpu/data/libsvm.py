@@ -65,6 +65,8 @@ class LibsvmData:
         lo, hi = self.indptr[i], self.indptr[i + 1]
         return self.indices[lo:hi], self.values[lo:hi]
 
+    # jaxlint: allow=f64 -- host-side densify for tests/oracles; callers
+    # pass the compute dtype for device-bound arrays
     def to_dense(self, dtype=np.float64) -> np.ndarray:
         """(n, d) dense matrix."""
         out = np.zeros((self.n, self.num_features), dtype=dtype)

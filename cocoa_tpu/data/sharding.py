@@ -84,6 +84,8 @@ def segment_sq_norms(values, ptr) -> np.ndarray:
     if nseg <= 0:
         return np.zeros(0)
     sq = np.empty(len(values) + 1)
+    # jaxlint: allow=f64 -- exact host-side ‖x‖² accounting; the kernels
+    # consume the result cast to the compute dtype
     np.square(np.asarray(values, np.float64), out=sq[:-1])
     sq[-1] = 0.0
     out = np.add.reduceat(sq, np.asarray(ptr[:-1], dtype=np.intp))

@@ -153,16 +153,22 @@ def evaluate(ds: ShardedDataset, w, alpha, lam, test_ds=None,
     ``alpha=None`` for primal-only solvers → gap is None."""
     import numpy as np
 
+    from cocoa_tpu.analysis import sanitize
+
     f = _eval_metrics_fn(
         mesh_of(ds.labels), float(lam), ds.n,
         test_ds.n if test_ds is not None else 0,
         loss, float(smoothing),
     )
-    out = np.asarray(f(
+    out = f(
         w, alpha, ds.shard_arrays(),
         None if test_ds is None else test_ds.shard_arrays(),
-    ))
-    primal, gap, test_err = (float(v) for v in out)
+    )
+    # the one sanctioned device→host fetch of the host-stepped eval
+    # cadence (the transfer-guard sanitizer disallows any other)
+    with sanitize.intended_fetch("eval_fetch"):
+        out = np.asarray(out)
+        primal, gap, test_err = (float(v) for v in out)
     return (
         primal,
         None if np.isnan(gap) else gap,

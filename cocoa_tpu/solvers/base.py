@@ -652,6 +652,8 @@ def drive_on_device(
     # so mesh runs use the fetch replay below).  Where ordered callbacks
     # are unsupported, the SAME tap replays the fetched buffer — identical
     # events, emitted at the end-of-run sync instead of live.
+    from cocoa_tpu.analysis import sanitize as _sanitize
+
     bus = _tele.get_bus()
     emit = bus.active()
     stream = emit and mesh is None and _tele.io_callback_supported()
@@ -660,7 +662,11 @@ def drive_on_device(
         # seed backoff detection with the stage this dispatch ENTERS at
         # (the sched leaf rides super-block boundaries), so a resumed or
         # later-block run never fabricates a backoff on its first eval
-        init_stage = (int(np.asarray(state[-1])[0]) if anneal else None)
+        if anneal:
+            with _sanitize.intended_fetch("sched_stage"):
+                init_stage = int(np.asarray(state[-1])[0])
+        else:
+            init_stage = None
         tap = _tele.DeviceTap(bus, name, start_round, c,
                               sigma_levels if anneal else None,
                               init_stage=init_stage)
@@ -676,12 +682,30 @@ def drive_on_device(
         if run_key is not None:
             _DEVICE_RUNS[run_key] = run
 
-    with _tele.device_tap(tap if stream else None):
-        i, done_tgt, done_stall, state, traj_buf = run(
-            *state, idxs_all, shard_arrays, test_arrays)
-        # the single host sync of the whole run
-        n_done = int(i)
-        traj_host = np.asarray(traj_buf[:n_done])
+    # the sanitizer's device-loop contract (analysis/sanitize.py): from
+    # dispatch to the sanctioned fetch, nothing crosses host↔device on
+    # this thread.  Inert unless a strict sanitizer armed it.  The one
+    # exception is the streaming dispatch itself: the ordered
+    # io_callback's zero-byte effect token rides h2d with the args —
+    # sanctioned tap machinery, not a leak.
+    import contextlib as _ctx
+
+    with _sanitize.device_loop_guard(), \
+            _tele.device_tap(tap if stream else None):
+        with (_sanitize.allow_transfers() if stream
+              else _ctx.nullcontext()):
+            i, done_tgt, done_stall, state, traj_buf = run(
+                *state, idxs_all, shard_arrays, test_arrays)
+        # the single host sync of the whole run — marked as the
+        # sanctioned fetch point, so the transfer-guard sanitizer
+        # (analysis/sanitize.py) can disallow every OTHER device→host
+        # path and production --metrics runs count it
+        # (host_transfers_total: ~1 per super-block, never per round)
+        with _sanitize.intended_fetch("device_loop_fetch"):
+            n_done = int(i)
+            stop_tgt = bool(done_tgt)
+            stop_stall = bool(done_stall)
+            traj_host = np.asarray(traj_buf[:n_done])
         if stream:
             # join the callback stream before leaving the tap context —
             # the fetch orders the computation, not the host callbacks
@@ -722,9 +746,9 @@ def drive_on_device(
     # n_done < n_chunks, which misses a guard fire on the FINAL chunk —
     # ADVICE r5): the while_loop carried exactly why it stopped
     if tgt is not None:
-        if bool(done_stall):
+        if stop_stall:
             traj.stopped = "diverged"   # caller reports (with the round)
-        elif bool(done_tgt):
+        elif stop_tgt:
             traj.stopped = "target"
     return state, traj
 

@@ -42,6 +42,9 @@ EVENT_TYPES = (
     "restart",          # sigma=auto trial rerun, or an elastic gang restart
     "divergence",       # the stall watch bailed the run out
     "run_end",          # final summary (primal, gap, stopped reason)
+    "compile",          # one finished XLA compile (analysis/sanitize.py
+                        # bridge) — the compile-once invariant, observable
+    "host_transfer",    # one sanctioned device→host fetch (intended_fetch)
 )
 
 
@@ -81,7 +84,10 @@ class EventBus:
 
     def configure(self, jsonl_path=None, metrics_path=None):
         """Attach sinks; either may be None.  The metrics path attaches a
-        :class:`cocoa_tpu.telemetry.metrics.MetricsWriter` subscriber."""
+        :class:`cocoa_tpu.telemetry.metrics.MetricsWriter` subscriber.
+        Any active sink also installs the compile→event bridge, so
+        ``compiles_total``/``compile`` events come for free on telemetry
+        runs (the sanitizer invariants, observable in production)."""
         with self._lock:
             self.jsonl_path = jsonl_path or None
             if metrics_path and metrics_path != self.metrics_path:
@@ -89,6 +95,10 @@ class EventBus:
 
                 self.subscribe(MetricsWriter(metrics_path))
                 self.metrics_path = metrics_path
+        if self.active():
+            from cocoa_tpu.analysis import sanitize
+
+            sanitize.install_compile_events(self)
         return self
 
     def active(self) -> bool:
@@ -282,6 +292,8 @@ class DeviceTap:
         self.count = 0
 
     def __call__(self, i, row):
+        # jaxlint: allow=f64 -- host-side decode of an already-fetched f32
+        # row; never enters device compute
         r = np.asarray(row, dtype=np.float64)
         t = self.start_round - 1 + (int(i) + 1) * self.cadence
         primal, gap, test_err, stage_f, stall = (float(v) for v in r[:5])

@@ -10,6 +10,13 @@ run that is otherwise one opaque device dispatch:
 - ``cocoa_evals_total``         counter — debugIter-cadence evaluations
 - ``cocoa_sigma_backoffs_total``counter — σ′ anneal backoffs
 - ``cocoa_restarts_total``      counter — trial reruns + gang restarts
+- ``cocoa_compiles_total``      counter — finished XLA compiles (the
+  analysis/sanitize.py bridge).  The sanitizer invariant made
+  observable: after warmup this must flatline — growth mid-run means a
+  shape or config is silently retracing every super-block
+- ``cocoa_host_transfers_total``counter — sanctioned device→host fetch
+  points (``intended_fetch``).  The drive loop's contract is ~1 per
+  super-block; per-ROUND growth means a host sync leaked into the loop
 - ``cocoa_last_gap``            gauge   — most recent duality gap
 - ``cocoa_round_seconds``       histogram — observed per-round wall time
   (host-clock deltas between consecutive evals divided by the rounds
@@ -36,6 +43,8 @@ class MetricsWriter:
         self.evals_total = 0
         self.sigma_backoffs_total = 0
         self.restarts_total = 0
+        self.compiles_total = 0
+        self.host_transfers_total = 0
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -82,6 +91,10 @@ class MetricsWriter:
             self.sigma_backoffs_total += 1
         elif ev == "restart":
             self.restarts_total += 1
+        elif ev == "compile":
+            self.compiles_total += 1
+        elif ev == "host_transfer":
+            self.host_transfers_total += 1
         self.write()
 
     def render(self) -> str:
@@ -94,6 +107,10 @@ class MetricsWriter:
             f"cocoa_sigma_backoffs_total {self.sigma_backoffs_total}",
             "# TYPE cocoa_restarts_total counter",
             f"cocoa_restarts_total {self.restarts_total}",
+            "# TYPE cocoa_compiles_total counter",
+            f"cocoa_compiles_total {self.compiles_total}",
+            "# TYPE cocoa_host_transfers_total counter",
+            f"cocoa_host_transfers_total {self.host_transfers_total}",
         ]
         if self.last_gap is not None:
             lines += ["# TYPE cocoa_last_gap gauge",

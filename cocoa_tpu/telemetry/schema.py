@@ -1,7 +1,7 @@
 """JSONL schema checker for the telemetry artifacts.
 
 One dependency-free validator shared by tests/test_telemetry.py and the CI
-telemetry step, covering the three JSONL dialects this repo emits:
+telemetry step, covering the four JSONL dialects this repo emits:
 
 - **event streams** (``--events``, telemetry/events.py): every line has
   ``event``/``seq``/``ts``, per-type required fields, and ``seq`` is
@@ -12,6 +12,9 @@ telemetry step, covering the three JSONL dialects this repo emits:
   on the final record;
 - **benchmark results** (benchmarks/results.jsonl): one config row per
   line.
+- **analysis reports** (``python -m cocoa_tpu.analysis --report=...``):
+  an ``analysis_manifest`` header plus one finding per line, unique
+  fingerprints (what the jaxlint baseline keys on).
 
 Usage: ``python -m cocoa_tpu.telemetry.schema FILE...`` — the dialect is
 sniffed per file from its first line; exit code 1 on any violation.
@@ -51,6 +54,11 @@ EVENT_FIELDS = {
     "restart": {"reason": (str,)},
     "divergence": {"algorithm": (str,), "t": (int,), "n_evals": (int,)},
     "run_end": {"algorithm": (str,), "stopped": (str, type(None))},
+    # the sanitizer bridge (analysis/sanitize.py): one per finished XLA
+    # compile / per sanctioned device→host fetch — what feeds the
+    # cocoa_compiles_total / cocoa_host_transfers_total counters
+    "compile": {"name": (str,), "seconds": _NUM},
+    "host_transfer": {"label": (str,)},
 }
 
 TRAJ_RECORD_FIELDS = {
@@ -62,6 +70,21 @@ TRAJ_RECORD_FIELDS = {
     "test_error": _OPT_NUM,
     "sigma": _OPT_NUM,
 }
+
+# jaxlint JSONL reports (python -m cocoa_tpu.analysis --report=...):
+# one analysis_manifest header line, then one line per finding
+ANALYSIS_FINDING_FIELDS = {
+    "rule": (str,),
+    "severity": (str,),
+    "path": (str,),
+    "line": (int,),
+    "col": (int,),
+    "message": (str,),
+    "fingerprint": (str,),
+}
+
+ANALYSIS_SEVERITIES = ("error", "warning", "inventory")
+
 
 # benchmarks/results.jsonl: "config" identifies the row; every OTHER known
 # key is type-checked when present (rows carry different column subsets —
@@ -176,13 +199,51 @@ def check_results_lines(objs) -> list:
     return errors
 
 
+def check_analysis_lines(objs) -> list:
+    """Validate a jaxlint JSONL report: the manifest header, per-finding
+    required fields, legal severities, and fingerprint uniqueness (the
+    baseline keys on fingerprints — a collision would silently merge two
+    findings)."""
+    errors = []
+    if not objs:
+        return ["empty analysis report"]
+    ln0, head = objs[0]
+    man = head.get("analysis_manifest")
+    if not isinstance(man, dict):
+        errors.append(f"line {ln0}: first line must carry the "
+                      f"analysis_manifest header")
+    else:
+        for name in ("tool", "version", "files_scanned", "rules"):
+            if name not in man:
+                errors.append(f"line {ln0}: analysis_manifest missing "
+                              f"{name!r}")
+    seen = {}
+    for ln, obj in objs[1:]:
+        where = f"line {ln}"
+        _typecheck(obj, ANALYSIS_FINDING_FIELDS, where, errors)
+        sev = obj.get("severity")
+        if isinstance(sev, str) and sev not in ANALYSIS_SEVERITIES:
+            errors.append(f"{where}: severity {sev!r} not in "
+                          f"{ANALYSIS_SEVERITIES}")
+        fp = obj.get("fingerprint")
+        if isinstance(fp, str):
+            if fp in seen:
+                errors.append(f"{where}: fingerprint {fp} duplicates "
+                              f"line {seen[fp]}")
+            seen[fp] = ln
+    return errors
+
+
 def sniff(objs) -> str:
-    """Dialect from the first line: 'events' | 'trajectory' | 'results'."""
+    """Dialect from the first line:
+    'events' | 'trajectory' | 'results' | 'analysis'."""
     if not objs:
         return "events"
     head = objs[0][1]
     if "event" in head:
         return "events"
+    if "analysis_manifest" in head:
+        return "analysis"
     if "manifest" in head:
         return "trajectory"
     return "results"
@@ -190,7 +251,8 @@ def sniff(objs) -> str:
 
 _CHECKERS = {"events": check_event_lines,
              "trajectory": check_trajectory_lines,
-             "results": check_results_lines}
+             "results": check_results_lines,
+             "analysis": check_analysis_lines}
 
 
 def check_file(path: str, kind: str = "auto") -> list:

@@ -1,0 +1,568 @@
+"""jaxlint (cocoa_tpu/analysis): per-rule known-good/known-bad fixtures,
+the PR-2 donation-miss regression, the mesh-API inventory completeness
+contract, the baseline/suppression machinery, and the dynamic sanitizer
+smoke on the CPU drive loop (compile-once + zero unintended device→host
+transfers, telemetry-on and -off)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cocoa_tpu import analysis
+from cocoa_tpu.analysis import core, pallas_budget, rules, sanitize
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.solvers import run_cocoa
+from cocoa_tpu.telemetry import events as tele
+from cocoa_tpu.telemetry import schema
+
+K = 4
+
+
+# --- fixture-lint helper ----------------------------------------------------
+
+
+def lint(tmp_path, code, relpath="fixture.py", rule=None):
+    """Lint one source fixture; returns findings (optionally one rule's)."""
+    ab = tmp_path / relpath
+    ab.parent.mkdir(parents=True, exist_ok=True)
+    ab.write_text(code)
+    src = core.load_source(str(tmp_path), relpath)
+    assert src is not None, "fixture failed to parse"
+    sources = {src.path: src}
+    found = rules.run_static_rules(sources)
+    core.fingerprint_findings(found, sources)
+    core.apply_suppressions(found, sources)
+    if rule is not None:
+        found = [f for f in found if f.rule == rule]
+    return found
+
+
+# --- donation rule ----------------------------------------------------------
+
+PR2_SHAPE = """
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def round_step(w, alpha, idxs, delta):
+    # the PR-2 bug: the donated alpha is read both through .at and bare,
+    # so the output cannot alias the donated buffer -> silent full copy
+    da = alpha.at[idxs].add(delta) - alpha
+    return w + da.sum(), alpha + da
+"""
+
+PR2_FIXED = """
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def round_step(w, alpha, idxs, delta):
+    # the PR-2 fix shape: scatter (a0 + d) - a0 into zeros
+    da = jnp.zeros_like(alpha).at[idxs].add(delta)
+    return w + da.sum(), alpha + da
+"""
+
+PR2_NESTED = """
+import functools
+import jax
+from cocoa_tpu.solvers import base
+
+def make_round_step(mesh):
+    def per_shard(w, alpha_k, idxs_k):
+        delta = w[idxs_k]
+        return delta.sum(), alpha_k.at[idxs_k].add(delta) - alpha_k
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def round_step(w, alpha, idxs):
+        dw, alpha = base.fanout(per_shard, mesh, w, alpha, idxs)
+        return w + dw, alpha
+
+    return round_step
+"""
+
+
+def test_donation_pr2_regression_caught(tmp_path):
+    """The exact PR-2 α donation-miss shape is a lint error."""
+    found = lint(tmp_path, PR2_SHAPE, rule="donation")
+    assert len(found) == 1
+    assert "full copy" in found[0].message
+    assert "alpha" in found[0].message
+
+
+def test_donation_pr2_fixed_shape_clean(tmp_path):
+    assert lint(tmp_path, PR2_FIXED, rule="donation") == []
+
+
+def test_donation_pr2_nested_per_shard_caught(tmp_path):
+    """The shape as it actually occurred: inside a per_shard fn passed to
+    fanout, not lexically inside the jitted def."""
+    found = lint(tmp_path, PR2_NESTED, rule="donation")
+    assert len(found) == 1
+    assert "alpha_k" in found[0].message
+
+
+def test_donation_index_out_of_range(tmp_path):
+    code = """
+import jax
+
+def f(w):
+    return w * 2
+
+g = jax.jit(f, donate_argnums=(3,))
+"""
+    found = lint(tmp_path, code, rule="donation")
+    assert len(found) == 1
+    assert "out of range" in found[0].message
+
+
+def test_donation_unused_donated_arg(tmp_path):
+    code = """
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def f(w, alpha):
+    return w * 2
+"""
+    found = lint(tmp_path, code, rule="donation")
+    assert len(found) == 1
+    assert "never reads" in found[0].message
+
+
+def test_donation_step_in_solvers_must_donate(tmp_path):
+    code = """
+import jax
+
+def make_step():
+    def round_step(w, idxs):
+        return w + idxs.sum()
+    return jax.jit(round_step)
+"""
+    found = lint(tmp_path, code, relpath="cocoa_tpu/solvers/x.py",
+                 rule="donation")
+    assert len(found) == 1
+    assert "donates nothing" in found[0].message
+    # the same jit site outside solvers/ is not step-shaped policy
+    assert lint(tmp_path, code, relpath="cocoa_tpu/evalsx/x.py",
+                rule="donation") == []
+
+
+def test_donation_good_steps_clean(tmp_path):
+    code = """
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def round_step(w, idxs):
+    return w + idxs.sum()
+
+def make(kernel):
+    return jax.jit(kernel, donate_argnums=(0, 1))
+"""
+    assert lint(tmp_path, code, relpath="cocoa_tpu/solvers/x.py",
+                rule="donation") == []
+
+
+# --- host-sync rule ---------------------------------------------------------
+
+HOST_SYNC_BAD = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+@jax.jit
+def f(x):
+    v = float(x)                 # scalar sync
+    a = np.asarray(x)            # host materialization
+    return v + a.sum()
+
+@jax.jit
+def g(state):
+    def body(s):
+        return s + jnp.float32(s.item())   # sync per loop iteration
+    return lax.while_loop(lambda s: s < 3, body, state)
+
+@jax.jit
+def h(x):
+    if x:                        # implicit bool()
+        return x
+    return -x
+"""
+
+
+def test_host_sync_bad_shapes_caught(tmp_path):
+    found = lint(tmp_path, HOST_SYNC_BAD, rule="host-sync")
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 4, msgs
+    assert any("float()" in m for m in msgs)
+    assert any("asarray" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+    assert any("implicit bool" in m for m in msgs)
+
+
+HOST_SYNC_GOOD = """
+import functools
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import io_callback
+
+def tap(i, row):
+    # host side: the sanctioned io_callback target may sync freely
+    print(int(i), float(row[0]))
+
+@jax.jit
+def f(x):
+    def body(s):
+        io_callback(tap, None, s, x, ordered=True)
+        return s + 1
+    return lax.while_loop(lambda s: s < 3, body, jnp.int32(0))
+
+@functools.partial(jax.jit, static_argnames=("n", "lam"))
+def k(x, n, lam):
+    # static args are trace-time python: float()/if are legal
+    scale = float(lam * n)
+    if n > 4:
+        scale = scale * 2.0
+    return x * scale + float(x.shape[0])   # shape metadata is static
+"""
+
+
+def test_host_sync_sanctioned_shapes_clean(tmp_path):
+    assert lint(tmp_path, HOST_SYNC_GOOD, rule="host-sync") == []
+
+
+def test_host_sync_repo_drivers_clean():
+    """The production drivers/kernels carry no stray host syncs (what
+    PR 6's first full-tree run established; keep it true)."""
+    findings, _, _ = analysis.run_analysis(with_budget_checks=False)
+    bad = [f for f in findings if f.rule == "host-sync" and f.actionable]
+    assert bad == [], [f.location() for f in bad]
+
+
+# --- f64 rule ---------------------------------------------------------------
+
+
+def test_f64_leak_caught_outside_evals(tmp_path):
+    code = """
+import jax.numpy as jnp
+import numpy as np
+
+def f(x):
+    return jnp.asarray(x, dtype=jnp.float64)
+
+def g(x):
+    return x.astype("float64")
+"""
+    found = lint(tmp_path, code, relpath="cocoa_tpu/ops/x.py", rule="f64")
+    assert len(found) == 2
+    # the same code under evals/ is certificate math — allowed
+    assert lint(tmp_path, code, relpath="cocoa_tpu/evals/x.py",
+                rule="f64") == []
+
+
+def test_f64_inline_allow(tmp_path):
+    code = """
+import numpy as np
+
+def parse(tokens):
+    # jaxlint: allow=f64 -- host-side exact parse fixture
+    return np.asarray(tokens, dtype=np.float64)
+"""
+    found = lint(tmp_path, code, relpath="cocoa_tpu/data/x.py", rule="f64")
+    assert len(found) == 1
+    assert found[0].suppressed
+    assert "exact parse" in found[0].suppression_reason
+
+
+# --- mesh-api inventory -----------------------------------------------------
+
+
+def test_mesh_inventory_complete():
+    """The deprecated/unsupported mesh-API worklist (ROADMAP item 4) is
+    exactly the set jaxlint catalogues — every call site named, each with
+    a supported-API replacement.  If this fails after editing the mesh
+    layer, the refactor either migrated a site (update the count AND the
+    baseline) or introduced a new unsupported call (migrate it)."""
+    findings, _, _ = analysis.run_analysis(with_budget_checks=False)
+    inv = sorted((f.path, f.line, f.message.split("`")[1])
+                 for f in findings if f.rule == "mesh-api")
+    by_file = {}
+    for path, _, api in inv:
+        by_file.setdefault(path, []).append(api)
+    assert by_file == {
+        "cocoa_tpu/parallel/fanout.py": [
+            "lax.pcast", "lax.pvary", "jax.shard_map", "jax.shard_map"],
+        "cocoa_tpu/parallel/mesh.py": [
+            "jax.make_mesh(axis_types=...)", "jax.sharding.AxisType"],
+    }, inv
+    assert len(inv) == 6
+    # every inventory entry must carry its supported-API replacement
+    for f in findings:
+        if f.rule == "mesh-api":
+            assert f.replacement, f.location()
+
+
+# --- pallas-budget ----------------------------------------------------------
+
+
+def test_pallas_budget_missing_gate_caught(tmp_path):
+    code = """
+from jax.experimental import pallas as pl
+
+def kernel(ref, out):
+    out[...] = ref[...]
+
+def run(x):
+    return pl.pallas_call(kernel, out_shape=x)(x)
+"""
+    found = lint(tmp_path, code, relpath="cocoa_tpu/ops/x.py",
+                 rule="pallas-budget")
+    msgs = [f.message for f in found]
+    assert any("no *_BUDGET constant" in m for m in msgs)
+    assert any("no *_fits gate" in m for m in msgs)
+
+
+def test_pallas_budget_numeric_checks_clean():
+    """The shipped ops modules: budgets under the physical caps, gates
+    agreeing with their estimates over the dispatch-realistic sweep."""
+    assert pallas_budget.run_budget_checks() == []
+
+
+def test_pallas_budget_detects_gate_estimate_drift(monkeypatch):
+    """Widen the sparse estimate out from under its gate — the sweep must
+    notice (this is the 'overflow becomes a lint error' contract)."""
+    from cocoa_tpu.ops import pallas_sparse
+
+    # a gate that stops consulting its estimate (the drift shape: a new
+    # scratch buffer accounted in the estimate but not gated on)
+    monkeypatch.setattr(pallas_sparse, "sparse_kernel_fits",
+                        lambda *a, **k: True)
+    found = pallas_budget.check_gate_estimate_agreement()
+    assert any("exceeds VMEM_BUDGET" in f.message for f in found)
+
+
+# --- fingerprints / baseline / report --------------------------------------
+
+
+def test_fingerprints_survive_unrelated_edits(tmp_path):
+    found1 = lint(tmp_path, PR2_SHAPE, relpath="a.py")
+    shifted = PR2_SHAPE.replace(
+        "import functools", "# an unrelated comment\nimport functools")
+    found2 = lint(tmp_path, shifted, relpath="a.py")
+    fp1 = {f.fingerprint for f in found1}
+    fp2 = {f.fingerprint for f in found2}
+    assert fp1 == fp2 and fp1
+
+
+def test_baseline_roundtrip(tmp_path):
+    ab = tmp_path / "a.py"
+    ab.write_text(PR2_SHAPE)
+    src = core.load_source(str(tmp_path), "a.py")
+    sources = {src.path: src}
+    findings = rules.run_static_rules(sources)
+    core.fingerprint_findings(findings, sources)
+    bl_path = str(tmp_path / "baseline.json")
+    core.write_baseline(findings, bl_path)
+    bl = core.load_baseline(bl_path)
+    stale = core.apply_baseline(findings, bl)
+    assert stale == []
+    assert all(f.baselined and not f.actionable for f in findings)
+    # fixing the finding leaves a stale entry behind
+    stale2 = core.apply_baseline([], bl)
+    assert len(stale2) == len(bl)
+
+
+def test_scoped_run_keeps_out_of_scope_baseline(tmp_path):
+    """A targeted run (explicit path subset) must treat baseline entries
+    for unscanned files as out-of-scope — not stale — and a path-scoped
+    --update-baseline must carry them over untouched instead of wiping
+    the repo's justified baseline."""
+    findings, sources, stale = analysis.run_analysis(
+        targets=["cocoa_tpu/solvers"], with_budget_checks=False)
+    assert stale == [], [e["fingerprint"] for e in stale]
+    # path-scoped rewrite: out-of-scope entries survive verbatim
+    before = core.load_baseline()
+    assert before, "repo baseline expected to be non-empty"
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"entries": list(before.values())}))
+    core.write_baseline(
+        [f for f in findings if not f.suppressed], str(bl),
+        scanned_paths=set(sources))
+    after = core.load_baseline(str(bl))
+    assert after == before
+
+
+def test_compile_bridge_survives_watch_teardown(tmp_path):
+    """install_compile_events during an open watch_compiles context must
+    keep counting after the context exits (the watch teardown must not
+    restore the logger level out from under the process-lifetime
+    bridge)."""
+    if sanitize._BUS_BRIDGE is None:
+        bus = tele.get_bus()
+        bus.configure(jsonl_path=str(tmp_path / "ev.jsonl"))
+        bus.reset()
+    assert sanitize._BUS_BRIDGE is not None
+    with sanitize.watch_compiles():
+        pass
+    seen = []
+    bus = tele.get_bus()
+    bus.subscribe(seen.append)
+    try:
+        jax.jit(lambda x: x * 3.5)(jnp.float32(2.0)).block_until_ready()
+    finally:
+        bus.reset()
+    assert any(e.get("event") == "compile" for e in seen), seen
+
+
+def test_report_jsonl_validates_against_schema(tmp_path):
+    findings = lint(tmp_path, PR2_SHAPE, relpath="a.py")
+    report = tmp_path / "report.jsonl"
+    core.write_report(str(report), findings, files_scanned=1,
+                      rules=analysis.RULES)
+    assert schema.check_file(str(report)) == []
+    # a corrupted finding line must trip the checker
+    lines = report.read_text().splitlines()
+    bad = json.loads(lines[1])
+    del bad["fingerprint"]
+    bad["severity"] = "catastrophic"
+    report.write_text("\n".join([lines[0], json.dumps(bad)]) + "\n")
+    errs = schema.check_file(str(report))
+    assert any("fingerprint" in e for e in errs)
+    assert any("catastrophic" in e for e in errs)
+
+
+def test_repo_is_lint_clean():
+    """The acceptance gate: `python -m cocoa_tpu.analysis` exits clean on
+    this tree — every finding fixed, inline-justified, or baselined with
+    a justification (never a TODO placeholder)."""
+    findings, _, stale = analysis.run_analysis()
+    new = [f for f in findings if f.actionable]
+    assert new == [], [f"{f.location()}: {f.message}" for f in new]
+    assert stale == [], stale
+    for f in findings:
+        if f.baselined:
+            assert f.justification and "TODO" not in f.justification, \
+                f.location()
+
+
+# --- dynamic sanitizer on the CPU drive loop --------------------------------
+
+
+@pytest.fixture()
+def small_ds(tiny_data):
+    return shard_dataset(tiny_data, k=K, layout="dense", dtype=jnp.float32)
+
+
+_PARAMS = dict(num_rounds=12, lam=0.01, local_iters=15, beta=1.0, gamma=1.0)
+_DBG = DebugParams(debug_iter=4, seed=0)
+
+
+def test_transfer_guard_has_teeth():
+    """An un-sanctioned scalar sync under the strict guard raises — the
+    'zero unintended transfers' assertion is not vacuous.  (On CPU the
+    device→host half of ``float(x[i])`` is zero-copy; what trips is the
+    host→device upload of the index constant — on TPU both halves do.)"""
+    x = jax.device_put(jnp.arange(3.0))
+    with pytest.raises(Exception, match="[Dd]isallowed.*transfer"):
+        with sanitize.no_transfers():
+            float(x[0])
+    # the sanctioned path through intended_fetch stays open
+    with sanitize.no_transfers():
+        with sanitize.intended_fetch("test"):
+            assert float(x[0]) == 0.0
+
+
+def test_sanitizer_drive_loop_compile_once_and_no_syncs(small_ds, tiny_data):
+    """THE sanitizer contract (ISSUE 6 acceptance): the device-resident
+    drive loop compiles exactly once per config, performs zero unintended
+    device→host transfers inside the round loop, and a second identical
+    run reuses the executable (zero compiles)."""
+    params = Params(n=tiny_data.n, **_PARAMS)
+    with sanitize.sanitizer() as s1:
+        w1, a1, traj1 = run_cocoa(small_ds, params, _DBG, plus=True,
+                                  quiet=True, device_loop=True)
+    assert s1.compile_count("run") == 1, [c.name for c in s1.compiles]
+    assert s1.intended_fetches >= 1
+    with sanitize.sanitizer() as s2:
+        w2, a2, traj2 = run_cocoa(small_ds, params, _DBG, plus=True,
+                                  quiet=True, device_loop=True)
+    assert s2.compiles == [], [c.name for c in s2.compiles]
+    assert jnp.array_equal(w1, w2) and jnp.array_equal(a1, a2)
+    assert len(traj2.records) == len(traj1.records)
+
+
+def test_sanitizer_drive_loop_telemetry_on(small_ds, tiny_data, tmp_path):
+    """Same invariants with every telemetry sink attached: the
+    io_callback tap must not introduce unintended transfers, and the
+    metrics textfile exposes compiles_total / host_transfers_total."""
+    params = Params(n=tiny_data.n, **_PARAMS)
+    ev = str(tmp_path / "events.jsonl")
+    mp = str(tmp_path / "metrics.prom")
+    bus = tele.get_bus()
+    bus.configure(jsonl_path=ev, metrics_path=mp)
+    try:
+        with sanitize.sanitizer() as s:
+            w, a, _ = run_cocoa(small_ds, params, _DBG, plus=True,
+                                quiet=True, device_loop=True)
+        assert s.compile_count("run") <= 1
+        assert s.intended_fetches >= 1
+    finally:
+        bus.reset()
+    # telemetry-off reference run must match bit-for-bit
+    w0, a0, _ = run_cocoa(small_ds, params, _DBG, plus=True, quiet=True,
+                          device_loop=True)
+    assert jnp.array_equal(w, w0) and jnp.array_equal(a, a0)
+    assert schema.check_file(ev) == []
+    evs = [json.loads(l) for l in open(ev)]
+    kinds = {e["event"] for e in evs}
+    assert "host_transfer" in kinds
+    text = open(mp).read()
+    assert "cocoa_compiles_total" in text
+    assert "cocoa_host_transfers_total" in text
+    ht = int([l for l in text.splitlines()
+              if l.startswith("cocoa_host_transfers_total")][0].split()[1])
+    assert ht == sum(1 for e in evs if e["event"] == "host_transfer")
+
+
+def test_host_stepped_eval_fetch_is_sanctioned(small_ds, tiny_data):
+    """The chunked (host-stepped) driver's per-eval fetch rides
+    intended_fetch too — the sanitizer passes on the scan_chunk path."""
+    params = Params(n=tiny_data.n, **_PARAMS)
+    with sanitize.sanitizer(strict="d2h") as s:
+        w, a, traj = run_cocoa(small_ds, params, _DBG, plus=True,
+                               quiet=True, scan_chunk=4)
+    assert s.intended_fetches >= len(traj.records)
+
+
+def test_metrics_writer_counts_sanitizer_events(tmp_path):
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    mp = str(tmp_path / "m.prom")
+    w = MetricsWriter(mp)
+    w({"event": "compile", "name": "run", "seconds": 0.5, "ts": 1.0})
+    w({"event": "compile", "name": "eval", "seconds": 0.1, "ts": 2.0})
+    w({"event": "host_transfer", "label": "device_loop_fetch", "ts": 3.0})
+    text = open(mp).read()
+    assert "cocoa_compiles_total 2" in text
+    assert "cocoa_host_transfers_total 1" in text
+
+
+def test_analysis_cli_exits_clean(tmp_path):
+    """`python -m cocoa_tpu.analysis` (the CI gate) exits 0 on this tree
+    and writes a schema-valid report."""
+    from cocoa_tpu.analysis.__main__ import main
+
+    report = str(tmp_path / "report.jsonl")
+    rc = main([f"--report={report}"])
+    assert rc == 0
+    assert schema.check_file(report) == []

@@ -67,7 +67,15 @@ def _backoff_run(device_loop, **kw):
 
 
 def _strip(events, drop=("ts", "seq")):
-    return [{k: v for k, v in e.items() if k not in drop} for e in events]
+    """Comparable view of an event stream: timing fields dropped, and the
+    sanitizer's transport-bookkeeping events (``host_transfer``/
+    ``compile``, analysis/sanitize.py) filtered out — their position is
+    inherently path-dependent (the live stream emits evals BEFORE the
+    end-of-run fetch; the fetch-replay bridge emits them after), while
+    the parity contract here is about the decoded eval/backoff events."""
+    return [{k: v for k, v in e.items() if k not in drop}
+            for e in events
+            if e.get("event") not in ("host_transfer", "compile")]
 
 
 # --- the acceptance pin -----------------------------------------------------
