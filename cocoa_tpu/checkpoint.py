@@ -28,15 +28,23 @@ def save(
     alpha: Optional[jax.Array] = None,
     seed: int = 0,
     sched: Optional[jax.Array] = None,
+    hist: Optional[jax.Array] = None,
 ) -> str:
     """Write checkpoint for ``round_t``; returns the file path.
 
     ``sched`` is the σ′-schedule / watch state of a ``--sigmaSchedule``
-    run (solvers/base.py SCHED layout, a tiny float32 vector).  It rides
-    the meta JSON rather than the array set: every float32 is exactly
-    representable as a JSON double, so the round trip is bit-identical —
-    which is what makes a mid-schedule ``--resume`` reproduce the
-    uninterrupted trajectory — and old checkpoints/readers stay valid.
+    run (solvers/base.py SCHED layout, a tiny float32 vector; ``--accel``
+    runs extend it with the momentum/Θ slots — same layout note).  It
+    rides the meta JSON rather than the array set: every float32 is
+    exactly representable as a JSON double, so the round trip is
+    bit-identical — which is what makes a mid-schedule ``--resume``
+    reproduce the uninterrupted trajectory — and old checkpoints/readers
+    stay valid.
+
+    ``hist`` is the ``--accel`` secant window bank (a (2, K, n_shard)
+    dual-history leaf — the two previous eval-boundary α snapshots): it
+    joins the ``.npz`` array set so an accelerated run's mid-momentum
+    resume is bit-identical too.
 
     Crash-safe: both files are written to temp names and renamed in, the
     ``.npz`` LAST — :func:`latest` discovers checkpoints by the ``.npz``,
@@ -71,6 +79,8 @@ def save(
     arrays = {"w": np.asarray(w), "_meta": np.array(json.dumps(meta))}
     if alpha is not None:
         arrays["alpha"] = np.asarray(alpha)
+    if hist is not None:
+        arrays["hist"] = np.asarray(hist)
     pid = os.getpid()
     tmp = f"{path}.tmp.{pid}"
     with open(tmp, "wb") as f:  # explicit handle: savez must not append .npz
@@ -118,10 +128,20 @@ def load(path: str):
     """Returns (meta dict, w, alpha-or-None) as host numpy arrays.  Meta
     comes from inside the archive (self-describing — see :func:`save`);
     the sidecar is only a fallback for pre-meta checkpoints."""
+    meta, arrays = load_full(path)
+    return meta, arrays["w"], arrays.get("alpha")
+
+
+def load_full(path: str):
+    """Returns (meta dict, {array name: host ndarray}) — everything the
+    checkpoint carries, including the ``--accel`` dual-history leaf
+    ``hist`` when present.  :func:`load` keeps the legacy 3-tuple
+    shape."""
     data = np.load(path)
     if "_meta" in data.files:
         meta = json.loads(str(data["_meta"]))
     else:
         with open(path + ".json") as f:
             meta = json.load(f)
-    return meta, data["w"], (data["alpha"] if "alpha" in data.files else None)
+    return meta, {name: data[name] for name in data.files
+                  if name != "_meta"}

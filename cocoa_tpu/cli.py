@@ -98,7 +98,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "deviceLoop", "master", "processId", "numProcesses",
                 "profile", "objective", "l2", "blockSize",
                 "blockPipeline", "divergenceGuard",
-                "sigmaSchedule", "warmStart",
+                "sigmaSchedule", "warmStart", "accel", "theta",
                 "elastic", "stallTimeout", "evalDense", "hotCols",
                 "metrics", "events", "quiet")  # run-level
 
@@ -261,6 +261,37 @@ def main(argv=None) -> int:
         print("error: --sigmaSchedule=anneal requires --gapTarget (the "
               "in-loop backoff triggers on the stall watch, which runs "
               "on the gap-target path)", file=sys.stderr)
+        return 2
+
+    accel_flag = (extras["accel"] or "auto").lower()
+    if accel_flag not in ("auto", "on", "off"):
+        print(f"error: --accel must be auto|on|off, got "
+              f"{extras['accel']!r}", file=sys.stderr)
+        return 2
+    theta_flag = (extras["theta"] or "fixed").lower()
+    if theta_flag not in ("fixed", "adaptive"):
+        print(f"error: --theta must be fixed|adaptive, got "
+              f"{extras['theta']!r}", file=sys.stderr)
+        return 2
+    if accel_flag == "on" and not extras["gapTarget"]:
+        # momentum's restart rule monitors the eval-cadence gap; without
+        # a target the run is a fixed-round benchmark path that must stay
+        # bit-comparable — require the gap-target regime explicitly
+        print("error: --accel=on requires --gapTarget (the momentum "
+              "restart rule monitors the gap trajectory; fixed-round "
+              "benchmark runs stay unaccelerated)", file=sys.stderr)
+        return 2
+    if accel_flag == "on" and sigma_schedule == "trial":
+        print("error: --accel cannot ride --sigmaSchedule=trial (the "
+              "trial is the bit-exact A/B control); use "
+              "--sigmaSchedule=anneal", file=sys.stderr)
+        return 2
+    if theta_flag == "adaptive" and (accel_flag == "off"
+                                     or sigma_schedule == "trial"
+                                     or not extras["gapTarget"]):
+        print("error: --theta=adaptive requires an accelerated "
+              "gap-targeted run (--accel=auto|on with --gapTarget, "
+              "not --sigmaSchedule=trial)", file=sys.stderr)
         return 2
 
     warm_start = None
@@ -779,13 +810,17 @@ def main(argv=None) -> int:
         path = ckpt_lib.latest(cfg.chkpt_dir, algorithm)
         if path is None:
             return dict()
-        meta, w0, a0 = ckpt_lib.load(path)
+        meta, arrays = ckpt_lib.load_full(path)
         print(f"resuming {algorithm} from round {meta['round']} ({path})")
-        out = dict(w_init=w0, start_round=meta["round"] + 1)
-        if a0 is not None:
-            out["alpha_init"] = a0
+        out = dict(w_init=arrays["w"], start_round=meta["round"] + 1)
+        if arrays.get("alpha") is not None:
+            out["alpha_init"] = arrays["alpha"]
         if meta.get("sched") is not None:
             out["sched_init"] = _np.asarray(meta["sched"], _np.float32)
+        if arrays.get("hist") is not None:
+            # the --accel secant window bank: restoring it (with the
+            # sched accel slots) makes a mid-momentum resume bit-identical
+            out["hist_init"] = arrays["hist"]
         return out
 
     def finish(traj, w, alpha=None):
@@ -815,7 +850,8 @@ def main(argv=None) -> int:
                     math=cfg.math, device_loop=cfg.device_loop,
                     block_size=block_size, block_pipeline=block_pipeline,
                     divergence_guard=guard, sigma_schedule=sigma_schedule,
-                    warm_start=warm_start)
+                    warm_start=warm_start, accel=accel_flag,
+                    theta=theta_flag)
 
     def run_all():
         w, alpha, traj = run_cocoa(ds, params, debug, plus=True,

@@ -98,6 +98,38 @@ def shard_margins(w: jax.Array, shard: dict) -> jax.Array:
     return m
 
 
+def shards_axpy(coefs: jax.Array, shards: dict, vec: jax.Array) -> jax.Array:
+    """vec + Σ_{k,i} coefs[k,i] · x_{k,i} over EVERY row of the stacked
+    (K, …) shard arrays — the transpose counterpart of
+    :func:`shard_margins` (margins contract each row against a d-vector;
+    this scatters one coefficient per row back into a d-vector).  Used by
+    the ``--accel`` secant jump (solvers/cocoa.py): the extrapolated
+    dual's exact correspondence update Δw = Σ y·Δα·x/(λn) in one batched
+    pass at eval cadence.
+
+    Same layout dispatch and padding conventions as the row accessors
+    above: padded CSR slots carry value 0, so they contribute exactly 0;
+    the hybrid split's hot and cold columns are disjoint, so the two
+    scatters never race on a coordinate.  TRAINING-side: never reads the
+    dense eval twin.
+    """
+    import jax.numpy as jnp
+
+    if "X" in shards:
+        return vec + jnp.einsum("kn,knd->d", coefs, shards["X"])
+    vec = vec.at[shards["sp_indices"]].add(
+        coefs[..., None] * shards["sp_values"])
+    if "X_hot" in shards:
+        # hot_cols arrives (K, n_hot) — replicated per shard by the
+        # loader — so the panel contribution scatters per shard: a
+        # summed (n_hot,) update here would be added K times by the
+        # leading index dim (pinned against the dense einsum in
+        # tests/test_accel.py::test_shards_axpy_hybrid_matches_dense)
+        vec = vec.at[shards["hot_cols"]].add(
+            jnp.einsum("kn,knh->kh", coefs, shards["X_hot"]))
+    return vec
+
+
 def eval_margins(w: jax.Array, shard: dict) -> jax.Array:
     """EVAL-side :func:`shard_margins`: additionally prefers the dense
     eval twin ``X_eval`` (data/sharding.py ``eval_dense=True``) — the

@@ -99,6 +99,249 @@ def stall_window(debug_iter: int) -> int:
 SCHED_LEN = 5
 MAX_SIGMA_LEVELS = 8
 
+# --- accelerated outer loop (--accel, round 12) -----------------------------
+#
+# Secant (Anderson-1) extrapolation on the DUAL at eval-window boundaries,
+# plus adaptive local accuracy Θ (the outer-acceleration + inexact-local-
+# solve structure of Smith et al., arXiv:1711.05305 — PAPERS.md).  The
+# solver state gains a (2, K, n_shard) dual-history leaf ``hist`` — the
+# two previous eval-boundary α snapshots — and EIGHT more f32 slots
+# appended to the sched vector, so an accelerated state tuple is
+#
+#   state = (w, alpha, hist, sched)     len(sched) = SCHED_LEN + ACCEL_LEN
+#
+#   sched[5]  = hist_len   valid α-window snapshots banked (0, 1, 2)
+#   sched[6]  = jump       a secant jump is armed for the next chunk head
+#   sched[7]  = restarts   cumulative gap-monitored momentum restarts
+#   sched[8]  = last_gap   the previous eval's gap (the restart trigger)
+#   sched[9]  = th_stage   Θ ladder index (inner steps per round)
+#   sched[10] = th_stall   Θ watch: consecutive no-improvement evals
+#   sched[11] = th_best    Θ watch best gap since the stage started
+#   sched[12] = th_best_prev
+#
+# The jump itself (solvers/cocoa.py accel_kernel head): with the two
+# banked snapshots h1, h2 and the current α, the window displacements
+# δ₁ = h2−h1 and δ₂ = α−h2 give the autocorrelation ρ = ⟨δ₁,δ₂⟩/⟨δ₁,δ₁⟩
+# of the outer iteration's limiting mode, and the secant/Anderson-1
+# fixed-point jump α ← α + c·δ₂ with c = ρ/(1−ρ) lands where the
+# geometric tail α + δ₂·(ρ + ρ² + …) is heading.  c is SIGNED and
+# data-derived: oscillation (ρ ≈ −1) makes it pairwise averaging
+# (c ≈ −½), slow drift (ρ → 1) aggressive extrapolation, clipped to
+# [ACCEL_CMIN, ACCEL_CMAX].  The jumped α is clipped back to the dual
+# box and masked, and w is advanced by the EXACT correspondence update
+# Σ y·Δα·x/(λn) (ops/rows.shards_axpy) — so (w, α) remains a feasible
+# primal-dual pair and the unmodified gap evaluation in
+# evals/objectives.py stays the certificate.  A gap RISE at an eval
+# boundary discards the bank (restart): damage from a bad jump is
+# bounded to one eval cadence.  All slots are small integers or f32
+# gaps — exact in float32, exact in the checkpoint meta JSON round trip.
+#
+# Measured-out alternatives on the rcv1-synth λ=1e-4 config (SWEEPS.md
+# "accelerated outer loop"): per-round growing-β Nesterov momentum on w
+# DIVERGES (54 restarts, never certifies — one CoCoA+ round is a large
+# contraction step, and 25 unmonitored β→1 extrapolations overshoot the
+# dual box); eval-windowed fixed β down to 0.05 still diverges; damped
+# (negative-β) extrapolation cannot stabilize σ′ < K/2; Polyak–Ruppert
+# window averaging never beats the raw iterate; raising H near the
+# target buys only ~1.1×.  The tail has a MIXED spectrum — measured
+# ρ_α ≈ +0.73 drift with oscillatory modes on top — which is exactly
+# the regime the signed secant coefficient adapts to: measured 1.76×
+# fewer rounds to the 1e-4 certificate on full rcv1-synth at the safe
+# σ′ = K·γ (1100 → 625), 1.38× at σ′ = K/2 — the ratio grows with the
+# control's round count (benchmarks/SWEEPS.md).
+ACCEL_LEN = 8
+A_HIST = SCHED_LEN
+A_JUMP = SCHED_LEN + 1
+A_RESTARTS = SCHED_LEN + 2
+A_LASTGAP = SCHED_LEN + 3
+A_TH_STAGE = SCHED_LEN + 4
+A_TH_STALL = SCHED_LEN + 5
+A_TH_BEST = SCHED_LEN + 6
+A_TH_BPREV = SCHED_LEN + 7
+
+# c = ρ/(1−min(ρ, RHO_CAP)) clipped to [CMIN, CMAX]: the cap keeps the
+# pole at ρ→1 finite before the clip, CMIN = −0.5 is exact pairwise
+# averaging (the stable limit for a pure oscillation), CMAX = 3 the
+# measured knee — the rcv1-synth sweep resolved c ≈ 2.2–2.7 under a cap
+# of 3 and of 8 identically (same 800-round trajectory), so 3 bounds a
+# bad estimate without binding the good ones.
+ACCEL_CMIN = -0.5
+ACCEL_CMAX = 3.0
+ACCEL_RHO_CAP = 0.9
+
+
+def secant_coef(xp, rho):
+    """The shared jump-coefficient rule (xp = jnp when traced, np for
+    tests): c = ρ/(1−ρ) with the ρ-cap and [CMIN, CMAX] clip.  Exact f32
+    ops only (one divide, min, clip)."""
+    den = xp.float32(1.0) - xp.minimum(rho, xp.float32(ACCEL_RHO_CAP))
+    return xp.clip(rho / den, xp.float32(ACCEL_CMIN),
+                   xp.float32(ACCEL_CMAX))
+
+# Θ (local accuracy) schedule: early rounds run H/divisor inner SDCA
+# steps — cheap, imprecise local solves while the gap is far from the
+# target — and the ladder tightens toward the full H as the run
+# approaches certification.  Two advance triggers, both device-computable
+# from the current gap estimate:
+#   - near-target: gap ≤ THETA_NEAR × gap_target jumps straight to the
+#     final (full-H) stage, so certification always happens at full
+#     local accuracy;
+#   - stall: the per-stage watch (same _watch_update arithmetic as the
+#     σ′ anneal, rel = THETA_REL) fires after THETA_EVALS consecutive
+#     evals without the best gap HALVING — a deliberately strict bar:
+#     loose stages are only worth keeping while the gap is in its early
+#     fast-decay phase (measured: an H/4 stage that merely *improves*
+#     ~30%/eval never fires a 0.9-rel watch and the run crawls; the
+#     0.5-rel watch moves it up within two evals).
+# The ladder starts at H/2, not lower: H has strongly diminishing
+# returns at the top (2×/10× MORE local work buys only 1.06–1.10×
+# fewer rounds, SWEEPS.md), so halving it costs almost nothing per
+# round — but an H/4 stage was measured to push the λ=1e-4 rcv1-synth
+# A/B from 800 to 925 rounds (the early fast-decay rounds ARE
+# productive, and their secant windows degrade too: 6 restarts vs 2).
+# A Θ stage advance also clears the secant window bank (the two banked
+# windows came from a DIFFERENT round map — a jump across the seam
+# extrapolates the wrong geometric tail).
+THETA_DIVS = (2, 1)
+THETA_REL = 0.5
+THETA_EVALS = 1
+THETA_NEAR = 10.0
+
+
+def theta_ladder(h: int, adaptive: bool) -> tuple:
+    """Per-Θ-stage inner-iteration counts, coarse → exact.  The final
+    rung is always the full ``h`` (certification runs at full local
+    accuracy); a small ``h`` collapses duplicate rungs away."""
+    if not adaptive:
+        return (int(h),)
+    out = []
+    for dv in THETA_DIVS:
+        hs = min(int(h), max(1, int(h) // dv))
+        if not out or hs > out[-1]:
+            out.append(hs)
+    return tuple(out)
+
+
+class AccelConfig:
+    """Static accelerated-loop configuration threaded through the drive*
+    ladder: the Θ ladder (per-stage inner-iteration counts) and the gap
+    target the near-target jump keys on.  Hashable (rides cache keys)."""
+
+    def __init__(self, theta_hs: tuple, gap_target=None):
+        self.theta_hs = tuple(int(v) for v in theta_hs)
+        self.n_theta = len(self.theta_hs)
+        self.gap_target = gap_target
+
+    def token(self):
+        return ("accel", self.theta_hs)
+
+
+def accel_host_step(sched, gap, n_theta: int, gap_target,
+                    seam: bool = False):
+    """Host twin of the device loop's per-eval accel update (same float32
+    arithmetic, so host-stepped and device drivers make identical
+    restart/arm/Θ decisions — the σ′ ``sched_host_step`` pattern).
+    ``seam`` marks a σ′ anneal backoff committed at this same eval
+    boundary — a round-map seam exactly like a Θ stage advance, with the
+    same bank treatment (see below).
+    Returns (new sched ndarray, restarted, theta_staged).
+
+    Window bookkeeping only — the secant jump ACTION runs at the head of
+    the next chunk dispatch (solvers/cocoa.py accel_kernel consumes the
+    armed ``A_JUMP`` flag, where the shard data the correspondence update
+    needs is in scope).  Three mutually exclusive outcomes per eval:
+
+    - gap ROSE: restart — the snapshot bank is discarded and restarts
+      from this eval's α (the caller banks it, see :func:`_accel_replace`);
+    - two windows banked and the gap still improving: ARM the jump — the
+      bank is frozen for the kernel head to consume, nothing is pushed;
+    - otherwise: bank this eval's α as the newest window snapshot."""
+    s = np.asarray(sched, dtype=np.float32).copy()
+    gv = (np.float32(np.inf) if gap is None or np.isnan(gap)
+          else np.float32(gap))
+    restarted = bool(gv > s[A_LASTGAP])
+    if restarted:
+        s[A_RESTARTS] += 1.0
+        s[A_HIST] = 1.0
+    elif s[A_HIST] >= 2.0:
+        s[A_JUMP] = 1.0
+        s[A_HIST] = 0.0
+    else:
+        s[A_HIST] = min(s[A_HIST] + 1.0, 2.0)
+    s[A_LASTGAP] = gv
+    staged = False
+    if n_theta > 1:
+        s[A_TH_BEST], s[A_TH_BPREV], s[A_TH_STALL] = _watch_update(
+            np, gv, s[A_TH_BEST], s[A_TH_BPREV], s[A_TH_STALL],
+            np.float32(THETA_REL))
+        tgt32 = (np.float32(-np.inf) if gap_target is None
+                 else np.float32(gap_target))
+        near = bool(gv <= np.float32(THETA_NEAR) * tgt32)
+        fire = bool(s[A_TH_STALL] >= np.float32(THETA_EVALS))
+        if s[A_TH_STAGE] < n_theta - 1 and (near or fire):
+            s[A_TH_STAGE] = (np.float32(n_theta - 1) if near
+                             else s[A_TH_STAGE] + 1)
+            s[A_TH_STALL] = 0.0
+            s[A_TH_BEST] = np.float32(np.inf)
+            s[A_TH_BPREV] = np.float32(np.inf)
+            # windows banked BEFORE the seam measured the old stage's
+            # round map — a secant ρ mixing maps extrapolates the wrong
+            # tail, so the bank drops to (at most) the α just banked,
+            # which is a valid anchor for the new map's first window.
+            # An already-armed jump stays armed: all three of its points
+            # predate the seam, so its extrapolation is consistent.
+            s[A_HIST] = min(s[A_HIST], 1.0)
+            staged = True
+    if seam:
+        # a σ′ backoff changed the round map at this boundary: cap the
+        # bank the same way a Θ stage advance does (armed jump stays
+        # armed — all its points predate the seam)
+        s[A_HIST] = min(s[A_HIST], np.float32(1.0))
+    return s, restarted, staged
+
+
+def _accel_replace(state, sched_np):
+    """Commit a host accel step back into the (w, alpha, hist, sched)
+    state: the sched leaf via :func:`_sched_replace`, plus — unless this
+    eval ARMED a jump (the bank is then frozen for the kernel head to
+    consume) — banking the current α as the newest window snapshot,
+    hist ← [hist[1], α].  ``jnp.stack`` materializes a fresh buffer, so
+    the hist leaf never aliases the separately-donated α arg."""
+    import jax
+    import jax.numpy as jnp
+
+    armed = float(sched_np[A_JUMP]) > 0.0
+    state = _sched_replace(state, sched_np)
+    if not armed:
+        hist = jnp.stack([state[2][1], state[1]])
+        sharding = getattr(state[2], "sharding", None)
+        if sharding is not None:
+            hist = jax.device_put(hist, sharding)
+        state = (*state[:2], hist, *state[3:])
+    return state
+
+
+def _emit_accel_events(name, t, restarted, restarts_total, staged, stage,
+                       accel: "AccelConfig", quiet):
+    """The typed momentum_restart / theta_stage events for one eval
+    boundary (emitted regardless of ``quiet`` — same policy as
+    :func:`_emit_backoff`)."""
+    from cocoa_tpu.telemetry import events as _tele
+
+    bus = _tele.get_bus()
+    if restarted:
+        bus.emit("momentum_restart", algorithm=name, t=int(t),
+                 restarts_total=int(restarts_total))
+        if not quiet:
+            print(f"{name}: momentum restart at round {t} (gap rose; "
+                  f"secant window bank discarded)")
+    if staged:
+        bus.emit("theta_stage", algorithm=name, t=int(t), stage=int(stage),
+                 h=int(accel.theta_hs[int(stage)]))
+        if not quiet:
+            print(f"{name}: Θ schedule — local accuracy raised to "
+                  f"H={accel.theta_hs[int(stage)]} at round {t}")
+
 
 def anneal_levels(start: float, safe: float, factor: float = 2.0,
                   max_levels: int = MAX_SIGMA_LEVELS) -> tuple:
@@ -115,23 +358,33 @@ def anneal_levels(start: float, safe: float, factor: float = 2.0,
     return tuple(levels)
 
 
-def sched_init_array(start_round: int, sched_init=None):
-    """The initial sched vector (see the layout note above): a restored
+def sched_init_array(start_round: int, sched_init=None, accel: bool = False):
+    """The initial sched vector (see the layout notes above): a restored
     mid-schedule state, or a fresh stage-0 watch starting at
-    ``start_round``."""
+    ``start_round``.  With ``accel`` the vector carries the ACCEL_LEN
+    momentum/Θ tail too; a restored plain (SCHED_LEN,) state is extended
+    with fresh accel slots (resuming a pre-accel checkpoint restarts the
+    momentum sequence — sound: any (w, α) is a valid primal-dual pair),
+    and an accel-length state resumed WITHOUT accel keeps its σ′ head."""
     import jax.numpy as jnp
 
+    head = np.array([0.0, 0.0, np.inf, np.inf, float(start_round)],
+                    dtype=np.float32)
+    tail = np.array([0.0, 0.0, 0.0, np.inf, 0.0, 0.0, np.inf, np.inf],
+                    dtype=np.float32)
     if sched_init is not None:
         s = np.asarray(sched_init, dtype=np.float32)
-        if s.shape != (SCHED_LEN,):
+        if s.shape not in ((SCHED_LEN,), (SCHED_LEN + ACCEL_LEN,)):
             raise ValueError(
                 f"restored sigma-schedule state has shape {s.shape}, "
-                f"expected ({SCHED_LEN},) — was the checkpoint written by "
-                f"an incompatible version?")
+                f"expected ({SCHED_LEN},) or ({SCHED_LEN + ACCEL_LEN},) — "
+                f"was the checkpoint written by an incompatible version?")
+        if accel and s.shape == (SCHED_LEN,):
+            s = np.concatenate([s, tail])
+        elif not accel and s.shape == (SCHED_LEN + ACCEL_LEN,):
+            s = s[:SCHED_LEN]
         return jnp.asarray(s)
-    return jnp.asarray(
-        np.array([0.0, 0.0, np.inf, np.inf, float(start_round)],
-                 dtype=np.float32))
+    return jnp.asarray(np.concatenate([head, tail]) if accel else head)
 
 
 def _watch_update(xp, gv, best, best_prev, stall, rel):
@@ -290,6 +543,7 @@ def drive(
                 debug.chkpt_dir, name, t, state[0],
                 state[1] if len(state) > 1 else None, seed=debug.seed,
                 sched=state[-1] if len(state) > 2 else None,
+                hist=state[2] if len(state) > 3 else None,
             )
     return state, traj
 
@@ -307,6 +561,7 @@ def drive_chunked(
     chunk: int = 50,
     divergence_guard: bool = True,
     sigma_levels: Optional[tuple] = None,
+    accel: Optional["AccelConfig"] = None,
 ):
     """Chunked variant of :func:`drive`: rounds run device-side in blocks of
     up to ``chunk`` via ``lax.scan`` (one dispatch per block instead of one
@@ -375,6 +630,19 @@ def drive_chunked(
                     stage = int(sched[0])
                     stall_v = int(sched[1])
                 sigma_val = sigma_levels[stage]
+            if accel is not None and not hit:
+                # accelerated outer loop: the restart/arm/bank step + Θ
+                # step at the same eval boundary (accel_host_step is the
+                # device loop's bit-twin; the σ′ update above already
+                # committed, so state[-1] carries its fresh head).  An
+                # armed jump executes at the head of the NEXT chunk
+                # dispatch — the kernel has the shard data in scope.
+                sched_a, restarted, staged = accel_host_step(
+                    state[-1], gap, accel.n_theta, gap_target, seam=backed)
+                state = _accel_replace(state, sched_a)
+                _emit_accel_events(name, end, restarted,
+                                   int(sched_a[A_RESTARTS]), staged,
+                                   int(sched_a[A_TH_STAGE]), accel, quiet)
             traj.log_round(end, primal=primal, gap=gap, test_error=test_err,
                            sigma=sigma_val, sigma_stage=stage, stall=stall_v)
             if backed:
@@ -396,6 +664,7 @@ def drive_chunked(
                 debug.chkpt_dir, name, end, state[0],
                 state[1] if len(state) > 1 else None, seed=debug.seed,
                 sched=state[-1] if len(state) > 2 else None,
+                hist=state[2] if len(state) > 3 else None,
             )
     return state, traj
 
@@ -466,7 +735,8 @@ class _Prefetch:
 
 def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                       mesh=None, stall_evals=STALL_EVALS,
-                      divergence_guard=True, n_stages=0, stream=False):
+                      divergence_guard=True, n_stages=0, stream=False,
+                      accel=None):
     import functools
 
     import jax.numpy as jnp
@@ -484,15 +754,18 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
     # so a scheduled run never stops "diverged" (see sched_host_step, the
     # host twin).
     anneal = check_div and n_stages > 1
-    # every eval writes one [primal, gap, test_err, sigma_stage, stall]
-    # row: cols 0-2 are the eval metrics, col 3 the post-update σ′ ladder
-    # stage (NaN outside anneal mode), col 4 the post-update stall-watch
-    # counter.  The row feeds the trajectory buffer AND — with ``stream``
-    # — an ordered io_callback that posts it to the telemetry bus while
-    # the loop is still on device (side-effect-only: nothing in the loop
-    # carry reads it, so a streaming run is bit-identical to a
-    # non-streaming one — the fetch-fallback replays the same buffer).
-    n_cols = 5
+    # every eval writes one [primal, gap, test_err, sigma_stage, stall,
+    # theta_stage, restarts] row: cols 0-2 are the eval metrics, col 3 the
+    # post-update σ′ ladder stage (NaN outside anneal mode), col 4 the
+    # post-update stall-watch counter, col 5 the post-update Θ ladder
+    # stage and col 6 the cumulative momentum-restart count (both NaN
+    # outside --accel runs).  The row feeds the trajectory buffer AND —
+    # with ``stream`` — an ordered io_callback that posts it to the
+    # telemetry bus while the loop is still on device (side-effect-only:
+    # nothing in the loop carry reads it, so a streaming run is
+    # bit-identical to a non-streaming one — the fetch-fallback replays
+    # the same buffer).
+    n_cols = 7
 
     @functools.partial(jax.jit, donate_argnums=tuple(range(n_state)))
     def run(*args):
@@ -535,8 +808,10 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                 stl = jnp.where(bo, jnp.float32(0), stl)
                 bst = jnp.where(bo, inf32, bst)
                 bpv = jnp.where(bo, inf32, bpv)
+                head = jnp.stack([stg, stl, bst, bpv, sched[4]])
                 state = (*state[:-1],
-                         jnp.stack([stg, stl, bst, bpv, sched[4]]))
+                         jnp.concatenate([head, sched[SCHED_LEN:]])
+                         if accel is not None else head)
                 extra = jnp.stack([stg.astype(metrics.dtype),
                                    stl.astype(metrics.dtype)])
             elif check_div:
@@ -552,7 +827,81 @@ def _build_device_run(chunk_kernel, eval_kernel, gap_target, n_state,
                 extra = jnp.stack([nanv, stall.astype(metrics.dtype)])
             else:
                 extra = jnp.stack([nanv, jnp.zeros((), metrics.dtype)])
-            row = jnp.concatenate([metrics, extra])
+            if accel is not None:
+                # accelerated outer loop: the per-eval restart/arm/bank +
+                # Θ-schedule update, in-state (the accel_host_step twin —
+                # identical f32 arithmetic).  State-changing ACTIONS are
+                # suppressed on a target hit (the host drivers stop
+                # without committing), matching the σ′ backoff policy;
+                # the watch arithmetic itself commits either way.  An
+                # armed jump executes at the head of the next chunk
+                # (solvers/cocoa.py accel_kernel — the shard data the
+                # correspondence update needs is in scope there).
+                sched = state[-1]
+                gv = jnp.where(jnp.isnan(metrics[1]), jnp.inf,
+                               metrics[1]).astype(jnp.float32)
+                hl, rst, lg = (sched[A_HIST], sched[A_RESTARTS],
+                               sched[A_LASTGAP])
+                restart = (gv > lg) & jnp.logical_not(done_tgt)
+                arm = ((hl >= jnp.float32(2)) & jnp.logical_not(restart)
+                       & jnp.logical_not(done_tgt))
+                rst = jnp.where(restart, rst + 1, rst)
+                hl = jnp.where(
+                    done_tgt, hl,
+                    jnp.where(arm, jnp.float32(0),
+                              jnp.where(restart, jnp.float32(1),
+                                        jnp.minimum(hl + 1,
+                                                    jnp.float32(2)))))
+                jmp = jnp.where(arm, jnp.float32(1), jnp.float32(0))
+                lg = jnp.where(done_tgt, lg, gv)
+                push = jnp.logical_not(arm) & jnp.logical_not(done_tgt)
+                thst = sched[A_TH_STAGE]
+                thstl, thb, thbp = (sched[A_TH_STALL], sched[A_TH_BEST],
+                                    sched[A_TH_BPREV])
+                if accel.n_theta > 1:
+                    thb, thbp, thstl = _watch_update(
+                        jnp, gv, thb, thbp, thstl, jnp.float32(THETA_REL))
+                    tgt32 = jnp.float32(tgt)
+                    near = gv <= jnp.float32(THETA_NEAR) * tgt32
+                    fire = thstl >= jnp.float32(THETA_EVALS)
+                    can = thst < jnp.float32(accel.n_theta - 1)
+                    step = (near | fire) & can & jnp.logical_not(done_tgt)
+                    thst = jnp.where(
+                        step,
+                        jnp.where(near, jnp.float32(accel.n_theta - 1),
+                                  thst + 1),
+                        thst)
+                    inf32 = jnp.float32(jnp.inf)
+                    thstl = jnp.where(step, jnp.float32(0), thstl)
+                    thb = jnp.where(step, inf32, thb)
+                    thbp = jnp.where(step, inf32, thbp)
+                    # a stage advance caps the secant bank at the α just
+                    # banked: pre-seam window displacements measured the
+                    # old stage's round map (an armed jump stays armed —
+                    # all its points predate the seam; base layout note)
+                    hl = jnp.where(step, jnp.minimum(hl, jnp.float32(1)),
+                                   hl)
+                if anneal:
+                    # a σ′ backoff committed above is a round-map seam
+                    # exactly like a Θ stage advance: same bank cap
+                    # (accel_host_step's ``seam`` is the host twin)
+                    hl = jnp.where(bo, jnp.minimum(hl, jnp.float32(1)),
+                                   hl)
+                tail = jnp.stack([hl, jmp, rst, lg, thst, thstl, thb,
+                                  thbp])
+                # the bank action: unless this eval armed a jump (the
+                # bank is then frozen for the kernel head to consume),
+                # the current α joins as the newest window snapshot;
+                # state is (w, alpha, hist, sched)
+                hist_leaf = jnp.where(
+                    push, jnp.stack([state[2][1], state[1]]), state[2])
+                state = (state[0], state[1], hist_leaf,
+                         jnp.concatenate([state[-1][:SCHED_LEN], tail]))
+                extra2 = jnp.stack([thst.astype(metrics.dtype),
+                                    rst.astype(metrics.dtype)])
+            else:
+                extra2 = jnp.stack([nanv, nanv])
+            row = jnp.concatenate([metrics, extra, extra2])
             if stream:
                 # side-effect-only event bridge: post this eval's row to
                 # the host WHILE THE LOOP RUNS.  Ordered, so the host sees
@@ -605,6 +954,7 @@ def drive_on_device(
     stall_evals: int = STALL_EVALS,
     divergence_guard: bool = True,
     sigma_levels: Optional[tuple] = None,
+    accel: Optional["AccelConfig"] = None,
 ):
     """Fully device-resident outer driver: the ENTIRE run — every round,
     every ``debugIter`` evaluation, and the gap-target early-stop test — is
@@ -659,17 +1009,26 @@ def drive_on_device(
     stream = emit and mesh is None and _tele.io_callback_supported()
     tap = None
     if emit:
-        # seed backoff detection with the stage this dispatch ENTERS at
-        # (the sched leaf rides super-block boundaries), so a resumed or
-        # later-block run never fabricates a backoff on its first eval
-        if anneal:
+        # seed backoff/restart/Θ detection with the values this dispatch
+        # ENTERS at (the sched leaf rides super-block boundaries), so a
+        # resumed or later-block run never fabricates a transition event
+        # on its first eval
+        init_stage = init_theta = init_restarts = None
+        if anneal or accel is not None:
             with _sanitize.intended_fetch("sched_stage"):
-                init_stage = int(np.asarray(state[-1])[0])
-        else:
-            init_stage = None
+                s0 = np.asarray(state[-1])
+            if anneal:
+                init_stage = int(s0[0])
+            if accel is not None:
+                init_theta = int(s0[A_TH_STAGE])
+                init_restarts = int(s0[A_RESTARTS])
         tap = _tele.DeviceTap(bus, name, start_round, c,
                               sigma_levels if anneal else None,
-                              init_stage=init_stage)
+                              init_stage=init_stage,
+                              theta_hs=(accel.theta_hs
+                                        if accel is not None else None),
+                              init_theta_stage=init_theta,
+                              init_restarts=init_restarts)
 
     run_key = None if cache_key is None else (cache_key, stream)
     run = _DEVICE_RUNS.get(run_key) if run_key is not None else None
@@ -677,7 +1036,7 @@ def drive_on_device(
         run = _build_device_run(
             chunk_kernel, eval_kernel, tgt, n_state, mesh=mesh,
             stall_evals=stall_evals, divergence_guard=divergence_guard,
-            n_stages=n_stages, stream=stream,
+            n_stages=n_stages, stream=stream, accel=accel,
         )
         if run_key is not None:
             _DEVICE_RUNS[run_key] = run
@@ -772,6 +1131,7 @@ def drive_device_full(
     mesh=None,
     divergence_guard: bool = True,
     sigma_levels: Optional[tuple] = None,
+    accel: Optional["AccelConfig"] = None,
 ):
     """Cadence-aligned wrapper around :func:`drive_on_device`, usable by any
     solver whose round has the (state, idxs, shards) shape: host-steps the
@@ -813,6 +1173,7 @@ def drive_device_full(
                 debug.chkpt_dir, name, done_round, state[0],
                 state[1] if len(state) > 1 else None, seed=debug.seed,
                 sched=state[-1] if len(state) > 2 else None,
+                hist=state[2] if len(state) > 3 else None,
             )
             last_saved = done_round
 
@@ -834,6 +1195,8 @@ def drive_device_full(
             primal, gap, test_err = eval_fn(state)
             sigma_val = stage = stall_v = None
             backed = False
+            hit = (gap_target is not None and gap is not None
+                   and gap <= gap_target)
             if anneal:
                 # host-stepped eval feeds the SAME in-state watch the
                 # device loop reads (sched_host_step is its bit-twin)
@@ -845,6 +1208,13 @@ def drive_device_full(
                 stall_v = int(sched[1])
             else:
                 watch.update(gap)
+            if accel is not None and not hit:
+                sched_a, restarted, staged = accel_host_step(
+                    state[-1], gap, accel.n_theta, gap_target, seam=backed)
+                state = _accel_replace(state, sched_a)
+                _emit_accel_events(name, head_end, restarted,
+                                   int(sched_a[A_RESTARTS]), staged,
+                                   int(sched_a[A_TH_STAGE]), accel, quiet)
             traj.log_round(head_end, primal=primal, gap=gap,
                            test_error=test_err, sigma=sigma_val,
                            sigma_stage=stage, stall=stall_v)
@@ -931,7 +1301,7 @@ def drive_device_full(
                 gap_target=gap_target, start_round=start,
                 cache_key=cache_key, mesh=mesh, stall_evals=watch.n,
                 divergence_guard=divergence_guard,
-                sigma_levels=sigma_levels,
+                sigma_levels=sigma_levels, accel=accel,
             )
             traj.records.extend(dev_traj.records)
             if dev_traj.records:
@@ -1194,6 +1564,7 @@ def drive_device_paths(
     eval_kernel=None,
     divergence_guard: bool = True,
     sigma_levels: Optional[tuple] = None,
+    accel: Optional["AccelConfig"] = None,
 ):
     """The scan_chunk / device_loop dispatch shared by every solver: builds
     the fused eval kernel (dual state iff ``alpha_in_state``; overridable
@@ -1222,12 +1593,13 @@ def drive_device_paths(
             cache_key=None if cache_key is None
             else (*cache_key, test_n, divergence_guard),
             mesh=mesh, divergence_guard=divergence_guard,
-            sigma_levels=sigma_levels,
+            sigma_levels=sigma_levels, accel=accel,
         )
     return drive_chunked(
         name, params, debug, state, chunk_fn, eval_fn, quiet=quiet,
         gap_target=gap_target, start_round=start_round, chunk=scan_chunk,
         divergence_guard=divergence_guard, sigma_levels=sigma_levels,
+        accel=accel,
     )
 
 

@@ -376,6 +376,9 @@ def run_sdca_family(
     sigma_levels=None,
     warm_start=None,
     sched_init=None,
+    accel: bool = False,
+    theta: str = "fixed",
+    hist_init=None,
 ):
     """Shared driver for the SDCA-family algorithms (CoCoA, CoCoA+,
     mini-batch CD — they differ only in their ``alg`` scaling triple, see
@@ -453,6 +456,30 @@ def run_sdca_family(
     ``divergence_guard`` ("auto" | "on" | "off", flag --divergenceGuard)
     controls the gap-target stall watch: auto arms it only when σ′ is
     overridden below the safe K·γ bound (base.resolve_divergence_guard).
+
+    ``accel=True`` (flag ``--accel``, resolved by :func:`run_cocoa`) runs
+    the ACCELERATED outer loop (docs/DESIGN.md "Accelerated outer loop";
+    the outer-acceleration structure of Smith et al., arXiv:1711.05305
+    with a measured secant extrapolation in place of fixed momentum):
+    the state gains a (2, K, n_shard) dual-history leaf ``hist`` and the
+    bank/jump/Θ slots on the sched vector (base.ACCEL_LEN layout).  At
+    each eval boundary the drivers bank the current α as a window
+    snapshot; once two consecutive improving windows are banked, the
+    next chunk dispatch opens with a secant (Anderson-1) jump — α moves
+    by c·(α − h2) with the signed, data-derived c = ρ/(1−ρ) from the
+    window displacements' autocorrelation (base.secant_coef), clipped
+    back into the dual box, and w advanced by the exact correspondence
+    update Σ y·Δα·x/(λn) (ops/rows.shards_axpy) — so the certified pair
+    (w, α) stays a feasible primal-dual pair and the unmodified gap
+    evaluation stays the certificate.  A gap rise at an eval boundary
+    restarts the bank (one-eval-cadence damage bound).
+    ``theta="adaptive"`` additionally runs the Θ local-accuracy ladder
+    (base.theta_ladder): early rounds run H/2 inner steps, resolved ON
+    DEVICE from the current gap estimate via the same
+    statically-specialized ``lax.switch`` branch machinery as the σ′
+    stages, tightening to the full H near the target.  ``hist_init``
+    restores the window bank from a checkpoint (bit-identical
+    mid-momentum resume).
     """
     base.check_shards(ds)
     guard_on = base.resolve_divergence_guard(
@@ -645,9 +672,20 @@ def run_sdca_family(
                 ds, state[0], state[1], params.lam, test_ds=test_ds,
                 loss=params.loss, smoothing=params.smoothing)
 
+    if theta not in ("fixed", "adaptive"):
+        raise ValueError(f"theta must be fixed|adaptive, got {theta!r}")
+    if accel:
+        if debug.debug_iter <= 0:
+            raise ValueError(
+                "--accel requires --debugIter > 0 (the momentum restart "
+                "rule rides the eval cadence)")
+        if theta == "adaptive" and gap_target is None:
+            raise ValueError(
+                "--theta=adaptive requires --gapTarget (the Θ ladder's "
+                "final full-accuracy stage is keyed to the target)")
     scheduled = ((sigma_levels is not None and len(sigma_levels) > 1)
                  or warm_start is not None)
-    if scheduled and scan_chunk <= 0 and not device_loop:
+    if (scheduled or accel) and scan_chunk <= 0 and not device_loop:
         # the schedule leaf rides the chunked/device drivers' state; the
         # per-round driver path is equivalent at chunk=1 (pinned by tests)
         scan_chunk = 1
@@ -656,7 +694,8 @@ def run_sdca_family(
         import dataclasses as _dc
 
         sched_token = None
-        if scheduled:
+        accel_cfg = None
+        if scheduled or accel:
             levels = (tuple(float(v) for v in sigma_levels)
                       if sigma_levels is not None else (float(alg[2]),))
             warm_end = 0
@@ -679,6 +718,148 @@ def run_sdca_family(
                 ]
             n_phases = len(branch_params)
             n_levels = len(levels)
+        if accel:
+            # --- the accelerated outer loop ------------------------------
+            # Branch table = (σ′ stage × loss phase × Θ stage), every
+            # branch the SAME statically-specialized chunk the plain
+            # scheduled path builds (_make_chunk_kernel): the Θ stage
+            # slices the sampled index tables to its H_s prefix — every
+            # mode's draw stream is prefix-stable, so a stage only runs
+            # FEWER of the reference draws, never different ones — and
+            # the traced schedule state picks which branch runs, exactly
+            # the σ′ anneal pattern.  The chunk head additionally
+            # consumes an armed secant jump (A_JUMP, set by the drivers'
+            # eval-boundary bookkeeping): the rounds themselves are
+            # UNMODIFIED CoCoA+ — acceleration lives entirely between
+            # windows, so the certificate arithmetic never changes.
+            from cocoa_tpu.ops import rows as _rows
+
+            accel_cfg = base.AccelConfig(
+                base.theta_ladder(params.local_iters, theta == "adaptive"),
+                gap_target)
+            n_theta = accel_cfg.n_theta
+            full_h = params.local_iters
+            if n_theta > 1 and (parts_kw.get("pallas")
+                                or parts_kw.get("block", 0) > 0):
+                raise ValueError(
+                    "--theta=adaptive slices the sequential (C, K, H) "
+                    "index tables and is not available on the Pallas/"
+                    "--blockSize paths (their kernels and the "
+                    "block-distinct sampling license are keyed to the "
+                    "full H); drop --theta=adaptive or the block flags")
+
+            def _accel_branch(bp, lv, hs):
+                bph = (bp if hs >= full_h
+                       else _dc.replace(bp, local_iters=int(hs)))
+                kern = _make_chunk_kernel(mesh, bph, k,
+                                          (alg[0], alg[1], lv),
+                                          sampler=sampler, **parts_kw)
+
+                def branch(w, alpha, idxs_ckh, shard_arrays):
+                    idxs = (idxs_ckh if hs >= full_h
+                            else idxs_ckh[:, :, :hs])
+                    return kern(w, alpha, idxs, shard_arrays)
+
+                return branch
+
+            branches = [_accel_branch(bp, lv, hs)
+                        for lv in levels for bp in branch_params
+                        for hs in accel_cfg.theta_hs]
+            inv_lam_n = 1.0 / (params.lam * params.n)
+
+            def accel_kernel(w, alpha, hist, sched, idxs_ckh,
+                             shard_arrays):
+                if isinstance(idxs_ckh, dict):
+                    idxs_ckh = sampler.tables_from_ts(idxs_ckh["t"])
+                c_len = idxs_ckh.shape[0]
+
+                def take_jump(w, alpha):
+                    # secant (Anderson-1) jump from the banked window
+                    # displacements (solvers/base.py layout note): the
+                    # jumped α is clipped to the hinge-family dual box
+                    # and padding-masked, and w advances by the EXACT
+                    # correspondence update — (w, α) stays a feasible
+                    # certified pair
+                    d1 = hist[1] - hist[0]
+                    den = jnp.vdot(d1, d1)
+                    rho = jnp.where(
+                        den > 0,
+                        jnp.vdot(d1, alpha - hist[1])
+                        / jnp.where(den > 0, den, jnp.float32(1)),
+                        jnp.float32(0))
+                    cj = base.secant_coef(jnp, rho)
+                    a_ext = jnp.clip(alpha + cj * (alpha - hist[1]),
+                                     0.0, 1.0) * shard_arrays["mask"]
+                    coefs = (shard_arrays["labels"] * (a_ext - alpha)
+                             * jnp.float32(inv_lam_n))
+                    return _rows.shards_axpy(coefs, shard_arrays, w), a_ext
+
+                w, alpha = jax.lax.cond(
+                    sched[base.A_JUMP] > 0, take_jump,
+                    lambda w, a: (w, a), w, alpha)
+                sched = sched.at[base.A_JUMP].set(jnp.float32(0))
+                stage = jnp.clip(sched[0].astype(jnp.int32), 0,
+                                 n_levels - 1)
+                th = jnp.clip(sched[base.A_TH_STAGE].astype(jnp.int32), 0,
+                              n_theta - 1)
+                if n_phases == 2:
+                    # same invariant as the scheduled branch below:
+                    # chunks never straddle an eval-cadence boundary, so
+                    # one phase test per chunk is exact (keep the two
+                    # branch-index computations in sync)
+                    warm_now = (sched[4] + (c_len - 1)
+                                <= jnp.float32(warm_end))
+                    ph = jnp.where(warm_now, 0, 1)
+                else:
+                    ph = 0
+                br = (stage * n_phases + ph) * n_theta + th
+                w2, a2 = jax.lax.switch(br, branches, w, alpha, idxs_ckh,
+                                        shard_arrays)
+                sched2 = sched.at[4].add(jnp.float32(c_len))
+                return w2, a2, hist, sched2
+
+            def chunk_kernel(state, idxs_ckh, shard_arrays):
+                return accel_kernel(state[0], state[1], state[2], state[3],
+                                    idxs_ckh, shard_arrays)
+
+            sched_token = ("accel", levels, warm_end,
+                           branch_params[0].loss,
+                           branch_params[0].smoothing,
+                           accel_cfg.theta_hs)
+            step_key = (
+                "accel", mesh, k, alg[0], alg[1], sched_token,
+                params.lam, params.n, params.local_iters, params.beta,
+                params.gamma, params.loss, params.smoothing,
+                sampler.cache_token(), tuple(sorted(parts_kw.items())),
+            )
+            chunk_step = _CHUNK_STEPS.get(step_key)
+            if chunk_step is None:
+                # hist is read-only in the kernel (the drivers rebind it
+                # at eval boundaries), so it stays un-donated
+                chunk_step = jax.jit(accel_kernel,
+                                     donate_argnums=(0, 1, 3))
+                _CHUNK_STEPS[step_key] = chunk_step
+
+            def chunk_fn(t0, c, state):
+                return chunk_step(state[0], state[1], state[2], state[3],
+                                  sampler.chunk_indices(t0, c),
+                                  shard_arrays)
+
+            hist0 = (jnp.zeros((2,) + alpha.shape, dtype=dtype)
+                     if hist_init is None
+                     else jnp.array(hist_init, dtype=dtype, copy=True))
+            sched0 = base.sched_init_array(start_round, sched_init,
+                                           accel=True)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from cocoa_tpu.parallel.mesh import DP_AXIS
+
+                hist0 = jax.device_put(
+                    hist0, NamedSharding(mesh, P(None, DP_AXIS)))
+                sched0 = jax.device_put(sched0, NamedSharding(mesh, P()))
+            state0 = (w, alpha, hist0, sched0)
+        elif scheduled:
             # one statically-specialized kernel per (σ′ stage, loss phase):
             # every Pallas/block configuration keeps its baked-in scalars,
             # and the traced schedule state only picks WHICH one runs
@@ -764,7 +945,7 @@ def run_sdca_family(
             start_round=start_round, scan_chunk=scan_chunk,
             device_loop=device_loop, cache_key=cache_key,
             eval_kernel=eval_kernel, divergence_guard=guard_on,
-            sigma_levels=levels,
+            sigma_levels=levels, accel=accel_cfg,
         )
         return state[0], state[1], traj
 
@@ -789,6 +970,8 @@ def run_cocoa(
     plus: bool,
     sigma_schedule: Optional[str] = None,
     warm_start=None,
+    accel: Optional[str] = None,
+    theta: Optional[str] = None,
     **kw,
 ):
     """CoCoA (plus=False, averaging, scaling β/K) / CoCoA+ (plus=True,
@@ -823,12 +1006,57 @@ def run_cocoa(
     device loop — the measured-but-manual SWEEPS.md "warm smooth_hinge"
     procedure as a flag.  Requires ``--loss=hinge``; the handoff is exact
     because the smooth-hinge dual keeps α in the hinge dual's [0,1] box,
-    and the reported gap is the hinge certificate throughout."""
+    and the reported gap is the hinge certificate throughout.
+
+    ``accel`` ("auto" | "on" | "off", flag ``--accel``): the accelerated
+    outer loop — a secant (Anderson-1) extrapolation of the dual at
+    eval-window boundaries, with a gap-monitored restart (see
+    :func:`run_sdca_family`).  ``auto`` enables it for gap-targeted
+    CoCoA+ runs (the regime the round-count win is measured in);
+    ``off`` (the library default) is bit-identical to the
+    pre-acceleration code.  ``theta`` ("fixed" | "adaptive", flag
+    ``--theta``): the adaptive local-accuracy ladder — early rounds run
+    far fewer inner SDCA steps, resolved on device from the current gap
+    estimate; requires an accelerated gap-targeted run.  Not available
+    with ``--sigmaSchedule=trial`` (the trial is the bit-exact
+    pre-schedule A/B control and stays untouched)."""
     import dataclasses as _dc
 
     if sigma_schedule not in (None, "trial", "anneal"):
         raise ValueError(f"sigma schedule must be trial|anneal, got "
                          f"{sigma_schedule!r}")
+    accel = "off" if accel is None else str(accel).lower()
+    if accel not in ("auto", "on", "off"):
+        raise ValueError(f"accel must be auto|on|off, got {accel!r}")
+    theta = "fixed" if theta is None else str(theta).lower()
+    if theta not in ("fixed", "adaptive"):
+        raise ValueError(f"theta must be fixed|adaptive, got {theta!r}")
+    if sigma_schedule == "trial":
+        # the trial path is preserved bit-exact as the pre-schedule A/B
+        # control — acceleration on top would change what it controls for
+        if accel == "on":
+            raise ValueError(
+                "--accel cannot ride --sigmaSchedule=trial (the trial is "
+                "the bit-exact A/B control); use --sigmaSchedule=anneal")
+        accel = "off"
+    # resolve auto HERE (before the sigma=auto recursion, whose inner
+    # calls see sigma already replaced): on for gap-targeted CoCoA+ runs
+    # — the regime where momentum's round-count win is measured and the
+    # restart rule has a gap to monitor
+    accel_on = (accel == "on"
+                or (accel == "auto" and plus
+                    and kw.get("gap_target") is not None))
+    if theta == "adaptive" and not accel_on:
+        if accel == "off":
+            raise ValueError(
+                "--theta=adaptive requires an accelerated run: pass "
+                "--accel=on, or --accel=auto with --gapTarget on CoCoA+")
+        # accel=auto resolved OFF for this run (plain-CoCoA leg of the
+        # CLI's run_all, or no gap target): Θ is an accelerated-run
+        # knob, so it degrades to the full-H schedule instead of
+        # rejecting a run the caller never asked to accelerate
+        theta = "fixed"
+    accel_kw = dict(accel="on" if accel_on else "off", theta=theta)
     if warm_start is not None:
         s_w, r_w = warm_start
         if params.loss != "hinge":
@@ -860,11 +1088,12 @@ def run_cocoa(
             # important because the reference driver runs BOTH algorithms
             # from one flag set (hingeDriver.scala:84-89)
             return run_cocoa(ds, _dc.replace(params, sigma=None), debug,
-                             plus, warm_start=warm_start, **kw)
+                             plus, warm_start=warm_start, **accel_kw, **kw)
         if (sigma_schedule or "anneal") == "anneal":
             return _run_cocoa_anneal(
                 ds, params, debug, plus,
-                base.anneal_levels(safe / 2.0, safe), warm_start, kw)
+                base.anneal_levels(safe / 2.0, safe), warm_start, accel_kw,
+                kw)
         if kw.get("gap_target") is None:
             # the divergence guard rides the gap-target early-stop path; a
             # fixed-round auto run could burn its whole budget diverged
@@ -888,7 +1117,7 @@ def run_cocoa(
                       f"σ′=K·γ={ds.k * params.gamma:g} (no re-trial from "
                       "restored state)")
             return run_cocoa(ds, _dc.replace(params, sigma=None), debug,
-                             plus, warm_start=warm_start, **kw)
+                             plus, warm_start=warm_start, **accel_kw, **kw)
         import os as _os
 
         ckpt_dir = debug.chkpt_dir if debug.chkpt_iter > 0 else ""
@@ -934,9 +1163,10 @@ def run_cocoa(
         # inherit the diverged trial's iterates (belt to the resumed-run
         # guard's suspenders above)
         safe_kw = {k2: v for k2, v in kw.items()
-                   if k2 not in ("w_init", "alpha_init", "start_round")}
+                   if k2 not in ("w_init", "alpha_init", "start_round",
+                                 "sched_init", "hist_init")}
         return run_cocoa(ds, safe_params, debug, plus,
-                         warm_start=warm_start, **safe_kw)
+                         warm_start=warm_start, **accel_kw, **safe_kw)
 
     if sigma_schedule == "trial":
         raise ValueError(
@@ -948,16 +1178,18 @@ def run_cocoa(
         # configs the schedule exists to rescue start here)
         return _run_cocoa_anneal(
             ds, params, debug, plus,
-            base.anneal_levels(float(params.sigma), safe), warm_start, kw)
+            base.anneal_levels(float(params.sigma), safe), warm_start,
+            accel_kw, kw)
 
     alg = _alg_config(params, ds.k, plus)
     return run_sdca_family(
         ds, params, debug, "CoCoA+" if plus else "CoCoA", alg,
-        warm_start=warm_start, **kw
+        warm_start=warm_start, accel=accel_on, theta=theta, **kw
     )
 
 
-def _run_cocoa_anneal(ds, params, debug, plus, levels, warm_start, kw):
+def _run_cocoa_anneal(ds, params, debug, plus, levels, warm_start,
+                      accel_kw, kw):
     """The scheduled (device-resident) σ′ anneal entry: validate, resolve
     resume, and hand the static ladder to :func:`run_sdca_family`."""
     import dataclasses as _dc
@@ -983,10 +1215,11 @@ def _run_cocoa_anneal(ds, params, debug, plus, levels, warm_start, kw):
             print("sigma anneal: resumed run has no schedule state; "
                   f"continuing with the safe σ′=K·γ={ds.k * params.gamma:g}")
         return run_cocoa(ds, _dc.replace(params, sigma=None), debug, plus,
-                         warm_start=warm_start, **kw)
+                         warm_start=warm_start, **accel_kw, **kw)
     p = _dc.replace(params, sigma=levels[0])
     alg = _alg_config(p, ds.k, plus)
     return run_sdca_family(
         ds, p, debug, "CoCoA+" if plus else "CoCoA", alg,
-        sigma_levels=levels, warm_start=warm_start, **kw
+        sigma_levels=levels, warm_start=warm_start,
+        accel=accel_kw["accel"] == "on", theta=accel_kw["theta"], **kw
     )

@@ -45,6 +45,8 @@ EVENT_TYPES = (
     "compile",          # one finished XLA compile (analysis/sanitize.py
                         # bridge) — the compile-once invariant, observable
     "host_transfer",    # one sanctioned device→host fetch (intended_fetch)
+    "momentum_restart", # --accel: a gap rise reset the outer momentum
+    "theta_stage",      # --accel: the Θ local-accuracy ladder stepped up
 )
 
 
@@ -272,23 +274,31 @@ class DeviceTap:
     (the parity the tests pin).
 
     Row layout (solvers/base.py ``_build_device_run``):
-    ``[primal, gap, test_err, sigma_stage, stall]`` — gap/test_err NaN
-    when not applicable, sigma_stage NaN outside σ′-anneal runs.
+    ``[primal, gap, test_err, sigma_stage, stall, theta_stage,
+    restarts]`` — gap/test_err NaN when not applicable, sigma_stage NaN
+    outside σ′-anneal runs, theta_stage/restarts NaN outside ``--accel``
+    runs (and absent entirely on pre-widening 5-col rows, which decode
+    unchanged).
 
-    ``init_stage`` seeds backoff detection with the stage the state
-    ENTERED this dispatch at (the sched leaf rides super-block
-    boundaries), so a resumed or multi-block run never fabricates a
-    backoff for its first eval.
+    ``init_stage`` / ``init_theta_stage`` / ``init_restarts`` seed
+    transition detection with the values the state ENTERED this dispatch
+    at (the sched leaf rides super-block boundaries), so a resumed or
+    multi-block run never fabricates a backoff / Θ-step / restart event
+    for its first eval.
     """
 
     def __init__(self, bus, algorithm: str, start_round: int, cadence: int,
-                 sigma_levels=None, init_stage=None):
+                 sigma_levels=None, init_stage=None, theta_hs=None,
+                 init_theta_stage=None, init_restarts=None):
         self.bus = bus
         self.algorithm = algorithm
         self.start_round = start_round
         self.cadence = cadence
         self.levels = sigma_levels
         self._prev_stage = init_stage
+        self.theta_hs = theta_hs
+        self._prev_theta = init_theta_stage
+        self._prev_restarts = init_restarts
         self.count = 0
 
     def __call__(self, i, row):
@@ -314,4 +324,23 @@ class DeviceTap:
             )
         if stage is not None:
             self._prev_stage = stage
+        if r.shape[0] >= 7:
+            theta_f, restarts_f = float(r[5]), float(r[6])
+            theta = None if math.isnan(theta_f) else int(theta_f)
+            restarts = None if math.isnan(restarts_f) else int(restarts_f)
+            if (restarts is not None and self._prev_restarts is not None
+                    and restarts > self._prev_restarts):
+                self.bus.emit("momentum_restart", algorithm=self.algorithm,
+                              t=t, restarts_total=restarts)
+            if restarts is not None:
+                self._prev_restarts = restarts
+            if (theta is not None and self._prev_theta is not None
+                    and theta != self._prev_theta):
+                self.bus.emit(
+                    "theta_stage", algorithm=self.algorithm, t=t,
+                    stage=theta,
+                    h=(self.theta_hs[theta]
+                       if self.theta_hs is not None else None))
+            if theta is not None:
+                self._prev_theta = theta
         self.count += 1

@@ -10,6 +10,10 @@ run that is otherwise one opaque device dispatch:
 - ``cocoa_evals_total``         counter — debugIter-cadence evaluations
 - ``cocoa_sigma_backoffs_total``counter — σ′ anneal backoffs
 - ``cocoa_restarts_total``      counter — trial reruns + gang restarts
+- ``cocoa_momentum_restarts_total`` counter — --accel gap-monitored
+  momentum restarts (the extrapolation reset to the certified iterate)
+- ``cocoa_theta_stage``         gauge   — --accel Θ local-accuracy ladder
+  stage currently in effect (inner-step count rises with it)
 - ``cocoa_compiles_total``      counter — finished XLA compiles (the
   analysis/sanitize.py bridge).  The sanitizer invariant made
   observable: after warmup this must flatline — growth mid-run means a
@@ -43,6 +47,8 @@ class MetricsWriter:
         self.evals_total = 0
         self.sigma_backoffs_total = 0
         self.restarts_total = 0
+        self.momentum_restarts_total = 0
+        self.theta_stage = None
         self.compiles_total = 0
         self.host_transfers_total = 0
         self.last_gap = None
@@ -91,6 +97,10 @@ class MetricsWriter:
             self.sigma_backoffs_total += 1
         elif ev == "restart":
             self.restarts_total += 1
+        elif ev == "momentum_restart":
+            self.momentum_restarts_total += 1
+        elif ev == "theta_stage":
+            self.theta_stage = rec.get("stage")
         elif ev == "compile":
             self.compiles_total += 1
         elif ev == "host_transfer":
@@ -107,11 +117,16 @@ class MetricsWriter:
             f"cocoa_sigma_backoffs_total {self.sigma_backoffs_total}",
             "# TYPE cocoa_restarts_total counter",
             f"cocoa_restarts_total {self.restarts_total}",
+            "# TYPE cocoa_momentum_restarts_total counter",
+            f"cocoa_momentum_restarts_total {self.momentum_restarts_total}",
             "# TYPE cocoa_compiles_total counter",
             f"cocoa_compiles_total {self.compiles_total}",
             "# TYPE cocoa_host_transfers_total counter",
             f"cocoa_host_transfers_total {self.host_transfers_total}",
         ]
+        if self.theta_stage is not None:
+            lines += ["# TYPE cocoa_theta_stage gauge",
+                      f"cocoa_theta_stage {self.theta_stage}"]
         if self.last_gap is not None:
             lines += ["# TYPE cocoa_last_gap gauge",
                       f"cocoa_last_gap {self.last_gap!r}"]

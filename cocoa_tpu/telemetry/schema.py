@@ -59,6 +59,14 @@ EVENT_FIELDS = {
     # cocoa_compiles_total / cocoa_host_transfers_total counters
     "compile": {"name": (str,), "seconds": _NUM},
     "host_transfer": {"label": (str,)},
+    # the accelerated outer loop (--accel, solvers/base.py): a
+    # gap-monitored momentum restart / a Θ local-accuracy ladder step —
+    # emitted identically by the live io_callback stream and the fetch
+    # replay (DeviceTap) and by the host-stepped drivers' twin
+    "momentum_restart": {"algorithm": (str,), "t": (int,),
+                         "restarts_total": (int,)},
+    "theta_stage": {"algorithm": (str,), "t": (int,), "stage": (int,),
+                    "h": (int, type(None))},
 }
 
 TRAJ_RECORD_FIELDS = {
@@ -100,6 +108,12 @@ RESULTS_FIELDS = {
     "hbm_bound_pct": _NUM, "bound": (str,),
     # h / gap_target are numeric but legacy rows carry e.g. "n/a"
     "h": (int, str), "gap_target": (int, float, str),
+    # the accelerated outer loop A/B row (--accel, benchmarks/run.py):
+    # control rounds, measured ratio, and the theoretical Nesterov floor
+    # (perf.predict_accel_rounds)
+    "control_rounds": (int,), "rounds_ratio": _NUM,
+    "accel_floor_rounds": (int,), "stopped": (str, type(None)),
+    "sigma_ladder": (str,),
 }
 
 

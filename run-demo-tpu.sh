@@ -13,7 +13,16 @@
 # aggregation bound is worst-case — auto tries K/2 (which HALVED the
 # certified comm-rounds on the rcv1 config) and falls back to the safe K
 # if the divergence guard fires, so a wrong guess costs ~12 evals, not
-# the round budget (benchmarks/SWEEPS.md).
+# the round budget (benchmarks/SWEEPS.md).  Append
+# --accel=on --theta=adaptive for the round-12 accelerated outer loop:
+# a secant extrapolation of the dual at eval-window boundaries with a
+# gap-monitored restart (the rounds themselves are unmodified CoCoA+ and
+# the exact gap evaluation stays the certificate — measured 1.76x fewer
+# comm rounds to the same gap on rcv1-synth at the safe σ′), plus the adaptive
+# local-accuracy ladder — early rounds run H/2 inner steps, tightening
+# to the full H near the target, resolved on device from the gap
+# estimate (docs/DESIGN.md "Accelerated outer loop"; A/B'd in
+# benchmarks/RESULTS.md and SWEEPS.md).
 cd "$(dirname "$0")"
 exec python -m cocoa_tpu.cli \
   --trainFile=data/small_train.dat \

@@ -1,0 +1,461 @@
+"""The accelerated outer loop (--accel / --theta, round 12).
+
+Secant (Anderson-1) extrapolation of the DUAL at eval-window boundaries:
+the drivers bank the two previous eval-boundary α snapshots in a
+(2, K, n_shard) ``hist`` state leaf; once two consecutive improving
+windows are banked, the next chunk opens with the jump α ← α + c·(α−h2)
+— c = ρ/(1−ρ) signed and data-derived from the window displacements'
+autocorrelation (base.secant_coef) — clipped back into the dual box,
+with w advanced by the EXACT correspondence update Σ y·Δα·x/(λn)
+(ops/rows.shards_axpy).  The certified pair (w, α) therefore stays a
+feasible primal-dual pair and the unmodified duality-gap evaluation
+stays the certificate; a gap rise at an eval boundary RESTARTS the bank.
+``--theta=adaptive`` adds the Θ local-accuracy ladder: per-round
+inner-step counts resolved on device from the current gap estimate
+through the same statically-specialized ``lax.switch`` machinery as the
+σ′ anneal stages.
+
+What these tests pin:
+
+- ``--accel=off`` is BIT-IDENTICAL to the pre-acceleration code across
+  all three drive modes (per-round, host-chunked, device loop);
+- the host-chunked and device-loop accelerated drivers make identical
+  decisions and produce identical states (accel_host_step is the device
+  loop's f32 bit-twin);
+- a mid-momentum checkpoint resume (hist leaf + extended sched slots) is
+  bit-identical to the uninterrupted run;
+- the typed ``momentum_restart`` / ``theta_stage`` events flow through
+  the bus identically on the host and device paths, and the sched-leaf
+  accel machinery (bank/arm/jump rule, Θ ladder, restart action)
+  matches its host twin slot for slot;
+- the flag surface validations.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cocoa_tpu import checkpoint as ckpt_lib
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.sharding import shard_dataset
+from cocoa_tpu.data.synth import synth_sparse
+from cocoa_tpu.solvers import base, run_cocoa
+from cocoa_tpu.telemetry import events as tele_events
+
+
+@pytest.fixture(autouse=True)
+def clean_bus():
+    tele_events.get_bus().reset()
+    yield tele_events.get_bus()
+    tele_events.get_bus().reset()
+
+
+def _ds(n=512, d=128, k=4, seed=3):
+    data = synth_sparse(n, d, nnz_mean=12, seed=seed)
+    return shard_dataset(data, k=k, layout="dense", dtype=jnp.float32), data.n
+
+
+def _run(ds, n, accel=None, theta=None, num_rounds=100, lam=1e-2,
+         gap_target=1e-6, debug_iter=10, **kw):
+    params = Params(n=n, num_rounds=num_rounds, local_iters=16, lam=lam)
+    debug = DebugParams(debug_iter=debug_iter, seed=0,
+                        chkpt_iter=kw.pop("chkpt_iter", num_rounds + 1),
+                        chkpt_dir=kw.pop("chkpt_dir", ""))
+    return run_cocoa(ds, params, debug, plus=True, quiet=True, math="fast",
+                     rng="permuted", gap_target=gap_target, accel=accel,
+                     theta=theta, **kw)
+
+
+# --- unit: the schedule arithmetic ------------------------------------------
+
+
+def test_theta_ladder():
+    assert base.theta_ladder(253, False) == (253,)
+    # the ladder starts at H/2 — an H/4 rung was measured to COST rounds
+    # (the early fast-decay rounds are productive; solvers/base.py note)
+    assert base.theta_ladder(253, True) == (126, 253)
+    assert base.theta_ladder(16, True) == (8, 16)
+    # tiny H collapses duplicate rungs, the full H always last
+    assert base.theta_ladder(2, True) == (1, 2)
+    assert base.theta_ladder(1, True) == (1,)
+
+
+def test_sched_init_array_accel_shapes():
+    s = np.asarray(base.sched_init_array(7, accel=True))
+    assert s.shape == (base.SCHED_LEN + base.ACCEL_LEN,)
+    assert s[4] == 7.0
+    assert s[base.A_HIST] == 0.0 and s[base.A_JUMP] == 0.0
+    assert np.isinf(s[base.A_LASTGAP]) and s[base.A_RESTARTS] == 0.0
+    # a plain (5,) restore under accel gains fresh accel slots
+    plain = np.asarray(base.sched_init_array(3))
+    ext = np.asarray(base.sched_init_array(3, sched_init=plain, accel=True))
+    np.testing.assert_array_equal(ext[:base.SCHED_LEN], plain)
+    assert ext.shape == (base.SCHED_LEN + base.ACCEL_LEN,)
+    # an accel-length restore WITHOUT accel keeps its σ′ head
+    back = np.asarray(base.sched_init_array(3, sched_init=ext))
+    np.testing.assert_array_equal(back, plain)
+    with pytest.raises(ValueError, match="shape"):
+        base.sched_init_array(1, sched_init=np.zeros(9, np.float32))
+
+
+def test_accel_host_step_bank_arm_restart():
+    """The window bookkeeping: improving evals BANK α snapshots; two
+    banked windows ARM the jump for the next chunk head (and freeze the
+    bank); a gap RISE discards the bank (restarts += 1, the bank
+    restarts from this eval's α).  All exact f32 arithmetic."""
+    s = np.asarray(base.sched_init_array(1, accel=True))
+    # first eval: last_gap is inf — bank one window
+    s, restarted, staged = base.accel_host_step(s, 1.0, 1, None)
+    assert not restarted and s[base.A_HIST] == 1.0
+    assert s[base.A_JUMP] == 0.0
+    assert s[base.A_LASTGAP] == np.float32(1.0)
+    # second improving eval: two windows banked
+    s, restarted, _ = base.accel_host_step(s, 0.5, 1, None)
+    assert not restarted and s[base.A_HIST] == 2.0
+    assert s[base.A_JUMP] == 0.0
+    # third improving eval: the jump ARMS and the bank is consumed
+    s, restarted, _ = base.accel_host_step(s, 0.25, 1, None)
+    assert not restarted
+    assert s[base.A_JUMP] == 1.0 and s[base.A_HIST] == 0.0
+    # the chunk head clears the armed flag when it takes the jump
+    s[base.A_JUMP] = 0.0
+    # a RISE restarts: bank discarded, restarted from this eval's α
+    s, restarted, _ = base.accel_host_step(s, 0.6, 1, None)
+    assert restarted and s[base.A_HIST] == 1.0
+    assert s[base.A_JUMP] == 0.0 and s[base.A_RESTARTS] == 1.0
+
+
+def test_secant_coef():
+    """The jump coefficient: c = ρ/(1−min(ρ, cap)) clipped to
+    [ACCEL_CMIN, ACCEL_CMAX] — averaging on oscillation, capped
+    extrapolation on drift."""
+    # pure oscillation ρ = −1 → pairwise averaging c = −0.5 exactly
+    assert base.secant_coef(np, np.float32(-1.0)) == np.float32(-0.5)
+    # no correlation → no jump
+    assert base.secant_coef(np, np.float32(0.0)) == np.float32(0.0)
+    # measured rcv1-synth drift ρ ≈ 0.73 → c ≈ 2.7, inside the cap
+    c = base.secant_coef(np, np.float32(0.73))
+    assert np.isclose(float(c), 0.73 / 0.27, rtol=1e-5)
+    # ρ → 1 pole is capped then clipped to CMAX
+    assert base.secant_coef(np, np.float32(0.999)) == \
+        np.float32(base.ACCEL_CMAX)
+    # strong anti-correlation clips at CMIN
+    assert base.secant_coef(np, np.float32(-5.0)) == \
+        np.float32(base.ACCEL_CMIN)
+
+
+def test_accel_host_step_theta_ladder_advance():
+    """Θ advances on the halve-per-eval stall watch, jumps to the final
+    stage near the target, and is inert at the last rung."""
+    tgt = 1e-4
+    s = np.asarray(base.sched_init_array(1, accel=True))
+    # fast-decay phase: gap halves every eval — the loose stage holds
+    s, _, staged = base.accel_host_step(s, 8.0, 3, tgt)
+    assert not staged and s[base.A_TH_STAGE] == 0.0
+    s, _, staged = base.accel_host_step(s, 3.0, 3, tgt)
+    assert not staged
+    # decay slows below 2x/eval -> one miss fires the watch
+    s, _, staged = base.accel_host_step(s, 2.0, 3, tgt)
+    assert staged and s[base.A_TH_STAGE] == 1.0
+    assert s[base.A_TH_STALL] == 0.0 and np.isinf(s[base.A_TH_BEST])
+    # near the target: jump straight to the final stage
+    s, _, staged = base.accel_host_step(s, 9e-4, 3, tgt)
+    assert staged and s[base.A_TH_STAGE] == 2.0
+    # final rung: the ladder is inert
+    s, _, staged = base.accel_host_step(s, 8.9e-4, 3, tgt)
+    assert not staged and s[base.A_TH_STAGE] == 2.0
+
+
+# --- accel=off is the pre-acceleration code, bit for bit --------------------
+
+
+@pytest.mark.parametrize("mode", ["per_round", "chunked", "device_loop"])
+def test_accel_off_bit_identical_all_modes(mode):
+    ds, n = _ds()
+    # the per-round driver pays a per-round dispatch+eval cost (~0.5 s/
+    # round on the CI box) — 30 rounds cross three eval boundaries, which
+    # is all the two-arm bit-identity needs; the cheap drivers keep the
+    # full 100 rounds of schedule evolution
+    kw = dict(num_rounds=30)
+    if mode == "chunked":
+        kw = dict(scan_chunk=1)
+    elif mode == "device_loop":
+        kw = dict(device_loop=True)
+    w_o, a_o, t_o = _run(ds, n, accel="off", **kw)
+    w_p, a_p, t_p = _run(ds, n, **kw)
+    np.testing.assert_array_equal(np.asarray(w_o), np.asarray(w_p))
+    np.testing.assert_array_equal(np.asarray(a_o), np.asarray(a_p))
+    assert [r.round for r in t_o.records] == [r.round for r in t_p.records]
+
+
+def test_accel_auto_resolution():
+    """auto = on for gap-targeted CoCoA+ runs, off without a target (the
+    fixed-round benchmark paths stay bit-comparable)."""
+    ds, n = _ds()
+    # targetless runs take the slow per-round driver — 30 rounds suffice
+    # for the two-arm identity (see test_accel_off_bit_identical_all_modes)
+    w_a, _, _ = _run(ds, n, accel="auto", gap_target=None, num_rounds=30)
+    w_p, _, _ = _run(ds, n, gap_target=None, num_rounds=30)
+    np.testing.assert_array_equal(np.asarray(w_a), np.asarray(w_p))
+    # with a target, auto accelerates: the trajectory departs from plain
+    w_on, _, _ = _run(ds, n, accel="on", num_rounds=60)
+    w_au, _, _ = _run(ds, n, accel="auto", num_rounds=60)
+    np.testing.assert_array_equal(np.asarray(w_on), np.asarray(w_au))
+
+
+# --- host/device parity ------------------------------------------------------
+
+
+@pytest.mark.parametrize("theta", ["fixed", "adaptive"])
+def test_accel_device_loop_identical_to_host(theta):
+    ds, n = _ds()
+    w_h, a_h, t_h = _run(ds, n, accel="on", theta=theta)
+    w_d, a_d, t_d = _run(ds, n, accel="on", theta=theta, device_loop=True)
+    np.testing.assert_array_equal(np.asarray(w_h), np.asarray(w_d))
+    np.testing.assert_array_equal(np.asarray(a_h), np.asarray(a_d))
+    assert [r.round for r in t_h.records] == [r.round for r in t_d.records]
+
+
+# --- checkpoint / resume -----------------------------------------------------
+
+
+def test_accel_checkpoint_carries_hist_and_extended_sched(tmp_path):
+    ds, n = _ds()
+    _run(ds, n, accel="on", theta="adaptive", chkpt_dir=str(tmp_path),
+         chkpt_iter=50, device_loop=True)
+    path = ckpt_lib.latest(str(tmp_path), "CoCoA+")
+    assert path is not None
+    meta, arrays = ckpt_lib.load_full(path)
+    assert "hist" in arrays
+    assert arrays["hist"].shape == (2,) + arrays["alpha"].shape
+    assert len(meta["sched"]) == base.SCHED_LEN + base.ACCEL_LEN
+
+
+@pytest.mark.parametrize("device_loop", [False, True],
+                         ids=["chunked", "deviceloop"])
+def test_accel_resume_mid_momentum_bit_identical(tmp_path, device_loop):
+    """Resume from a mid-run checkpoint (momentum β and Θ watch slots
+    mid-flight): the restored run must reproduce the uninterrupted one
+    bit for bit."""
+    ds, n = _ds()
+    ck = str(tmp_path)
+    w0, a0, t0 = _run(ds, n, accel="on", theta="adaptive", chkpt_dir=ck,
+                      chkpt_iter=50, device_loop=device_loop)
+    path = os.path.join(ck, "CoCoA+-r000050.npz")
+    meta, arrays = ckpt_lib.load_full(path)
+    sched = np.asarray(meta["sched"], np.float32)
+    assert sched.shape == (base.SCHED_LEN + base.ACCEL_LEN,)
+    w_r, a_r, t_r = _run(
+        ds, n, accel="on", theta="adaptive", device_loop=device_loop,
+        w_init=arrays["w"], alpha_init=arrays["alpha"],
+        hist_init=arrays["hist"], sched_init=sched,
+        start_round=meta["round"] + 1)
+    np.testing.assert_array_equal(np.asarray(w0), np.asarray(w_r))
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a_r))
+
+
+def test_accel_off_resumes_accel_checkpoint(tmp_path):
+    """An accel checkpoint restored into an --accel=off run keeps the σ′
+    head of the sched vector and simply drops the momentum state (any
+    (w, α) is a valid primal-dual pair)."""
+    ds, n = _ds()
+    ck = str(tmp_path)
+    _run(ds, n, accel="on", chkpt_dir=ck, chkpt_iter=50)
+    path = os.path.join(ck, "CoCoA+-r000050.npz")
+    meta, arrays = ckpt_lib.load_full(path)
+    w_r, a_r, t_r = _run(
+        ds, n, accel="off", w_init=arrays["w"],
+        alpha_init=arrays["alpha"], start_round=meta["round"] + 1)
+    assert t_r.records, "resumed run must keep evaluating"
+
+
+# --- telemetry ---------------------------------------------------------------
+
+
+def _collect():
+    events = []
+    tele_events.get_bus().subscribe(events.append)
+    return events
+
+
+def _accel_event_run(device_loop):
+    """A run engineered to restart at least once: λ small enough that the
+    gap trajectory is non-monotone under extrapolation."""
+    ds, n = _ds(n=1024, d=256, k=4, seed=0)
+    return _run(ds, n, accel="on", theta="adaptive", lam=1e-4,
+                num_rounds=200, debug_iter=5, gap_target=1e-5,
+                device_loop=device_loop)
+
+
+def test_accel_events_host_vs_device_identical():
+    """momentum_restart / theta_stage events: same count, same rounds,
+    same payloads on the host-chunked and device-loop paths (the
+    DeviceTap decode vs the host twin's flags)."""
+    def strip(events):
+        return [
+            {k: v for k, v in e.items() if k not in ("seq", "ts", "pid")}
+            for e in events
+            if e["event"] in ("momentum_restart", "theta_stage")]
+
+    ev_h = _collect()
+    _accel_event_run(device_loop=False)
+    host = strip(ev_h)
+    tele_events.get_bus().reset()
+    ev_d = _collect()
+    _accel_event_run(device_loop=True)
+    dev = strip(ev_d)
+    assert host == dev
+    assert any(e["event"] == "theta_stage" for e in host), \
+        "the fixture must exercise at least one Θ step"
+
+
+def test_accel_events_schema_and_metrics(tmp_path):
+    from cocoa_tpu.telemetry import schema as tele_schema
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    jsonl = str(tmp_path / "events.jsonl")
+    metrics_path = str(tmp_path / "metrics.prom")
+    bus = tele_events.get_bus()
+    bus.configure(jsonl_path=jsonl, metrics_path=metrics_path)
+    _, _, traj = _accel_event_run(device_loop=True)
+    assert tele_schema.check_file(jsonl) == []
+    text = open(metrics_path).read()
+    assert "cocoa_momentum_restarts_total" in text
+    import re
+    n_restarts = int(re.search(
+        r"cocoa_momentum_restarts_total (\d+)", text).group(1))
+    with open(jsonl) as f:
+        restart_events = [ln for ln in f
+                          if '"momentum_restart"' in ln]
+    assert n_restarts == len(restart_events)
+    if any('"theta_stage"' in ln for ln in open(jsonl)):
+        assert "cocoa_theta_stage" in text
+
+
+def test_accel_telemetry_on_off_bit_identical(tmp_path):
+    """The tap/stream machinery is side-effect-only: an accel run with
+    every sink active produces bit-identical (w, α) to a silent one."""
+    w_s, a_s, _ = _accel_event_run(device_loop=True)
+    bus = tele_events.get_bus()
+    bus.configure(jsonl_path=str(tmp_path / "e.jsonl"),
+                  metrics_path=str(tmp_path / "m.prom"))
+    w_t, a_t, _ = _accel_event_run(device_loop=True)
+    np.testing.assert_array_equal(np.asarray(w_s), np.asarray(w_t))
+    np.testing.assert_array_equal(np.asarray(a_s), np.asarray(a_t))
+
+
+# --- validations -------------------------------------------------------------
+
+
+def test_accel_validations():
+    ds, n = _ds()
+    params = Params(n=n, num_rounds=20, local_iters=8, lam=1e-2)
+    debug = DebugParams(debug_iter=5, seed=0)
+    with pytest.raises(ValueError, match="auto|on|off"):
+        run_cocoa(ds, params, debug, plus=True, quiet=True, accel="fast")
+    with pytest.raises(ValueError, match="fixed|adaptive"):
+        run_cocoa(ds, params, debug, plus=True, quiet=True, accel="on",
+                  theta="warp", gap_target=1e-6)
+    # theta=adaptive needs an accelerated run
+    with pytest.raises(ValueError, match="accel"):
+        run_cocoa(ds, params, debug, plus=True, quiet=True,
+                  theta="adaptive", gap_target=1e-6)
+    # the trial control stays untouched
+    p_auto = dataclasses.replace(params, sigma="auto")
+    with pytest.raises(ValueError, match="trial"):
+        run_cocoa(ds, p_auto, debug, plus=True, quiet=True, accel="on",
+                  sigma_schedule="trial", gap_target=1e-6)
+    # momentum restarts ride the eval cadence
+    with pytest.raises(ValueError, match="debugIter"):
+        run_cocoa(ds, params, DebugParams(debug_iter=0, seed=0),
+                  plus=True, quiet=True, accel="on", gap_target=1e-6)
+
+
+def test_accel_combines_with_sigma_anneal():
+    """accel + σ′ anneal share one device loop: the branch table is the
+    (σ′ stage × Θ stage) product and both selectors ride the sched
+    leaf."""
+    ds, n = _ds()
+    params = Params(n=n, num_rounds=100, local_iters=16, lam=1e-2,
+                    sigma="auto")
+    debug = DebugParams(debug_iter=10, seed=0)
+    w, alpha, traj = run_cocoa(ds, params, debug, plus=True, quiet=True,
+                               math="fast", rng="permuted",
+                               gap_target=1e-6, accel="on",
+                               theta="adaptive", device_loop=True)
+    assert traj.records[-1].sigma is not None
+
+
+def test_accel_with_hot_cols_hybrid_layout():
+    """--accel on a hybrid (--hotCols) sparse layout: the secant jump's
+    transpose-apply must scatter the hot-panel contribution as a summed
+    (n_hot,) update (regression: a per-shard (K, n_hot) einsum raised a
+    broadcast error at trace time, so accel+hotCols could never run)."""
+    data = synth_sparse(512, 128, nnz_mean=12, seed=3)
+    ds = shard_dataset(data, k=4, layout="sparse", hot_cols=16)
+    w, alpha, traj = run_cocoa(
+        ds, Params(n=data.n, num_rounds=60, local_iters=16, lam=1e-2),
+        DebugParams(debug_iter=10, seed=0), plus=True, quiet=True,
+        math="fast", rng="permuted", gap_target=1e-6, accel="on",
+        device_loop=True)
+    assert np.isfinite(np.asarray(w)).all()
+    gaps = [r.gap for r in traj.records if r.gap is not None]
+    assert gaps and np.isfinite(gaps[-1]) and gaps[-1] < gaps[0]
+
+
+def test_shards_axpy_hybrid_matches_dense():
+    """shards_axpy on the hybrid split == the dense einsum on the same
+    data (the hot/cold split permutes per-coordinate sums only)."""
+    from cocoa_tpu.ops import rows as _rows
+
+    data = synth_sparse(256, 64, nnz_mean=10, seed=7)
+    dense = shard_dataset(data, k=4, layout="dense")
+    hyb = shard_dataset(data, k=4, layout="sparse", hot_cols=8)
+    coefs = jnp.asarray(
+        np.random.default_rng(0).normal(size=(4, dense.n_shard)),
+        jnp.float32)
+    vec = jnp.zeros((data.num_features,), jnp.float32)
+    out_d = _rows.shards_axpy(coefs, dense.shard_arrays(), vec)
+    out_h = _rows.shards_axpy(coefs, hyb.shard_arrays(), vec)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_h),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_theta_adaptive_degrades_when_accel_auto_resolves_off():
+    """theta=adaptive rides accel=auto: on a run where auto resolves OFF
+    (plain CoCoA — the CLI's run_all second leg), Θ degrades to the full-H
+    schedule instead of raising mid-run; explicit accel=off still
+    rejects the contradiction."""
+    ds, n = _ds()
+    params = Params(n=n, num_rounds=20, local_iters=8, lam=1e-2)
+    debug = DebugParams(debug_iter=5, seed=0)
+    w, alpha, traj = run_cocoa(ds, params, debug, plus=False, quiet=True,
+                               gap_target=1e-6, accel="auto",
+                               theta="adaptive")
+    assert np.isfinite(np.asarray(w)).all()
+    with pytest.raises(ValueError, match="accel"):
+        run_cocoa(ds, params, debug, plus=True, quiet=True,
+                  gap_target=1e-6, accel="off", theta="adaptive")
+
+
+def test_accel_host_step_sigma_seam_caps_bank():
+    """A σ′ anneal backoff at the same eval boundary is a round-map seam
+    exactly like a Θ stage advance: the secant bank caps at the α just
+    banked, and an already-armed jump stays armed."""
+    sched = np.array(base.sched_init_array(1, accel=True), dtype=np.float32)
+    sched[base.A_LASTGAP] = np.float32(1.0)
+    sched[base.A_HIST] = np.float32(1.0)
+    # improving eval + seam: would bank to 2, capped back to 1
+    s, restarted, _ = base.accel_host_step(sched, 0.5, 1, 1e-6, seam=True)
+    assert not restarted and s[base.A_HIST] == np.float32(1.0)
+    assert s[base.A_JUMP] == np.float32(0.0)
+    # armed jump survives the seam (hist already 0 after arming)
+    sched[base.A_HIST] = np.float32(2.0)
+    sched[base.A_LASTGAP] = np.float32(1.0)
+    s, _, _ = base.accel_host_step(sched, 0.5, 1, 1e-6, seam=True)
+    assert s[base.A_JUMP] == np.float32(1.0)
+    assert s[base.A_HIST] == np.float32(0.0)

@@ -174,3 +174,34 @@ def test_latency_predictor_calibration_and_hybrid_target():
 def test_unknown_path_rejected():
     with pytest.raises(ValueError, match="unknown path"):
         perf.sdca_round_model(10, 10, 1, 1, path="warp")
+
+
+def test_predict_accel_rounds_fixture():
+    """Hand-computed accelerated floor (perf.py predict_accel_rounds).
+
+    Fixture: gap0 = 1, target = e⁻⁸ (so decades = −8 exactly), plain
+    rounds = 800 ⇒ per-round rate q = e^(−8/800) = e^(−0.01)
+    = 0.990049834…; 1 − q = 0.00995016625…, √(1−q) = 0.0997505201…,
+    q_acc = 0.9002494799…, ln(q_acc) = −0.105083567…;
+    −8 / ln(q_acc) = 76.1299…, ×1.1 restart inflation = 83.74…,
+    ceil = 84."""
+    import math
+
+    gap0, target, r_plain = 1.0, math.exp(-8.0), 800
+    assert perf.predict_accel_rounds(r_plain, gap0, target) == 84
+    # no restart inflation: ceil(76.1299...) = 77
+    assert perf.predict_accel_rounds(r_plain, gap0, target,
+                                     restart_overhead=0.0) == 77
+    # the floor is a STRICT improvement and scales with conditioning:
+    # a slower plain run (worse q) accelerates by a bigger factor
+    fast = perf.predict_accel_rounds(100, 1.0, 1e-4)
+    slow = perf.predict_accel_rounds(1600, 1.0, 1e-4)
+    assert fast < 100 and slow < 1600
+    assert 1600 / slow > 100 / fast
+
+
+def test_predict_accel_rounds_validations():
+    with pytest.raises(ValueError, match="gap_target"):
+        perf.predict_accel_rounds(100, 1e-4, 1.0)
+    with pytest.raises(ValueError, match="rounds_plain"):
+        perf.predict_accel_rounds(0, 1.0, 1e-4)
