@@ -274,6 +274,63 @@ def predict_accel_rounds(rounds_plain, gap0, gap_target, *,
                          * (1.0 + restart_overhead)))
 
 
+# Calibrated LIBSVM text-parse throughput, bytes/s per process (the
+# strtod-bound native scanner measured on the container's single core at
+# rcv1-synth scale; the Python fallback is ~20x slower and the model is
+# read against the native path).  Both ingest passes share this rate —
+# pass 1 parses-and-drops, pass 2 parses-and-keeps.
+PARSE_BYTES_PER_S = 90e6
+# jax.distributed KV-store exchange throughput for the pass-1 partials
+# (base64 through the coordinator's gRPC store — small payloads, so this
+# is a latency-flavored effective rate, not a link speed)
+KV_BYTES_PER_S = 50e6
+
+
+def csr_host_bytes(n, nnz):
+    """Host bytes of a parsed LIBSVM CSR: f64 labels + i64 indptr +
+    i32 indices + f64 values (data/libsvm.LibsvmData)."""
+    return 8 * n + 8 * (n + 1) + 4 * nnz + 8 * nnz
+
+
+def ingest_model(file_bytes, n, nnz, processes, *, mode, d):
+    """Per-PROCESS cost model of one ingest (benchmarks/run.py ``ingest``
+    A/B row; docs/DESIGN.md §12 RSS accounting).
+
+    - ``whole``: every process reads and parses the ENTIRE file once and
+      holds the full host CSR — P redundant parses, full-dataset RSS per
+      process, no exchange.
+    - ``stream``: pass 1 range-parses this process's 1/P of the file
+      (stats kept, rows dropped), the partial index/histogram is
+      exchanged over the KV store (~(8·n + 8·d) per process, gathered
+      from P−1 peers), pass 2 parses the ~1/P of the file its own shards
+      occupy — so ~2/P of the file is parsed per process and the held
+      CSR shrinks to ~1/P of the dataset plus the global index.
+
+    Returns ``{bytes_read, parse_seconds, csr_peak_bytes}``; seconds are
+    parse work at :data:`PARSE_BYTES_PER_S` plus the exchange at
+    :data:`KV_BYTES_PER_S`.  The predicted stream:whole ratios — wallclock
+    ~2/P, resident CSR ~1/P + index — are what the measured bench row is
+    read against (RESULTS.md fixed-cost breakdown).
+    """
+    if mode not in ("whole", "stream"):
+        raise ValueError(f"mode must be whole|stream, got {mode!r}")
+    index_bytes = 8 * (n + 1) + 8 * n + 8 * d  # row_off + row_nnz + hist
+    if mode == "whole":
+        return dict(
+            bytes_read=float(file_bytes),
+            parse_seconds=file_bytes / PARSE_BYTES_PER_S,
+            csr_peak_bytes=float(csr_host_bytes(n, nnz)),
+        )
+    share = file_bytes / processes
+    exchange = (processes - 1) * (8 * n + 8 * d)
+    return dict(
+        bytes_read=2.0 * share,
+        parse_seconds=(2.0 * share / PARSE_BYTES_PER_S
+                       + exchange / KV_BYTES_PER_S),
+        csr_peak_bytes=(csr_host_bytes(n, nnz) / processes + index_bytes),
+    )
+
+
 def eval_flops(n, d, *, nnz=None, test_n=0):
     """One duality-gap + test-error evaluation: a full-data margins pass
     (2·n·nnz), the O(n) loss reductions, and the test pass."""

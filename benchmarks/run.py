@@ -1054,6 +1054,174 @@ def bench_lasso(results, perf_rows, quick):
             ))
 
 
+# Each ingest bench worker is a PLAIN subprocess (no jax import): its
+# ru_maxrss then reflects the parse artifacts — the cost the A/B is
+# about — not the ~350 MB backend baseline.  Device placement is
+# identical in both modes (HBM on a real TPU, excluded here); the worker
+# replays exactly the per-process parse work of the two ingest paths
+# over ranges the parent derives from the real pass-1 index.
+_INGEST_WORKER = r"""
+import importlib.util, json, os, resource, sys, time, types
+spec = json.load(open(sys.argv[1]))
+import numpy as np
+
+# load the parser modules by FILE PATH, not through the package: the
+# cocoa_tpu package __init__ imports jax, whose ~350 MB import peak
+# would swallow the parse-artifact RSS this worker exists to measure
+def _load(name, relpath):
+    s = importlib.util.spec_from_file_location(
+        name, os.path.join(spec["root"], relpath))
+    m = importlib.util.module_from_spec(s)
+    sys.modules[name] = m
+    s.loader.exec_module(m)
+    return m
+
+sys.modules["cocoa_tpu"] = types.ModuleType("cocoa_tpu")
+sys.modules["cocoa_tpu.data"] = types.ModuleType("cocoa_tpu.data")
+_libsvm = _load("cocoa_tpu.data.libsvm", "cocoa_tpu/data/libsvm.py")
+sys.modules["cocoa_tpu.data"].native_loader = _load(
+    "cocoa_tpu.data.native_loader", "cocoa_tpu/data/native_loader.py")
+load_libsvm, load_libsvm_range = _libsvm.load_libsvm, _libsvm.load_libsvm_range
+
+def rss_kb():
+    # current resident set from statm — ru_maxrss is unusable here (this
+    # kernel carries the PARENT's high-water mark across fork+exec).
+    # Sampled while the parse artifacts are live, so it reads the
+    # held-CSR peak the A/B is about.
+    pages = int(open("/proc/self/statm").read().split()[1])
+    return pages * os.sysconf("SC_PAGE_SIZE") // 1024
+
+path, d, mode = spec["path"], spec["d"], spec["mode"]
+rss0 = rss_kb()
+rss_peak = 0
+t0 = time.perf_counter()
+bytes_read = rows = nnz = 0
+if mode == "whole":
+    # whole-file ingest: every process parses the entire file and holds
+    # the full CSR before slicing out its shards (load_libsvm ->
+    # _shard_dataset_distributed)
+    data = load_libsvm(path, d)
+    rss_peak = rss_kb()
+    rows, nnz = data.n, int(data.indptr[-1])
+    bytes_read = os.path.getsize(path)
+else:
+    # pass 1 (data/ingest.build_index): windowed range scan of this
+    # process's 1/P — stats kept, rows dropped
+    lo, hi = spec["scan_range"]
+    hist = np.zeros(d, np.int64)
+    nnz_parts = []
+    w = lo
+    while w < hi:
+        piece, off = load_libsvm_range(path, d, w, min(w + spec["window"], hi))
+        hist += np.bincount(piece.indices, minlength=d)
+        nnz_parts.append(np.diff(piece.indptr))
+        rss_peak = max(rss_peak, rss_kb())
+        w = min(w + spec["window"], hi)
+    bytes_read += hi - lo
+    # pass 2 (stream_shard_dataset): parse ONLY this process's local
+    # devices' shard byte ranges, held one device-piece at a time
+    for blo, bhi in spec["piece_ranges"]:
+        piece, _ = load_libsvm_range(path, d, blo, bhi)
+        rss_peak = max(rss_peak, rss_kb())
+        rows += piece.n
+        nnz += len(piece.values)
+        bytes_read += bhi - blo
+secs = time.perf_counter() - t0
+json.dump(dict(secs=secs, bytes_read=bytes_read, rows=rows, nnz=nnz,
+               rss0_kb=rss0, rss1_kb=rss_peak),
+          open(spec["out"], "w"))
+"""
+
+
+def bench_ingest(results, quick, processes=(2, 8)):
+    """Streaming vs whole-file ingest A/B at rcv1-synth scale (the ISSUE 8
+    acceptance row): per-PROCESS parse wallclock, bytes read, and peak
+    host RSS for a P-process run, measured by replaying each process's
+    exact parse work in a clean subprocess.
+
+    ``whole``: every process parses the entire file and holds the full
+    CSR.  ``stream`` (data/ingest.py): pass-1 range scan of 1/P of the
+    file + pass-2 parse of only its own shards' byte ranges.  The
+    wallclock win scales as ~P/2 (at P=2 the streamed path parses the
+    same total bytes, split across passes); the RSS win is the point at
+    P=2 already — the held CSR drops to ~1/P of the dataset plus the
+    index (the ``rss_vs_whole`` column, acceptance bar ≤ ~0.6 at P=2).
+    Model predictions from perf.ingest_model ride each row.
+    """
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    import perf
+    from cocoa_tpu.data.ingest import PASS1_WINDOW, build_index
+    from cocoa_tpu.data.sharding import split_sizes
+    from cocoa_tpu.data.synth import synth_sparse, write_libsvm
+
+    n, d, nnz_mean, k = ((2024, 4724, 20, 8) if quick
+                        else (20242, 47236, 75, 8))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "rcv1_synth.svm")
+        write_libsvm(synth_sparse(n, d, nnz_mean=nnz_mean, seed=0), path)
+        fsize = os.path.getsize(path)
+        index = build_index(path, d)
+        offsets = np.concatenate([[0], np.cumsum(split_sizes(index.n, k))])
+
+        def run_worker(spec):
+            spec_path = spec["out"] + ".spec"
+            json.dump(spec, open(spec_path, "w"))
+            subprocess.run([_sys.executable, "-c", _INGEST_WORKER,
+                            spec_path], check=True, cwd=tmp)
+            return json.load(open(spec["out"]))
+
+        for nproc in processes:
+            if k % nproc:
+                continue
+            m = k // nproc  # shards multiplexed per process's device
+            rows = {}
+            for mode in ("whole", "stream"):
+                reps = []
+                for p in range(nproc):
+                    r0, r1 = int(offsets[p * m]), int(offsets[(p + 1) * m])
+                    reps.append(run_worker(dict(
+                        root=ROOT, path=path, d=d, mode=mode,
+                        window=PASS1_WINDOW,
+                        scan_range=[p * fsize // nproc,
+                                    (p + 1) * fsize // nproc],
+                        piece_ranges=[[int(index.row_off[r0]),
+                                       int(index.row_off[r1])]],
+                        out=os.path.join(tmp, f"{mode}{nproc}_{p}.json"),
+                    )))
+                pred = perf.ingest_model(fsize, index.n, index.total_nnz,
+                                         nproc, mode=mode, d=d)
+                rows[mode] = row = dict(
+                    config=f"ingest/{mode}-p{nproc}"
+                           + ("(quick)" if quick else ""),
+                    n=index.n, d=d, k=k, mode=mode, processes=nproc,
+                    file_mb=round(fsize / 2**20, 1),
+                    parse_s=round(max(r["secs"] for r in reps), 3),
+                    bytes_read_mb=round(
+                        max(r["bytes_read"] for r in reps) / 2**20, 1),
+                    peak_rss_mb=round(
+                        max(r["rss1_kb"] for r in reps) / 1024, 1),
+                    rss_delta_mb=round(
+                        max(r["rss1_kb"] - r["rss0_kb"] for r in reps)
+                        / 1024, 1),
+                    predicted_parse_s=round(pred["parse_seconds"], 3),
+                    predicted_csr_mb=round(
+                        pred["csr_peak_bytes"] / 2**20, 1),
+                )
+                results.append(row)
+            ratio = (rows["stream"]["rss_delta_mb"]
+                     / max(rows["whole"]["rss_delta_mb"], 1e-9))
+            rows["stream"]["rss_vs_whole"] = round(ratio, 2)
+            print(f"bench: ingest p={nproc} — whole "
+                  f"{rows['whole']['parse_s']}s/"
+                  f"{rows['whole']['rss_delta_mb']}MB vs stream "
+                  f"{rows['stream']['parse_s']}s/"
+                  f"{rows['stream']['rss_delta_mb']}MB "
+                  f"(rss ratio {ratio:.2f}, bar ≤0.6 at p=2)")
+
+
 def write_results(results, perf_rows, out_dir, partial=False, final=False):
     """Full runs own results.jsonl / RESULTS.md (the artifacts BASELINE.md
     cites); --quick / --only runs write to *.partial.* so they can never
@@ -1370,7 +1538,8 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="~10x smaller synthetic sizes (smoke test)")
     ap.add_argument("--only", default="",
-                    help="comma-separated subset: demo,epsilon,rcv1,losses,lasso")
+                    help="comma-separated subset: "
+                         "demo,epsilon,rcv1,losses,lasso,ingest")
     ap.add_argument("--data-dir",
                     default=os.path.join(os.path.dirname(
                         os.path.abspath(__file__)), "data"),
@@ -1412,6 +1581,9 @@ def main():
         flush()
     if only is None or "lasso" in only:
         bench_lasso(results, perf_rows, args.quick)
+        flush()
+    if only is None or "ingest" in only:
+        bench_ingest(results, args.quick)
         flush()
     write_results(results, perf_rows, out_dir, partial=partial, final=True)
     for r in perf_rows:

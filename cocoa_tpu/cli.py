@@ -71,6 +71,21 @@ accepts ``auto``: materialize the dense eval twin only when it fits
 the HBM budget, otherwise (with a hot panel) the certificate margins
 ride the panel matvec + residual stream.
 
+``--ingest=stream|whole|auto`` picks how the LIBSVM text reaches the
+device (data/ingest.py, docs/DESIGN.md §12).  ``whole`` is the original
+path: every process parses the entire file, then slices out its shards.
+``stream`` is the two-pass byte-range pipeline: a parallel index scan
+(1/P of the file per process, partial column histograms assembled over
+the jax.distributed KV store) followed by each process parsing ONLY the
+byte ranges of its local devices' shards, built straight into the
+target layout — multiplexed dp meshes (D < K devices), ``--hotCols``
+and ``--evalDense`` are all first-class, and per-process peak host RSS
+drops to ~1/P of the dataset plus the index.  ``auto`` streams exactly
+where it wins: multi-process svm runs on a dp mesh.  The built shards
+are bit-identical either way (the whole-file build stays the A/B
+control); fp meshes and ``--objective=lasso`` are whole-file only and
+reject ``--ingest=stream`` loudly.
+
 ``--objective=lasso`` switches to the ProxCoCoA+ L1 family
 (solvers/prox_cocoa.py): labels become the regression target b,
 ``--lambda`` the L1 weight, ``--l2`` the optional elastic-net weight;
@@ -100,7 +115,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "blockPipeline", "divergenceGuard",
                 "sigmaSchedule", "warmStart", "accel", "theta",
                 "elastic", "stallTimeout", "evalDense", "hotCols",
-                "metrics", "events", "quiet")  # run-level
+                "ingest", "metrics", "events", "quiet")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -473,12 +488,6 @@ def main(argv=None) -> int:
     run_meta = {"dataset": cfg.train_file, "seed": cfg.seed,
                 "config_hash": telemetry.events.config_hash(cfg_manifest)}
 
-    try:
-        data = load_libsvm(cfg.train_file, cfg.num_features)
-    except (OSError, ValueError) as e:  # missing file, bad numFeatures, ...
-        print(f"error: {e}", file=sys.stderr)
-        return 2
-    n = data.n
     k = cfg.num_splits
 
     # mesh selection: K shards ride a D-device dp mesh whenever D divides K
@@ -553,14 +562,25 @@ def main(argv=None) -> int:
                else str(extras["evalDense"]).lower())
     eval_dense = ed_spec not in ("false", "auto")
 
-    # --hotCols=auto|off|<n>: the hot/cold column split (sparse layout
-    # only, data/hybrid.py).  Resolved HERE — against the measured column
-    # histogram, with the panel's HBM bytes accounted explicitly — so the
-    # run_start manifest records the split the run actually trains on.
+    # --ingest=stream|whole|auto: how the LIBSVM text reaches the device
+    # (data/ingest.py).  Resolved against the mesh/objective BEFORE any
+    # parse so a streamed run never pays a whole-file pass by accident.
+    from cocoa_tpu.data import ingest as ingest_lib
+
+    try:
+        ingest_mode = ingest_lib.resolve_ingest_mode(
+            extras["ingest"], mesh, objective=objective)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
     from cocoa_tpu.data import resolve_hot_cols, resolve_layout
 
     hot_n = 0
     layout_split = None
+    ingest_reports = []
+    data = None
+    ds = test_ds = None
     if objective == "lasso" and extras["hotCols"] is not None:
         # column shards transpose the roles (the shard "rows" ARE
         # columns); a row-space hot panel has no meaning there
@@ -568,42 +588,188 @@ def main(argv=None) -> int:
               "(column shards already partition the feature axis)",
               file=sys.stderr)
         return 2
-    if objective == "svm":
-        resolved_layout = resolve_layout(data, cfg.layout, mesh)
-        if extras["hotCols"] is not None and resolved_layout != "sparse":
-            print("error: --hotCols (the hot/cold column split) only "
-                  "applies to the sparse layout", file=sys.stderr)
-            return 2
-        if resolved_layout == "sparse":
-            try:
-                hot_n, layout_split = resolve_hot_cols(
-                    extras["hotCols"], data, k, dtype)
-            except ValueError as e:
-                print(f"error: {e}", file=sys.stderr)
-                return 2
-            if ed_spec == "auto":
-                # materialize the dense eval twin only when it fits the
-                # HBM budget; otherwise the certificate margins ride the
-                # hot panel + residual stream when a panel exists
-                # (ops/rows.eval_margins), or the plain gather without one
-                from cocoa_tpu.data.sharding import eval_dense_fits
 
-                eval_dense = eval_dense_fits(n, cfg.num_features, k, dtype)
-                if not quiet:
-                    fallback = ("hot panel + residual stream" if hot_n
-                                else "per-nonzero gather (no hot panel — "
-                                     "consider --hotCols=auto)")
-                    print(f"evalDense=auto: "
-                          f"{'dense twin' if eval_dense else fallback} "
-                          f"for the certificate margins")
-            if hot_n and not quiet:
-                print(f"hotCols={layout_split['spec']}: panel {hot_n} "
-                      f"columns, {layout_split['coverage'] * 100:.1f}% "
-                      f"nonzero coverage, "
-                      f"{layout_split['panel_bytes'] / 2**20:.1f} MiB HBM, "
-                      f"residual mean nnz "
-                      f"{layout_split['residual_mean_nnz']:.1f} (max "
-                      f"{layout_split['residual_max_nnz']})")
+    def announce_eval(eval_dense, hot_n):
+        if not quiet:
+            fallback = ("hot panel + residual stream" if hot_n
+                        else "per-nonzero gather (no hot panel — "
+                             "consider --hotCols=auto)")
+            print(f"evalDense=auto: "
+                  f"{'dense twin' if eval_dense else fallback} "
+                  f"for the certificate margins")
+
+    def announce_hot(layout_split, hot_n):
+        if hot_n and not quiet:
+            print(f"hotCols={layout_split['spec']}: panel {hot_n} "
+                  f"columns, {layout_split['coverage'] * 100:.1f}% "
+                  f"nonzero coverage, "
+                  f"{layout_split['panel_bytes'] / 2**20:.1f} MiB HBM, "
+                  f"residual mean nnz "
+                  f"{layout_split['residual_mean_nnz']:.1f} (max "
+                  f"{layout_split['residual_max_nnz']})")
+
+    import time as time_mod
+
+    if ingest_mode == "stream":
+        # streaming sharded ingest (svm only — resolve_ingest_mode
+        # rejects lasso/fp): pass 1 builds the row index + global column
+        # histogram from per-process partial scans, --hotCols resolves
+        # from that histogram bit-identically to the whole-file build,
+        # pass 2 parses only this process's shard byte ranges
+        from cocoa_tpu.data import hybrid as hybrid_lib
+        from cocoa_tpu.data.sharding import resolve_layout_stats
+
+        try:
+            index = ingest_lib.build_index(cfg.train_file,
+                                           cfg.num_features)
+            n = index.n
+            resolved_layout = resolve_layout_stats(
+                n, cfg.num_features, index.total_nnz, cfg.layout, mesh)
+            if (extras["hotCols"] is not None
+                    and resolved_layout != "sparse"):
+                print("error: --hotCols (the hot/cold column split) only "
+                      "applies to the sparse layout", file=sys.stderr)
+                return 2
+            if resolved_layout == "sparse":
+                hot_n = hybrid_lib.resolve_hot_width(
+                    extras["hotCols"], index.hist, n, k, dtype)
+                if ed_spec == "auto":
+                    from cocoa_tpu.data.sharding import eval_dense_fits
+
+                    eval_dense = eval_dense_fits(n, cfg.num_features, k,
+                                                 dtype)
+                    announce_eval(eval_dense, hot_n)
+            ds, sinfo = ingest_lib.stream_shard_dataset(
+                cfg.train_file, cfg.num_features, k, layout=cfg.layout,
+                dtype=dtype, mesh=mesh, eval_dense=eval_dense,
+                hot_cols=hot_n, index=index)
+            if resolved_layout == "sparse":
+                layout_split = hybrid_lib.stats_from_counts(
+                    extras["hotCols"], index.hist, hot_n,
+                    (sinfo.residual_max_nnz if hot_n
+                     else int(index.row_nnz.max(initial=0))),
+                    n, k, dtype)
+                announce_hot(layout_split, hot_n)
+            ingest_reports.append(ingest_lib.IngestReport(
+                mode="stream", path=cfg.train_file,
+                file_bytes=index.file_bytes,
+                processes=jax.process_count(),
+                parse_seconds=index.scan_seconds + sinfo.parse_seconds,
+                bytes_read=index.scan_bytes + sinfo.bytes_read,
+                rows=sinfo.rows, nnz=sinfo.nnz,
+                n=n, total_nnz=index.total_nnz,
+                peak_rss_bytes=ingest_lib.peak_rss_bytes()))
+            if cfg.test_file:
+                tindex = ingest_lib.build_index(cfg.test_file,
+                                                cfg.num_features)
+                test_ds, tinfo = ingest_lib.stream_shard_dataset(
+                    cfg.test_file, cfg.num_features, k,
+                    layout=cfg.layout, dtype=dtype, mesh=mesh,
+                    eval_dense=eval_dense, hot_cols=hot_n, index=tindex)
+                ingest_reports.append(ingest_lib.IngestReport(
+                    mode="stream", path=cfg.test_file,
+                    file_bytes=tindex.file_bytes,
+                    processes=jax.process_count(),
+                    parse_seconds=(tindex.scan_seconds
+                                   + tinfo.parse_seconds),
+                    bytes_read=tindex.scan_bytes + tinfo.bytes_read,
+                    rows=tinfo.rows, nnz=tinfo.nnz,
+                    n=tindex.n, total_nnz=tindex.total_nnz,
+                    peak_rss_bytes=ingest_lib.peak_rss_bytes()))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    else:
+        # whole-file ingest: every process parses the full file, then
+        # slices out its shards (the bit-exact A/B control; multi-process
+        # dp runs still materialize only their local shards host-side)
+        t_load = time_mod.perf_counter()
+        try:
+            data = load_libsvm(cfg.train_file, cfg.num_features)
+        except (OSError, ValueError) as e:  # missing file, bad numFeatures
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        n = data.n
+
+        # --hotCols=auto|off|<n>: the hot/cold column split (sparse
+        # layout only, data/hybrid.py).  Resolved HERE — against the
+        # measured column histogram, with the panel's HBM bytes accounted
+        # explicitly — so the run_start manifest records the split the
+        # run actually trains on.
+        if objective == "svm":
+            resolved_layout = resolve_layout(data, cfg.layout, mesh)
+            if (extras["hotCols"] is not None
+                    and resolved_layout != "sparse"):
+                print("error: --hotCols (the hot/cold column split) only "
+                      "applies to the sparse layout", file=sys.stderr)
+                return 2
+            if resolved_layout == "sparse":
+                try:
+                    hot_n, layout_split = resolve_hot_cols(
+                        extras["hotCols"], data, k, dtype)
+                except ValueError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+                if ed_spec == "auto":
+                    # materialize the dense eval twin only when it fits
+                    # the HBM budget; otherwise (with a hot panel) the
+                    # certificate margins ride the panel matvec +
+                    # residual stream (ops/rows.eval_margins)
+                    from cocoa_tpu.data.sharding import eval_dense_fits
+
+                    eval_dense = eval_dense_fits(n, cfg.num_features, k,
+                                                 dtype)
+                    announce_eval(eval_dense, hot_n)
+                announce_hot(layout_split, hot_n)
+
+        def whole_report(path, parsed, seconds):
+            # one report per loaded file, like the stream branch, so the
+            # stream-vs-whole telemetry is an apples-to-apples A/B;
+            # parse seconds cover parse + shard/slab build, same span the
+            # streamed pass-2 timer covers
+            try:
+                fsize = os.path.getsize(path)
+            except OSError:
+                fsize = 0
+            return ingest_lib.IngestReport(
+                mode="whole", path=path, file_bytes=fsize,
+                processes=jax.process_count(), parse_seconds=seconds,
+                bytes_read=fsize, rows=parsed.n,
+                nnz=int(parsed.indptr[-1]), n=parsed.n,
+                total_nnz=int(parsed.indptr[-1]),
+                peak_rss_bytes=ingest_lib.peak_rss_bytes())
+
+        try:
+            if objective == "svm":
+                # --evalDense: dense eval twin for sparse layouts — the
+                # duality-gap certificate's full margins pass as one MXU
+                # matvec instead of an every-nonzero w-gather (31% of the
+                # rcv1 production round); costs K*n_shard*d*itemsize HBM
+                ds = shard_dataset(data, k=k, layout=cfg.layout,
+                                   dtype=dtype, mesh=mesh,
+                                   eval_dense=eval_dense, hot_cols=hot_n)
+                ingest_reports.append(whole_report(
+                    cfg.train_file, data,
+                    time_mod.perf_counter() - t_load))
+                if cfg.test_file:
+                    t_test = time_mod.perf_counter()
+                    test_data = load_libsvm(cfg.test_file,
+                                            cfg.num_features)
+                    test_ds = shard_dataset(test_data, k=k,
+                                            layout=cfg.layout,
+                                            dtype=dtype, mesh=mesh,
+                                            eval_dense=eval_dense,
+                                            hot_cols=hot_n)
+                    ingest_reports.append(whole_report(
+                        cfg.test_file, test_data,
+                        time_mod.perf_counter() - t_test))
+            else:
+                ingest_reports.append(whole_report(
+                    cfg.train_file, data,
+                    time_mod.perf_counter() - t_load))
+        except (OSError, ValueError) as e:  # e.g. --layout=sparse + --fp>1
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     if layout_split is not None:
         cfg_manifest["layout_split"] = layout_split
@@ -613,27 +779,14 @@ def main(argv=None) -> int:
                                                  dataset=cfg.train_file)
         if layout_split is not None:
             manifest["layout_split"] = dict(layout_split)
+        if ingest_reports:
+            # the TRAIN file's ingest record rides the manifest next to
+            # layout_split (stats like parse seconds/RSS are run facts,
+            # not config — they stay out of the config hash)
+            manifest["ingest"] = ingest_reports[0].as_fields()
         bus.emit("run_start", manifest=manifest)
-
-    try:
-        ds = test_ds = None
-        if objective == "svm":
-            # --evalDense: dense eval twin for sparse layouts — the
-            # duality-gap certificate's full margins pass as one MXU
-            # matvec instead of an every-nonzero w-gather (31% of the
-            # rcv1 production round); costs K*n_shard*d*itemsize HBM
-            ds = shard_dataset(data, k=k, layout=cfg.layout, dtype=dtype,
-                               mesh=mesh, eval_dense=eval_dense,
-                               hot_cols=hot_n)
-            if cfg.test_file:
-                test_data = load_libsvm(cfg.test_file, cfg.num_features)
-                test_ds = shard_dataset(test_data, k=k, layout=cfg.layout,
-                                        dtype=dtype, mesh=mesh,
-                                        eval_dense=eval_dense,
-                                        hot_cols=hot_n)
-    except (OSError, ValueError) as e:  # e.g. --layout=sparse with --fp>1
-        print(f"error: {e}", file=sys.stderr)
-        return 2
+        for rep in ingest_reports:
+            bus.emit("ingest", **rep.as_fields())
 
     params = cfg.to_params(n, k)
     debug = cfg.to_debug()

@@ -104,6 +104,112 @@ def panel_bytes(n_hot: int, k: int, n_shard: int, itemsize: int) -> int:
     return k * n_shard * n_hot * itemsize
 
 
+def normalize_spec(spec) -> str:
+    """One normalization of the ``--hotCols`` flag value, shared by the
+    whole-file and streaming resolution paths."""
+    return ("off" if spec is None else str(spec)).strip().lower()
+
+
+def resolve_hot_width(
+    spec,
+    counts: np.ndarray,
+    n: int,
+    k: int,
+    dtype,
+    *,
+    coverage_target: float = HOT_COVERAGE_TARGET,
+    budget: "int | None" = None,
+) -> int:
+    """``--hotCols=auto|off|<n>`` → lane-padded panel width (0 = off),
+    from the column histogram alone — no parsed dataset required, so
+    streaming ingest resolves the SAME width from its assembled partial
+    histograms bit-identically to the whole-file build.  Raises for an
+    explicit width over the HBM budget (loud, with the accounting)."""
+    from cocoa_tpu.data.sharding import pad_rows, split_sizes
+
+    if budget is None:
+        budget = HOT_PANEL_HBM_BUDGET
+    spec_s = normalize_spec(spec)
+    if spec_s in ("off", "false", "0", "none", ""):
+        return 0
+    d = len(counts)
+    itemsize = np.dtype(dtype).itemsize
+    n_shard = pad_rows(int(split_sizes(n, k).max())) if k > 0 else 0
+    per_lane_block = panel_bytes(PANEL_LANES, k, n_shard, itemsize)
+
+    if spec_s == "auto":
+        desc = np.sort(counts)[::-1]
+        cums = np.cumsum(desc)
+        total = max(1, int(cums[-1]) if len(cums) else 1)
+        need = int(np.searchsorted(cums, coverage_target * total)) + 1
+        real = min(need, d)
+        width = pad_panel(real)
+        max_width = (budget // per_lane_block) * PANEL_LANES \
+            if per_lane_block > 0 else width
+        width = min(width, max_width)
+        if width < PANEL_LANES:
+            # not even one lane block fits the budget — keep the streams
+            return 0
+        return int(width)
+
+    try:
+        want = int(spec_s)
+    except ValueError:
+        raise ValueError(f"--hotCols must be auto|off|<n>, "
+                         f"got {spec!r}") from None
+    if want <= 0:
+        raise ValueError(f"--hotCols must be auto|off|<positive n>, "
+                         f"got {spec!r}")
+    width = pad_panel(min(want, d))
+    pb = panel_bytes(width, k, n_shard, itemsize)
+    if pb > budget:
+        raise ValueError(
+            f"--hotCols={want}: the hot panel needs {pb / 2**20:.1f} MiB "
+            f"of HBM (K={k} x n_shard={n_shard} x {width} lanes x "
+            f"{itemsize} B) against the {budget / 2**20:.0f} MiB "
+            f"budget; lower --hotCols or use --hotCols=auto"
+        )
+    return int(width)
+
+
+def stats_from_counts(
+    spec,
+    counts: np.ndarray,
+    width: int,
+    residual_max_nnz: int,
+    n: int,
+    k: int,
+    dtype,
+) -> dict:
+    """The layout-split manifest record from the column histogram plus
+    the (exchanged) residual per-row max — the streaming twin of
+    :func:`split_stats`: coverage and residual mean derive from exact
+    integer totals, so the record is bit-identical to the whole-file one
+    for the same dataset."""
+    from cocoa_tpu.data.sharding import pad_rows, split_sizes
+
+    total = max(1, int(counts.sum()))
+    if width:
+        hot_total = int(counts[hottest_columns(counts, width)].sum())
+    else:
+        hot_total = 0
+    n_shard = pad_rows(int(split_sizes(n, k).max())) if k > 0 else 0
+    itemsize = np.dtype(dtype).itemsize
+    spec_s = normalize_spec(spec)
+    if width == 0 and spec_s != "auto":
+        spec_s = "off"  # the whole off-family records as "off"
+    return {
+        "coverage": float(hot_total / total) if width else 0.0,
+        "residual_mean_nnz": (
+            float((total - hot_total) / n) if n else 0.0),
+        "residual_max_nnz": int(residual_max_nnz),
+        "total_nnz": int(counts.sum()),
+        "spec": spec_s,
+        "hot_cols": int(width),
+        "panel_bytes": panel_bytes(width, k, n_shard, itemsize),
+    }
+
+
 def resolve_hot_cols(
     spec,
     data: LibsvmData,
@@ -129,68 +235,33 @@ def resolve_hot_cols(
       accounting when the panel exceeds the budget — an explicit ask that
       cannot be honored must fail loudly, not silently degrade.
     - ``off``/``0``: the unchanged stream layout (the A/B control).
+
+    The width itself comes from :func:`resolve_hot_width` (histogram
+    only); streaming ingest calls that directly with its assembled
+    histogram and fills the stats via :func:`stats_from_counts`.
     """
     from cocoa_tpu.data.sharding import pad_rows, split_sizes
 
-    if budget is None:
-        budget = HOT_PANEL_HBM_BUDGET
-    spec_s = ("off" if spec is None else str(spec)).strip().lower()
-    if spec_s in ("off", "false", "0", "none", ""):
-        return 0, {"spec": "off", "hot_cols": 0, "coverage": 0.0,
+    spec_s = normalize_spec(spec)
+    counts = column_counts(data)
+    width = resolve_hot_width(spec, counts, data.n, k, dtype,
+                              coverage_target=coverage_target,
+                              budget=budget)
+    if width == 0:
+        off_spec = spec_s if spec_s == "auto" else "off"
+        return 0, {"spec": off_spec, "hot_cols": 0, "coverage": 0.0,
                    "residual_mean_nnz": (float(np.diff(data.indptr).mean())
                                          if data.n else 0.0),
                    "residual_max_nnz": int(np.diff(data.indptr).max(initial=0)),
                    "panel_bytes": 0,
                    "total_nnz": int(data.indptr[-1])}
 
-    counts = column_counts(data)
-    d = data.num_features
-    itemsize = np.dtype(dtype).itemsize
-    n_shard = pad_rows(int(split_sizes(data.n, k).max())) if k > 0 else 0
-    per_lane_block = panel_bytes(PANEL_LANES, k, n_shard, itemsize)
-
-    if spec_s == "auto":
-        desc = np.sort(counts)[::-1]
-        cums = np.cumsum(desc)
-        total = max(1, int(cums[-1]) if len(cums) else 1)
-        need = int(np.searchsorted(cums, coverage_target * total)) + 1
-        real = min(need, d)
-        width = pad_panel(real)
-        max_width = (budget // per_lane_block) * PANEL_LANES \
-            if per_lane_block > 0 else width
-        width = min(width, max_width)
-        if width < PANEL_LANES:
-            # not even one lane block fits the budget — keep the streams
-            return 0, {"spec": "auto", "hot_cols": 0, "coverage": 0.0,
-                       "residual_mean_nnz": float(np.diff(data.indptr).mean())
-                       if data.n else 0.0,
-                       "residual_max_nnz":
-                           int(np.diff(data.indptr).max(initial=0)),
-                       "panel_bytes": 0,
-                       "total_nnz": int(data.indptr[-1])}
-    else:
-        try:
-            n = int(spec_s)
-        except ValueError:
-            raise ValueError(f"--hotCols must be auto|off|<n>, "
-                             f"got {spec!r}") from None
-        if n <= 0:
-            raise ValueError(f"--hotCols must be auto|off|<positive n>, "
-                             f"got {spec!r}")
-        width = pad_panel(min(n, d))
-        pb = panel_bytes(width, k, n_shard, itemsize)
-        if pb > budget:
-            raise ValueError(
-                f"--hotCols={n}: the hot panel needs {pb / 2**20:.1f} MiB "
-                f"of HBM (K={k} x n_shard={n_shard} x {width} lanes x "
-                f"{itemsize} B) against the {budget / 2**20:.0f} MiB "
-                f"budget; lower --hotCols or use --hotCols=auto"
-            )
-
     hot_ids = hottest_columns(counts, width)
+    n_shard = pad_rows(int(split_sizes(data.n, k).max())) if k > 0 else 0
     stats = split_stats(data, hot_ids)
     stats.update(spec=spec_s, hot_cols=int(width),
-                 panel_bytes=panel_bytes(width, k, n_shard, itemsize))
+                 panel_bytes=panel_bytes(width, k, n_shard,
+                                         np.dtype(dtype).itemsize))
     return int(width), stats
 
 

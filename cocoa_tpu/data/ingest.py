@@ -1,0 +1,435 @@
+"""Streaming sharded ingest: parse only your shards, build in place.
+
+The reference's Spark loader reads only the HDFS blocks local to each
+executor (OptUtils.scala:11-53).  The whole-file path here
+(``load_libsvm`` → ``shard_dataset``) instead parses the ENTIRE LIBSVM
+text in every process and only then slices out the local shards — P
+redundant full parses, and a full-dataset host-side CSR per process.
+This module is the data-local ingest CoCoA+'s design assumes (Ma et al.,
+arXiv:1502.03508: each worker only ever touches its own partition), as a
+two-pass byte-range pipeline (docs/DESIGN.md §12):
+
+- **pass 1 — index scan.**  Each process scans its 1/P byte range of the
+  file in bounded windows (range-parse, keep the stats, drop the rows):
+  per-row byte offsets + nnz, and a partial column histogram.  The
+  partials are all-gathered over the jax.distributed KV store
+  (parallel/distributed.host_allgather_bytes — host data, no device
+  round-trip) and summed: integer totals, so the assembled histogram is
+  bit-identical to a whole-file ``np.bincount`` and ``--hotCols=auto``
+  resolves to exactly the single-process width
+  (hybrid.resolve_hot_width).
+- **pass 2 — shard parse.**  The global row-offset index maps each local
+  device's m = K/D consecutive shards to an EXACT byte range; each
+  process parses only those ranges (native or Python range parser,
+  data/libsvm.load_libsvm_range) and builds the padded slabs straight
+  into the target layout — dense, padded-CSR, or the hybrid hot/cold
+  split with the dense eval twin — through the same
+  ``sharding._build_shard_slabs`` the whole-file paths use, so the
+  shards are bit-identical by construction.  The full dataset CSR is
+  never materialized host-side: peak host RSS is ~1/P of the dataset
+  plus the index.
+
+The hybrid residual width (global max COLD nnz per row) needs the hot
+set, which needs the global histogram — so it is measured on the held
+pass-2 pieces and max-reduced across processes (exact integer max, equal
+to the whole-file ``bincount(...).max()``).
+
+The single-process replicated builder (``shard_dataset``) stays bit-exact
+as the A/B control; ``stream_shard_dataset`` with one process produces
+the identical ``ShardedDataset`` (pinned by tests/test_ingest.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import itertools
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cocoa_tpu.data import hybrid as hybrid_lib
+from cocoa_tpu.data import sharding as sharding_lib
+from cocoa_tpu.data.libsvm import load_libsvm_range
+from cocoa_tpu.data.sharding import ShardedDataset
+from cocoa_tpu.parallel import distributed
+from cocoa_tpu.parallel import mesh as mesh_lib
+
+# pass-1 window: bounds the transient CSR a scan holds (rows are parsed
+# and dropped per window; only offsets/nnz/histogram survive)
+PASS1_WINDOW = 64 << 20
+
+# SPMD-deterministic exchange tags: every process runs the same ingest
+# calls in the same order, so a per-process counter yields matching tags
+_EXCHANGE_SEQ = itertools.count()
+
+
+def peak_rss_bytes() -> int:
+    """This process's lifetime peak resident set (ru_maxrss is kB on
+    Linux) — the ingest telemetry's memory fact.  ``resource`` is
+    Unix-only; report 0 where it is absent rather than breaking the
+    package import (this module loads with ``cocoa_tpu.data``)."""
+    try:
+        import resource
+    except ImportError:
+        return 0
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+@dataclasses.dataclass
+class IngestIndex:
+    """The pass-1 artifact: the global row index + column histogram.
+
+    ``row_off`` has n+1 entries — ``row_off[i]`` is the byte offset of
+    row i's line start, ``row_off[n]`` the file size — so rows [a, b)
+    occupy exactly bytes [row_off[a], row_off[b]).
+    """
+
+    path: str
+    file_bytes: int
+    num_features: int
+    row_off: np.ndarray      # (n+1,) int64
+    row_nnz: np.ndarray      # (n,) int64
+    hist: np.ndarray         # (d,) int64 global column histogram
+    scan_bytes: int          # bytes THIS process scanned in pass 1
+    scan_seconds: float
+
+    @property
+    def n(self) -> int:
+        return len(self.row_nnz)
+
+    @property
+    def total_nnz(self) -> int:
+        return int(self.row_nnz.sum())
+
+
+def _pack_arrays(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _unpack_arrays(payload: bytes) -> dict:
+    with np.load(io.BytesIO(payload)) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _exchange_max(value: int) -> int:
+    """Exact integer max across processes (identity single-process)."""
+    tag = f"ingest{next(_EXCHANGE_SEQ)}"
+    payloads = distributed.host_allgather_bytes(
+        tag, _pack_arrays(v=np.asarray([value], np.int64)))
+    return int(max(int(_unpack_arrays(p)["v"][0]) for p in payloads))
+
+
+def build_index(path: str, num_features: int, *,
+                window: int = PASS1_WINDOW) -> IngestIndex:
+    """Pass 1: scan this process's 1/P byte range, exchange, assemble.
+
+    Every process returns the same global index (offsets concatenated in
+    process order — ranges tile the file, so the concatenation IS the
+    whole-file row order; histogram summed as int64, bit-identical to the
+    whole-file ``np.bincount``).
+    """
+    size = os.path.getsize(path)
+    nproc = jax.process_count()
+    me = jax.process_index()
+    lo = me * size // nproc
+    hi = (me + 1) * size // nproc
+    t0 = time.perf_counter()
+    offs: list = []
+    nnzs: list = []
+    hist = np.zeros(num_features, np.int64)
+    w = lo
+    while w < hi:
+        wl, wh = w, min(w + window, hi)
+        piece, off = load_libsvm_range(path, num_features, wl, wh)
+        hist += np.bincount(piece.indices, minlength=num_features)
+        nnzs.append(np.diff(piece.indptr))
+        offs.append(off)
+        w = wh
+    my_off = (np.concatenate(offs) if offs
+              else np.empty(0, np.int64)).astype(np.int64)
+    my_nnz = (np.concatenate(nnzs) if nnzs
+              else np.empty(0, np.int64)).astype(np.int64)
+    scan_seconds = time.perf_counter() - t0
+
+    if nproc > 1:
+        tag = f"ingest{next(_EXCHANGE_SEQ)}"
+        payloads = distributed.host_allgather_bytes(
+            tag, _pack_arrays(off=my_off, nnz=my_nnz, hist=hist))
+        parts = [_unpack_arrays(p) for p in payloads]
+        row_off = np.concatenate([p["off"] for p in parts])
+        row_nnz = np.concatenate([p["nnz"] for p in parts])
+        hist = np.sum([p["hist"] for p in parts], axis=0,
+                      dtype=np.int64)
+        scan_seconds = time.perf_counter() - t0
+    else:
+        row_off, row_nnz = my_off, my_nnz
+
+    return IngestIndex(
+        path=path,
+        file_bytes=size,
+        num_features=num_features,
+        row_off=np.append(row_off, np.int64(size)),
+        row_nnz=row_nnz,
+        hist=hist,
+        scan_bytes=hi - lo,
+        scan_seconds=scan_seconds,
+    )
+
+
+@dataclasses.dataclass
+class StreamBuildInfo:
+    """Pass-2 facts of one streamed build (this process's share)."""
+
+    rows: int                # rows parsed by THIS process in pass 2
+    nnz: int
+    bytes_read: int          # pass-2 bytes parsed by this process
+    parse_seconds: float     # pass-2 wall time (parse + slab build)
+    residual_max_nnz: int    # global max cold nnz (0 unless hybrid)
+
+
+def stream_shard_dataset(
+    path: str,
+    num_features: int,
+    k: int,
+    *,
+    layout: str = "auto",
+    dtype=jnp.float32,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    max_nnz: Optional[int] = None,
+    eval_dense: bool = False,
+    hot_cols: int = 0,
+    index: Optional[IngestIndex] = None,
+):
+    """Streamed twin of :func:`cocoa_tpu.data.sharding.shard_dataset`:
+    same arguments plus the file path instead of parsed data, returning
+    ``(ShardedDataset, StreamBuildInfo)``.  The dataset is bit-identical
+    to the whole-file build of the same file/config — same slab builders
+    over the same parsed values, only the parse granularity changes.
+
+    Multi-process with a dp mesh: each process parses and materializes
+    ONLY the byte ranges of its local devices' shards (m = K/D shards
+    per device — multiplexed meshes are first-class).  Single-process:
+    shards build one at a time from their byte ranges (the full CSR is
+    still never materialized), then place exactly like the replicated
+    builder.  fp meshes keep whole-file ingest — the feature-axis column
+    split re-buckets every row and has no data-local byte range per
+    device; that combination is rejected loudly upstream.
+    """
+    if index is None:
+        index = build_index(path, num_features)
+    n, d = index.n, num_features
+    layout = sharding_lib.resolve_layout_stats(n, d, index.total_nnz,
+                                               layout, mesh)
+    if mesh_lib.has_fp(mesh):
+        # sparse+fp is impossible anywhere; dense+fp is whole-ingest only
+        raise ValueError(
+            "streamed ingest does not support feature-parallel (fp) "
+            "meshes: the fp column split has no per-device byte range "
+            "to stream; use --ingest=whole"
+        )
+    if eval_dense and layout != "sparse":
+        raise ValueError("eval_dense only applies to the sparse layout "
+                         "(the dense layout's eval is already a matvec)")
+
+    np_dtype = np.dtype(dtype)
+    sizes = sharding_lib.split_sizes(n, k)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    n_shard = sharding_lib.pad_rows(int(sizes.max())) if k > 0 else 0
+
+    width = 0
+    if layout == "sparse":
+        width = int(max_nnz if max_nnz is not None
+                    else max(1, index.row_nnz.max(initial=1)))
+        if n and int(index.row_nnz.max(initial=0)) > width:
+            raise ValueError(
+                f"row nnz {int(index.row_nnz.max())} exceeds max_nnz "
+                f"{width}"
+            )
+
+    rank = None
+    hot_ids = None
+    n_hot = 0
+    if hot_cols:
+        if layout != "sparse":
+            raise ValueError("hot_cols (the hot/cold column split) only "
+                             "applies to the sparse layout")
+        if max_nnz is not None:
+            raise ValueError("hot_cols and max_nnz cannot combine: the "
+                             "residual width is measured from the split")
+        n_hot = hybrid_lib.pad_panel(min(int(hot_cols), d))
+        # the hot set derives from the ASSEMBLED histogram — identical to
+        # the whole-file hottest_columns(column_counts(data), n_hot)
+        hot_ids = hybrid_lib.hottest_columns(index.hist, n_hot)
+        rank = hybrid_lib.hot_rank(d, hot_ids)
+
+    distributed_build = (mesh is not None and jax.process_count() > 1)
+    if distributed_build:
+        if k % mesh.devices.size != 0:
+            raise ValueError(
+                f"multi-process runs need numSplits divisible by the dp "
+                f"mesh size: K={k} shards cannot multiplex onto "
+                f"{mesh.devices.size} devices"
+            )
+        locals_ = mesh_lib.dp_local_shards(mesh, k)
+    else:
+        locals_ = [(None, 0, k)]
+
+    t0 = time.perf_counter()
+    bytes_read = 0
+    rows_parsed = 0
+    nnz_parsed = 0
+
+    def parse_piece(shard_lo, shard_hi):
+        """The CSR piece holding shards [shard_lo, shard_hi)'s rows."""
+        nonlocal bytes_read, rows_parsed, nnz_parsed
+        r0, r1 = int(offsets[shard_lo]), int(offsets[shard_hi])
+        blo = int(index.row_off[r0])
+        bhi = int(index.row_off[r1])
+        piece, _ = load_libsvm_range(path, d, blo, bhi)
+        if piece.n != r1 - r0:
+            raise ValueError(
+                f"{path}: changed during ingest (index says rows "
+                f"[{r0}, {r1}) occupy bytes [{blo}, {bhi}), parsed "
+                f"{piece.n} rows); re-run"
+            )
+        bytes_read += bhi - blo
+        rows_parsed += piece.n
+        nnz_parsed += len(piece.values)
+        return piece, r0
+
+    # hybrid residual width: measured on the held pass-2 pieces, then
+    # max-reduced across processes — exact integer, equal to the
+    # whole-file bincount(cold_rows).max()
+    pieces = None
+    resid_max = 0
+    if n_hot:
+        pieces = {(slo, shi): parse_piece(slo, shi)
+                  for _, slo, shi in locals_}
+        local_max = 0
+        for piece, _ in pieces.values():
+            if piece.n == 0:
+                continue
+            pr_nnz = np.diff(piece.indptr)
+            rows = np.repeat(np.arange(piece.n, dtype=np.int64), pr_nnz)
+            cold = rows[rank[piece.indices] < 0]
+            local_max = max(local_max, int(
+                np.bincount(cold, minlength=piece.n).max(initial=0)))
+        resid_max = (_exchange_max(local_max) if jax.process_count() > 1
+                     else local_max)
+        width = max(1, resid_max)
+
+    d_eff = mesh_lib.pad_features(d, mesh) if layout == "dense" else d
+
+    def build_shards(shard_lo, shard_hi):
+        """Slab dicts for shards [shard_lo, shard_hi) from one piece."""
+        if pieces is not None:
+            piece, base = pieces.pop((shard_lo, shard_hi))
+        else:
+            piece, base = parse_piece(shard_lo, shard_hi)
+        pr_nnz = np.diff(piece.indptr)
+        pr_sq = sharding_lib.segment_sq_norms(piece.values, piece.indptr)
+        out = {}
+        for s in range(shard_lo, shard_hi):
+            lo, hi = int(offsets[s]) - base, int(offsets[s + 1]) - base
+            out[s] = sharding_lib._build_shard_slabs(
+                piece, lo, hi, n_shard, layout, np_dtype, d_eff, width,
+                pr_nnz, pr_sq, rank=rank, n_hot=n_hot,
+                eval_dense=eval_dense)
+        return out
+
+    if distributed_build:
+        built = {}
+        for _, slo, shi in locals_:
+            built.update(build_shards(slo, shi))
+        ds = sharding_lib._assemble_distributed(
+            mesh, k, built, locals_, layout=layout, n=n, d=d_eff,
+            n_shard=n_shard, width=width, sizes=sizes, n_hot=n_hot,
+            hot_ids=hot_ids, eval_dense=eval_dense, np_dtype=np_dtype)
+    else:
+        # single process: one shard's piece at a time — the full CSR is
+        # never held; peak = the stacked (K, ...) arrays + one piece.
+        # (Hybrid is the exception: the residual-width measurement above
+        # already parsed the whole range as one held piece, so build from
+        # it rather than parse everything twice.)
+        ranges = ([(0, k)] if pieces is not None
+                  else [(s, s + 1) for s in range(k)])
+        arrs: dict = {}
+        for slo, shi in ranges:
+            for s, slab in build_shards(slo, shi).items():
+                for f, v in slab.items():
+                    arrs.setdefault(f,
+                                    np.zeros((k, *v.shape), v.dtype))[s] = v
+        if n_hot:
+            hc = np.zeros(n_hot, dtype=np.int32)
+            hc[:len(hot_ids)] = hot_ids
+            arrs["hot_cols"] = np.tile(hc[None], (k, 1))
+        ds = sharding_lib._finalize_replicated(
+            arrs, layout=layout, n=n, d=d_eff, mesh=mesh, sizes=sizes)
+
+    info = StreamBuildInfo(
+        rows=rows_parsed,
+        nnz=nnz_parsed,
+        bytes_read=bytes_read,
+        parse_seconds=time.perf_counter() - t0,
+        residual_max_nnz=resid_max,
+    )
+    return ds, info
+
+
+def resolve_ingest_mode(spec, mesh, *, objective: str = "svm") -> str:
+    """``--ingest=stream|whole|auto`` → the mode a run uses.
+
+    ``auto`` picks ``stream`` exactly where it wins: multi-process svm
+    runs on a dp mesh (every process would otherwise parse the whole
+    file).  Single-process, fp meshes, and the lasso column shards keep
+    ``whole`` — the replicated builder is the bit-exact A/B control.
+    Explicit asks that cannot be honored raise (loudly, with the remedy).
+    """
+    spec_s = ("auto" if spec is None else str(spec)).strip().lower()
+    if spec_s not in ("auto", "stream", "whole"):
+        raise ValueError(f"--ingest must be stream|whole|auto, "
+                         f"got {spec!r}")
+    if spec_s == "stream":
+        if objective == "lasso":
+            raise ValueError(
+                "--ingest=stream does not apply to --objective=lasso "
+                "(column shards re-bucket every row; use --ingest=whole)")
+        if mesh_lib.has_fp(mesh):
+            raise ValueError(
+                "--ingest=stream does not support feature-parallel (fp) "
+                "meshes (no per-device byte range to stream); use "
+                "--ingest=whole")
+        return "stream"
+    if spec_s == "whole":
+        return "whole"
+    if (objective == "svm" and mesh is not None
+            and not mesh_lib.has_fp(mesh) and jax.process_count() > 1):
+        return "stream"
+    return "whole"
+
+
+@dataclasses.dataclass
+class IngestReport:
+    """The typed ``ingest`` telemetry payload (one per loaded file)."""
+
+    mode: str                # "stream" | "whole"
+    path: str
+    file_bytes: int
+    processes: int
+    parse_seconds: float     # this process: scan + shard parse
+    bytes_read: int          # this process: scanned + parsed bytes
+    rows: int                # rows this process materialized
+    nnz: int
+    n: int                   # global dataset facts
+    total_nnz: int
+    peak_rss_bytes: int
+
+    def as_fields(self) -> dict:
+        return dataclasses.asdict(self)

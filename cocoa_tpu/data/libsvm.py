@@ -68,11 +68,13 @@ class LibsvmData:
     # jaxlint: allow=f64 -- host-side densify for tests/oracles; callers
     # pass the compute dtype for device-bound arrays
     def to_dense(self, dtype=np.float64) -> np.ndarray:
-        """(n, d) dense matrix."""
+        """(n, d) dense matrix — one global scatter, not a per-row Python
+        loop (this sits on the oracle path of every dense parity test).
+        A duplicate column within a row keeps the LAST occurrence, same
+        as the per-row fancy assignment it replaces."""
         out = np.zeros((self.n, self.num_features), dtype=dtype)
-        for i in range(self.n):
-            lo, hi = self.indptr[i], self.indptr[i + 1]
-            out[i, self.indices[lo:hi]] = self.values[lo:hi]
+        rows = np.repeat(np.arange(self.n), np.diff(self.indptr))
+        out[rows, self.indices] = self.values
         return out
 
     @property
@@ -95,73 +97,139 @@ def _parse_label(token: str) -> float:
     return -1.0
 
 
-def load_libsvm_python(path: str, num_features: int) -> LibsvmData:
-    """Pure-Python reference parser (semantic oracle for the native one).
-
-    Malformed ``idx:val`` tails (missing ``:``, index or value outside the
-    shared decimal grammar, empty value — e.g. a stray ``"3: "``) end the
-    pair list for that line; earlier pairs and later lines are kept.  The
-    native parser applies the identical rule (strtol/strtod longest-prefix
-    parse + whole-token and character-class validation), so both paths
-    agree byte-for-byte on such files — pinned by the parity cases in
+def _parse_line(line: str):
+    """One decoded line → ``(label, idx, val)`` arrays, or None for a
+    blank line.  Malformed ``idx:val`` tails (missing ``:``, index or
+    value outside the shared decimal grammar, empty value — e.g. a stray
+    ``"3: "``) end the pair list for that line; earlier pairs and later
+    lines are kept.  The native parser applies the identical rule
+    (strtol/strtod longest-prefix parse + whole-token and character-class
+    validation), so both paths agree byte-for-byte on such files — pinned
+    by the parity cases in
     ``test_native_parser_malformed_whitespace_tails``.  The reference
-    simply threw (``"".toDouble``) — crashing on bad input is not behavior
-    worth replicating.
+    simply threw (``"".toDouble``) — crashing on bad input is not
+    behavior worth replicating."""
+    parts = [t for t in _WS_SPLIT.split(line.rstrip("\n")) if t]
+    if not parts:
+        return None
+    label = _parse_label(parts[0])
+    row_idx = np.empty(len(parts) - 1, dtype=np.int32)
+    # jaxlint: allow=f64 -- exact text→f64 parse; device arrays cast later
+    row_val = np.empty(len(parts) - 1, dtype=np.float64)
+    m = 0
+    for tok in parts[1:]:
+        head, sep, val = tok.partition(":")
+        if (not sep or not head or not val
+                or not _INT_CHARS.issuperset(head)
+                or not _NUM_CHARS.issuperset(val)):
+            break
+        try:
+            i = int(head)
+            v = float(val)
+        except ValueError:
+            break
+        # 1-based index must land in int32 after the -1 shift;
+        # out-of-range (incl. idx<1) is malformed, same as native —
+        # a silent int32 cast there would alias huge indices onto
+        # valid features
+        if i < 1 or i - 1 > 2**31 - 1:
+            break
+        row_idx[m] = i - 1  # 1-based → 0-based (OptUtils.scala:42)
+        row_val[m] = v
+        m += 1
+    return label, row_idx[:m], row_val[:m]
+
+
+def _parse_python_stream(path: str, num_features: int, lo: int, hi):
+    """Shared range/whole Python parse: rows whose line START lies in
+    [lo, hi) — ``hi=None`` means EOF, and with ``lo == 0`` the file is
+    read strictly sequentially (pipes stay supported on the whole-file
+    path).  Returns ``(LibsvmData, row_off)`` where ``row_off[i]`` is the
+    absolute byte offset of row i's line start.
+
+    Reading is byte-transparent (binary readline + latin-1 decode): every
+    byte decodes (a non-UTF-8 byte is junk to reject, not a decode crash
+    the native path doesn't have) and a lone ``'\\r'`` stays in-line
+    whitespace instead of universal-newlines splitting the row — both
+    exactly as the byte-oriented native scanner sees the file.
     """
     labels: list[float] = []
     indptr: list[int] = [0]
     indices: list[np.ndarray] = []
     values: list[np.ndarray] = []
+    offsets: list[int] = []
     nnz = 0
-    # latin-1 + newline="\n" = byte-transparent read: every byte decodes
-    # (a non-UTF-8 byte is junk to reject, not a decode crash the native
-    # path doesn't have) and a lone '\r' stays in-line whitespace instead
-    # of universal-newlines splitting the row — both exactly as the
-    # byte-oriented native scanner sees the file.
-    with open(path, "r", encoding="latin-1", newline="\n") as f:
-        for line in f:
-            parts = [t for t in _WS_SPLIT.split(line.rstrip("\n")) if t]
-            if not parts:
+    with open(path, "rb") as f:
+        pos = 0
+        if lo > 0:
+            # ownership rule (native resolve_span): a line belongs to the
+            # range containing its first byte, so seek to the first line
+            # start at or past lo — one past the first '\n' from lo-1
+            f.seek(lo - 1)
+            pos = None
+            probe = lo - 1
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                j = chunk.find(b"\n")
+                if j >= 0:
+                    pos = probe + j + 1
+                    break
+                probe += len(chunk)
+            if pos is None:
+                pos = -1  # no line starts at or past lo
+            else:
+                f.seek(pos)
+        while pos >= 0:
+            start = pos
+            if hi is not None and start >= hi:
+                break
+            line = f.readline()
+            if not line:
+                break
+            pos = start + len(line)
+            row = _parse_line(line.decode("latin-1"))
+            if row is None:
                 continue
-            labels.append(_parse_label(parts[0]))
-            row_idx = np.empty(len(parts) - 1, dtype=np.int32)
-            row_val = np.empty(len(parts) - 1, dtype=np.float64)
-            m = 0
-            for tok in parts[1:]:
-                head, sep, val = tok.partition(":")
-                if (not sep or not head or not val
-                        or not _INT_CHARS.issuperset(head)
-                        or not _NUM_CHARS.issuperset(val)):
-                    break
-                try:
-                    i = int(head)
-                    v = float(val)
-                except ValueError:
-                    break
-                # 1-based index must land in int32 after the -1 shift;
-                # out-of-range (incl. idx<1) is malformed, same as native —
-                # a silent int32 cast there would alias huge indices onto
-                # valid features
-                if i < 1 or i - 1 > 2**31 - 1:
-                    break
-                row_idx[m] = i - 1  # 1-based → 0-based (OptUtils.scala:42)
-                row_val[m] = v
-                m += 1
-            indices.append(row_idx[:m])
-            values.append(row_val[:m])
-            nnz += m
+            label, row_idx, row_val = row
+            labels.append(label)
+            indices.append(row_idx)
+            values.append(row_val)
+            nnz += len(row_idx)
             indptr.append(nnz)
-    return LibsvmData(
+            offsets.append(start)
+    data = LibsvmData(
+        # jaxlint: allow=f64 -- exact parse output; cast at device_put
         labels=np.asarray(labels, dtype=np.float64),
         indptr=np.asarray(indptr, dtype=np.int64),
         indices=(
             np.concatenate(indices) if indices else np.empty(0, dtype=np.int32)
         ),
         values=(
+            # jaxlint: allow=f64 -- exact parse output; cast at device_put
             np.concatenate(values) if values else np.empty(0, dtype=np.float64)
         ),
         num_features=num_features,
     )
+    return data, np.asarray(offsets, dtype=np.int64)
+
+
+def load_libsvm_python(path: str, num_features: int) -> LibsvmData:
+    """Pure-Python reference parser (semantic oracle for the native one)."""
+    return _parse_python_stream(path, num_features, 0, None)[0]
+
+
+def load_libsvm_python_range(path: str, num_features: int,
+                             lo: int, hi: int):
+    """Rows owned by the byte range [lo, hi) (ownership rule: a line
+    belongs to the range containing its first byte; the last owned line
+    parses to ITS end even past ``hi``).  Returns ``(LibsvmData,
+    row_off)``.  Ranges that tile the file parse to exactly the
+    whole-file result, each row once — pinned byte-for-byte against the
+    whole parse by the chunk-boundary parity suite in
+    tests/test_libsvm.py."""
+    return _parse_python_stream(path, num_features, max(0, lo), hi)
 
 
 def _validate(data: LibsvmData, path: str) -> LibsvmData:
@@ -191,3 +259,22 @@ def load_libsvm(path: str, num_features: int, prefer_native: bool = True) -> Lib
             # None: the path can't be mmap'd (missing or non-regular) —
             # the Python parser owns those cases (clean OSError / pipes)
     return _validate(load_libsvm_python(path, num_features), path)
+
+
+def load_libsvm_range(path: str, num_features: int, lo: int, hi: int,
+                      prefer_native: bool = True):
+    """Parse the rows owned by the byte range [lo, hi); C++ fast path when
+    available, same fallback contract as :func:`load_libsvm`.  Returns
+    ``(LibsvmData, row_off)`` — ``row_off[i]`` the absolute byte offset of
+    row i's line start, the per-row index streaming ingest
+    (data/ingest.py) uses to map shard row ranges back to byte ranges."""
+    if prefer_native:
+        from cocoa_tpu.data import native_loader
+
+        if native_loader.available():
+            out = native_loader.parse_range(path, lo, hi, num_features)
+            if out is not None:
+                data, row_off = out
+                return _validate(data, path), row_off
+    data, row_off = load_libsvm_python_range(path, num_features, lo, hi)
+    return _validate(data, path), row_off

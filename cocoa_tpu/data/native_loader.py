@@ -94,6 +94,25 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p,                             # actual rows out
             i64p,                             # actual pairs out
         ]
+        lib.cocoa_libsvm_count_range.restype = ctypes.c_int
+        lib.cocoa_libsvm_count_range.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
+        ]
+        lib.cocoa_libsvm_parse_range.restype = ctypes.c_int
+        lib.cocoa_libsvm_parse_range.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,                   # byte range lo
+            ctypes.c_int64,                   # byte range hi
+            ctypes.POINTER(ctypes.c_double),  # labels (cap_rows)
+            i64p,                             # indptr (cap_rows + 1)
+            ctypes.POINTER(ctypes.c_int32),   # indices (cap_pairs)
+            ctypes.POINTER(ctypes.c_double),  # values (cap_pairs)
+            i64p,                             # row_off (cap_rows)
+            ctypes.c_int64,                   # cap_rows
+            ctypes.c_int64,                   # cap_pairs
+            i64p,                             # actual rows out
+            i64p,                             # actual pairs out
+        ]
     except (OSError, AttributeError):
         # corrupt/incompatible .so (e.g. an interrupted foreign build, or
         # one with the pre-two-pass ABI): honor the fallback contract —
@@ -153,3 +172,54 @@ def parse_file(path: str, num_features: int) -> Optional[LibsvmData]:
         values=values[:nnz],
         num_features=num_features,
     )
+
+
+def parse_range(path: str, lo: int, hi: int,
+                num_features: int) -> "Optional[tuple]":
+    """Rows owned by the byte range [lo, hi) via the C++ library (the
+    ownership rule lives in native/libsvm_parser.cpp resolve_span: a line
+    belongs to the range containing its first byte; the last owned line
+    parses to its own end even past ``hi``).  Returns ``(LibsvmData,
+    row_off)`` — ``row_off[i]`` the absolute byte offset of row i's line
+    start — or None when the library is not built, the path cannot be
+    mmap'd, or the file changed between the count and parse passes (the
+    Python range parser owns those cases)."""
+    lib = _load()
+    if lib is None:
+        return None
+    rows_b, pairs_b = ctypes.c_int64(), ctypes.c_int64()
+    if lib.cocoa_libsvm_count_range(path.encode(), lo, hi,
+                                    ctypes.byref(rows_b),
+                                    ctypes.byref(pairs_b)) != 0:
+        return None
+    nb, zb = rows_b.value, pairs_b.value
+    # jaxlint: allow=f64 -- exact text→f64 parse buffers (host-side);
+    # device arrays are cast to the compute dtype downstream
+    labels = np.empty(max(nb, 1), dtype=np.float64)
+    indptr = np.empty(nb + 2, dtype=np.int64)
+    indices = np.empty(max(zb, 1), dtype=np.int32)
+    # jaxlint: allow=f64 -- same exact-parse buffer as labels above
+    values = np.empty(max(zb, 1), dtype=np.float64)
+    row_off = np.empty(max(nb, 1), dtype=np.int64)
+    rows, pairs = ctypes.c_int64(), ctypes.c_int64()
+    rc = lib.cocoa_libsvm_parse_range(
+        path.encode(), lo, hi,
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        row_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(max(nb, 1)), ctypes.c_int64(max(zb, 1)),
+        ctypes.byref(rows), ctypes.byref(pairs),
+    )
+    if rc != 0:
+        return None
+    n, nnz = rows.value, pairs.value
+    data = LibsvmData(
+        labels=labels[:n],
+        indptr=indptr[:n + 1],
+        indices=indices[:nnz],
+        values=values[:nnz],
+        num_features=num_features,
+    )
+    return data, row_off[:n]

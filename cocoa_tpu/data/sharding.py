@@ -39,19 +39,26 @@ def pad_rows(n_rows: int) -> int:
     return -(-n_rows // 16) * 16
 
 
-def resolve_layout(data, layout: str, mesh=None) -> str:
-    """The one place the ``layout="auto"`` rule lives: sparse below 10%
-    density (rcv1-like), dense otherwise (epsilon-like); feature-parallel
-    meshes are dense-only.  Shared by :func:`shard_dataset` and the CLI
-    (which must know the resolved layout before it can resolve
-    sparse-only knobs like ``--hotCols``)."""
+def resolve_layout_stats(n: int, d: int, nnz: int, layout: str,
+                         mesh=None) -> str:
+    """The one place the ``layout="auto"`` rule lives, from dataset
+    STATS alone (streaming ingest resolves before any rows are parsed
+    into a full dataset): sparse below 10% density (rcv1-like), dense
+    otherwise (epsilon-like); feature-parallel meshes are dense-only."""
     if layout != "auto":
         return layout
-    nnz = int(data.indptr[-1])
-    density = nnz / max(1, data.n * data.num_features)
+    density = nnz / max(1, n * d)
     if mesh_lib.has_fp(mesh):
         return "dense"  # fp sharding is dense-only (see shard_dataset)
     return "sparse" if density < 0.10 else "dense"
+
+
+def resolve_layout(data, layout: str, mesh=None) -> str:
+    """``layout="auto"`` against a parsed dataset — shared by
+    :func:`shard_dataset` and the CLI (which must know the resolved
+    layout before it can resolve sparse-only knobs like ``--hotCols``)."""
+    return resolve_layout_stats(data.n, data.num_features,
+                                int(data.indptr[-1]), layout, mesh)
 
 
 # HBM budget for the OPT-IN dense eval twin (``--evalDense=auto``): the
@@ -236,8 +243,17 @@ def _densify_rows(data, lo, hi, n_shard, d, np_dtype, row_nnz) -> np.ndarray:
 
 
 def _build_shard_slabs(data, lo, hi, n_shard, layout, np_dtype, d, width,
-                       row_nnz, row_sq) -> dict:
-    """One shard's padded host arrays (rows [lo, hi) of ``data``)."""
+                       row_nnz, row_sq, *, rank=None, n_hot=0,
+                       eval_dense=False) -> dict:
+    """One shard's COMPLETE padded host arrays (rows [lo, hi) of
+    ``data``): labels/mask/sq_norms plus the layout slabs — dense X,
+    plain padded-CSR, or (``n_hot > 0``) the hybrid hot panel + cold
+    residual — plus the optional dense eval twin.  The ONE slab builder
+    shared by the replicated, whole-file-distributed, and streaming
+    ingest paths, so every build produces bit-identical shards from the
+    same parsed rows.  ``lo``/``hi`` and the ``row_nnz``/``row_sq``
+    arrays index into ``data`` — streaming callers pass a range-parsed
+    PIECE with piece-relative bounds."""
     m = hi - lo
     labels = np.zeros(n_shard, np_dtype)
     labels[:m] = data.labels[lo:hi]
@@ -246,11 +262,19 @@ def _build_shard_slabs(data, lo, hi, n_shard, layout, np_dtype, d, width,
     sq = np.zeros(n_shard, np_dtype)
     sq[:m] = row_sq[lo:hi]
     out = dict(labels=labels, mask=mask, sq_norms=sq)
-    a, b = data.indptr[lo], data.indptr[hi]
-    rows = np.repeat(np.arange(m), row_nnz[lo:hi])
     if layout == "dense":
         out["X"] = _densify_rows(data, lo, hi, n_shard, d, np_dtype, row_nnz)
+    elif n_hot:
+        from cocoa_tpu.data import hybrid
+
+        X_hot, spi, spv = hybrid.split_slab(data, lo, hi, n_shard, rank,
+                                            n_hot, width, np_dtype)
+        out["X_hot"] = X_hot
+        out["sp_indices"] = spi
+        out["sp_values"] = spv
     else:
+        a, b = data.indptr[lo], data.indptr[hi]
+        rows = np.repeat(np.arange(m), row_nnz[lo:hi])
         cols = np.arange(a, b) - np.repeat(data.indptr[lo:hi], row_nnz[lo:hi])
         spi = np.zeros((n_shard, width), np.int32)
         spv = np.zeros((n_shard, width), np_dtype)
@@ -258,36 +282,34 @@ def _build_shard_slabs(data, lo, hi, n_shard, layout, np_dtype, d, width,
         spv[rows, cols] = data.values[a:b]
         out["sp_indices"] = spi
         out["sp_values"] = spv
+    if eval_dense:
+        out["X_eval"] = _densify_rows(data, lo, hi, n_shard, d, np_dtype,
+                                      row_nnz)
     return out
 
 
-def _shard_dataset_distributed(data, k, layout, np_dtype, mesh, sizes,
-                               offsets, n_shard, d, width, row_nnz,
-                               row_sq) -> ShardedDataset:
-    """Multi-process assembly: each process materializes ONLY the shards
-    whose dp mesh position is one of its own devices, then the global
-    (K, ...) arrays are assembled from the per-device pieces
-    (``jax.make_array_from_single_device_arrays``) — per-process host
-    memory stays ~1/P of the dense matrix instead of P full copies
-    (VERDICT r1 item 5; the reference reads only local HDFS blocks per
-    executor, OptUtils.scala:14).  dp-only meshes (the fp extension keeps
-    the replicated-assembly path)."""
+def _assemble_distributed(mesh, k, built, locals_, *, layout, n, d,
+                          n_shard, width, sizes, n_hot, hot_ids,
+                          eval_dense, np_dtype) -> ShardedDataset:
+    """Assemble the global (K, ...) sharded arrays from per-device
+    (m, ...) slab stacks (``jax.make_array_from_single_device_arrays``):
+    ``built`` maps shard id → slab dict for THIS process's shards only,
+    ``locals_`` is the :func:`cocoa_tpu.parallel.mesh.dp_local_shards`
+    placement.  Shared by the whole-file distributed builder and
+    streaming ingest — the same assembly regardless of how the rows were
+    parsed."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    dev_grid = np.asarray(mesh.devices).reshape(k, -1)
-    me = jax.process_index()
-    local = {s: dev_grid[s, 0] for s in range(k)
-             if dev_grid[s, 0].process_index == me}
-    built = {
-        s: _build_shard_slabs(data, offsets[s], offsets[s + 1], n_shard,
-                              layout, np_dtype, d, width, row_nnz, row_sq)
-        for s in local
-    }
-
-    def assemble(field, trailing):
-        sh = NamedSharding(mesh, P(mesh_lib.DP_AXIS, *([None] * len(trailing))))
-        pieces = [jax.device_put(built[s][field][None], dev)
-                  for s, dev in local.items()]
+    def assemble(field, trailing, synth=None):
+        sh = NamedSharding(mesh,
+                           P(mesh_lib.DP_AXIS, *([None] * len(trailing))))
+        pieces = [
+            jax.device_put(
+                np.stack([built[s][field] for s in range(lo, hi)])
+                if synth is None else np.tile(synth[None], (hi - lo, 1)),
+                dev)
+            for dev, lo, hi in locals_
+        ]
         return jax.make_array_from_single_device_arrays(
             (k, *trailing), sh, pieces
         )
@@ -298,9 +320,18 @@ def _shard_dataset_distributed(data, k, layout, np_dtype, mesh, sizes,
     else:
         kwargs["sp_indices"] = assemble("sp_indices", (n_shard, width))
         kwargs["sp_values"] = assemble("sp_values", (n_shard, width))
+        if n_hot:
+            # panel lanes past the real hot count carry column id 0 and
+            # all-zero values — inert, the standing padding trick
+            hc = np.zeros(n_hot, dtype=np.int32)
+            hc[:len(hot_ids)] = hot_ids
+            kwargs["X_hot"] = assemble("X_hot", (n_shard, n_hot))
+            kwargs["hot_cols"] = assemble("hot_cols", (n_hot,), synth=hc)
+        if eval_dense:
+            kwargs["X_eval"] = assemble("X_eval", (n_shard, d))
     return ShardedDataset(
         layout=layout,
-        n=data.n,
+        n=n,
         num_features=d,
         counts=sizes.astype(np.int64),
         labels=assemble("labels", (n_shard,)),
@@ -308,6 +339,38 @@ def _shard_dataset_distributed(data, k, layout, np_dtype, mesh, sizes,
         sq_norms=assemble("sq_norms", (n_shard,)),
         **kwargs,
     )
+
+
+def _shard_dataset_distributed(data, k, layout, np_dtype, mesh, sizes,
+                               offsets, n_shard, d, width, row_nnz,
+                               row_sq, *, rank=None, n_hot=0,
+                               hot_ids=None,
+                               eval_dense=False) -> ShardedDataset:
+    """Multi-process assembly from a WHOLE-parsed dataset: each process
+    materializes ONLY the shards whose dp mesh position is one of its own
+    devices — m = K/D consecutive logical shards per device when the mesh
+    is multiplexed (D < K, the Spark ``coalesce`` analogue) — then the
+    global (K, ...) arrays are assembled from the per-device (m, ...)
+    stacks.  Per-process host memory stays ~1/P of the padded layout
+    instead of P full copies (VERDICT r1 item 5; the reference reads only
+    local HDFS blocks per executor, OptUtils.scala:14) — though every
+    process still parses the whole file here; ``--ingest=stream``
+    (data/ingest.py) removes that last full-dataset pass too.  The hybrid
+    hot/cold split and the dense eval twin build per shard exactly as on
+    the replicated path.  dp-only meshes (the fp extension keeps the
+    replicated-assembly path)."""
+    locals_ = mesh_lib.dp_local_shards(mesh, k)
+    built = {
+        s: _build_shard_slabs(data, offsets[s], offsets[s + 1], n_shard,
+                              layout, np_dtype, d, width, row_nnz, row_sq,
+                              rank=rank, n_hot=n_hot, eval_dense=eval_dense)
+        for _, lo, hi in locals_ for s in range(lo, hi)
+    }
+    return _assemble_distributed(mesh, k, built, locals_, layout=layout,
+                                 n=data.n, d=d, n_shard=n_shard,
+                                 width=width, sizes=sizes, n_hot=n_hot,
+                                 hot_ids=hot_ids, eval_dense=eval_dense,
+                                 np_dtype=np_dtype)
 
 
 def shard_dataset(
@@ -406,94 +469,53 @@ def shard_dataset(
         mesh is not None
         and jax.process_count() > 1
         and not mesh_lib.has_fp(mesh)
-        and mesh.devices.size != k
     ):
-        # a multiplexed dp mesh (D < K) would otherwise fall through to the
-        # single-process replicated builder: every process materializes the
-        # full (K, n_shard, d) dataset host-side and device_puts across
-        # non-addressable devices — a version-dependent crash or a
-        # per-process memory blow-up, never what was asked for (ADVICE r5;
-        # mirrors the explicit eval_dense rejection below)
-        raise ValueError(
-            f"multi-process runs need a dp mesh with exactly "
-            f"numSplits={k} positions, got {mesh.devices.size}; shard "
-            f"multiplexing (D < K) is single-process only — use "
-            f"numSplits == device count, or run single-process"
-        )
-    if (
-        mesh is not None
-        and jax.process_count() > 1
-        and not mesh_lib.has_fp(mesh)
-        and mesh.devices.size == k
-    ):
-        if eval_dense:
-            raise ValueError("eval_dense is not supported on the "
-                             "multi-process sharding path yet")
-        if n_hot:
-            raise ValueError("hot_cols is not supported on the "
-                             "multi-process sharding path yet")
+        if k % mesh.devices.size != 0:
+            # the multiplexed distributed builder stacks m = K/D shards
+            # per device; a non-divisor D has no even placement — the same
+            # rule fanout.shards_per_device enforces for the solvers
+            raise ValueError(
+                f"multi-process runs need numSplits divisible by the dp "
+                f"mesh size: K={k} shards cannot multiplex onto "
+                f"{mesh.devices.size} devices"
+            )
         return _shard_dataset_distributed(
             data, k, layout, np_dtype, mesh, sizes, offsets, n_shard,
             # mirror the replicated path: only the dense layout pads d
             mesh_lib.pad_features(d, mesh) if layout == "dense" else d,
-            width, row_nnz, row_sq,
+            width, row_nnz, row_sq, rank=rank, n_hot=n_hot,
+            hot_ids=hot_ids, eval_dense=eval_dense,
         )
 
-    labels = np.zeros((k, n_shard), dtype=np_dtype)
-    mask = np.zeros((k, n_shard), dtype=np_dtype)
-    sq_norms = np.zeros((k, n_shard), dtype=np_dtype)
-    for s in range(k):
-        lo, hi = offsets[s], offsets[s + 1]
-        m = hi - lo
-        labels[s, :m] = data.labels[lo:hi]
-        mask[s, :m] = 1.0
-        sq_norms[s, :m] = row_sq[lo:hi]
-
-    kwargs: dict = {}
     if layout == "dense":
         d = mesh_lib.pad_features(d, mesh)
-        X = np.zeros((k, n_shard, d), dtype=np_dtype)
-        for s in range(k):
-            lo, hi = offsets[s], offsets[s + 1]
-            X[s] = _densify_rows(data, lo, hi, n_shard, d, np_dtype, row_nnz)
-        kwargs["X"] = X
-    else:
-        sp_idx = np.zeros((k, n_shard, width), dtype=np.int32)
-        sp_val = np.zeros((k, n_shard, width), dtype=np_dtype)
-        X_hot = np.zeros((k, n_shard, n_hot), dtype=np_dtype) if n_hot \
-            else None
-        for s in range(k):
-            lo, hi = offsets[s], offsets[s + 1]
-            if n_hot:
-                from cocoa_tpu.data import hybrid
+    arrs: dict = {}
+    for s in range(k):
+        slab = _build_shard_slabs(data, offsets[s], offsets[s + 1],
+                                  n_shard, layout, np_dtype, d, width,
+                                  row_nnz, row_sq, rank=rank, n_hot=n_hot,
+                                  eval_dense=eval_dense)
+        for f, v in slab.items():
+            arrs.setdefault(f, np.zeros((k, *v.shape), v.dtype))[s] = v
+    if n_hot:
+        # panel lanes past the real hot count (d < n_hot after lane
+        # padding) carry column id 0 and all-zero values — inert in
+        # every gather and scatter, the standing padding trick
+        hc = np.zeros(n_hot, dtype=np.int32)
+        hc[:len(hot_ids)] = hot_ids
+        arrs["hot_cols"] = np.tile(hc[None], (k, 1))
+    return _finalize_replicated(arrs, layout=layout, n=n, d=d, mesh=mesh,
+                                sizes=sizes)
 
-                X_hot[s], sp_idx[s], sp_val[s] = hybrid.split_slab(
-                    data, lo, hi, n_shard, rank, n_hot, width, np_dtype)
-                continue
-            a, b = data.indptr[lo], data.indptr[hi]
-            rows = np.repeat(np.arange(hi - lo), row_nnz[lo:hi])
-            cols = np.arange(a, b) - np.repeat(data.indptr[lo:hi], row_nnz[lo:hi])
-            sp_idx[s][rows, cols] = data.indices[a:b]
-            sp_val[s][rows, cols] = data.values[a:b]
-        kwargs["sp_indices"] = sp_idx
-        kwargs["sp_values"] = sp_val
-        if n_hot:
-            # panel lanes past the real hot count (d < n_hot after lane
-            # padding) carry column id 0 and all-zero values — inert in
-            # every gather and scatter, the standing padding trick
-            hc = np.zeros(n_hot, dtype=np.int32)
-            hc[:len(hot_ids)] = hot_ids
-            kwargs["X_hot"] = X_hot
-            kwargs["hot_cols"] = np.tile(hc[None], (k, 1))
-        if eval_dense:
-            Xe = np.zeros((k, n_shard, d), dtype=np_dtype)
-            for s in range(k):
-                lo, hi = offsets[s], offsets[s + 1]
-                Xe[s] = _densify_rows(data, lo, hi, n_shard, d, np_dtype,
-                                      row_nnz)
-            kwargs["X_eval"] = Xe
 
+def _finalize_replicated(arrs, *, layout, n, d, mesh, sizes
+                         ) -> ShardedDataset:
+    """device_put the stacked (K, ...) host arrays and wrap them — the
+    tail of every single-process build (replicated whole-file and
+    streaming alike)."""
     def put(arr, fp_last=False):
+        if arr is None:
+            return None
         if mesh is not None:
             if fp_last:
                 return jax.device_put(arr, mesh_lib.x_sharding(mesh))
@@ -507,13 +529,13 @@ def shard_dataset(
         n=n,
         num_features=d,
         counts=sizes.astype(np.int64),
-        labels=put(labels),
-        mask=put(mask),
-        sq_norms=put(sq_norms),
-        X=put(kwargs["X"], fp_last=True) if "X" in kwargs else None,
-        sp_indices=put(kwargs["sp_indices"]) if "sp_indices" in kwargs else None,
-        sp_values=put(kwargs["sp_values"]) if "sp_values" in kwargs else None,
-        X_eval=put(kwargs["X_eval"]) if "X_eval" in kwargs else None,
-        X_hot=put(kwargs["X_hot"]) if "X_hot" in kwargs else None,
-        hot_cols=put(kwargs["hot_cols"]) if "hot_cols" in kwargs else None,
+        labels=put(arrs["labels"]),
+        mask=put(arrs["mask"]),
+        sq_norms=put(arrs["sq_norms"]),
+        X=put(arrs.get("X"), fp_last=True) if "X" in arrs else None,
+        sp_indices=put(arrs.get("sp_indices")),
+        sp_values=put(arrs.get("sp_values")),
+        X_eval=put(arrs.get("X_eval")),
+        X_hot=put(arrs.get("X_hot")),
+        hot_cols=put(arrs.get("hot_cols")),
     )

@@ -20,6 +20,13 @@ supervisor and re-executes its own command line N times with
 ``--master=127.0.0.1:<port> --processId=i --numProcesses=N --resume``.
 A fresh coordinator port is chosen per generation (a dying coordinator can
 leave the old port lingering in TIME_WAIT).
+
+Each (re)launched worker ingests data exactly like any multi-process run:
+``--ingest=auto`` streams — pass-1 index scan of 1/P of the LIBSVM file,
+pass-2 parse of only that worker's own shards' byte ranges (data/ingest.py,
+docs/DESIGN.md §12, README "Multi-host quickstart") — so a gang restart
+re-pays ~2/P of a full parse per worker, not P redundant whole-file
+parses.
 """
 
 from __future__ import annotations

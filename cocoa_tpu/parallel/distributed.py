@@ -71,3 +71,82 @@ def maybe_initialize(
         process_id=process_id,
     )
     return True
+
+
+# --- host-side metadata exchange -------------------------------------------
+#
+# Streaming ingest (data/ingest.py) assembles its global row index and
+# column histogram from per-process partials.  That exchange is HOST data
+# (numpy, before any device placement), so it rides the jax.distributed
+# coordination service's key-value store rather than an XLA collective:
+# no device round-trip, no dependency on cross-process jit support (which
+# older CPU backends lack — the Gloo collective path only has to carry
+# the training psums, exactly as before).
+
+
+def kv_client():
+    """The distributed coordination client, or None single-process.
+
+    Raises when multiple processes are live but the coordination service
+    is not — host-side exchanges have no fallback path in that state.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return None
+    from jax._src import distributed as _dist
+
+    client = getattr(_dist.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "multi-process run without a jax.distributed coordination "
+            "client; initialize via --master=host:port (or "
+            "jax.distributed.initialize) before streaming ingest"
+        )
+    return client
+
+
+# one raw chunk per KV value, base64-encoded below the coordinator's gRPC
+# message ceiling (4 MB default; 2 MB raw -> ~2.7 MB encoded)
+_KV_CHUNK = 2 << 20
+
+
+def host_allgather_bytes(tag: str, payload: bytes,
+                         timeout_s: float = 600.0) -> list:
+    """All-gather one bytes payload per process through the KV store.
+
+    Returns the payloads in process order (every process sees the same
+    list).  ``tag`` must be unique per logical exchange AND identical
+    across processes — callers derive it from an SPMD-deterministic
+    counter.  Single-process: returns ``[payload]`` with no coordinator.
+    """
+    import base64
+
+    import jax
+
+    client = kv_client()
+    if client is None:
+        return [payload]
+    me = jax.process_index()
+    nchunk = (len(payload) + _KV_CHUNK - 1) // _KV_CHUNK
+    for i in range(nchunk):
+        chunk = payload[i * _KV_CHUNK:(i + 1) * _KV_CHUNK]
+        client.key_value_set(f"cocoa/{tag}/{me}/{i}",
+                             base64.b64encode(chunk).decode())
+    client.key_value_set(f"cocoa/{tag}/{me}/n", str(nchunk))
+    timeout_ms = int(timeout_s * 1000)
+    out = []
+    for p in range(jax.process_count()):
+        if p == me:
+            out.append(payload)
+            continue
+        n = int(client.blocking_key_value_get(f"cocoa/{tag}/{p}/n",
+                                              timeout_ms))
+        parts = [
+            base64.b64decode(
+                client.blocking_key_value_get(f"cocoa/{tag}/{p}/{i}",
+                                              timeout_ms))
+            for i in range(n)
+        ]
+        out.append(b"".join(parts))
+    return out

@@ -97,6 +97,35 @@ def manual_axes(mesh: Optional[Mesh]) -> frozenset:
     return frozenset({DP_AXIS}) if has_fp(mesh) else frozenset()
 
 
+def dp_local_shards(mesh: Mesh, k: int) -> list:
+    """``[(device, shard_lo, shard_hi)]`` for THIS process's dp positions.
+
+    Under ``P('dp', ...)`` sharding of a (K, ...) array on a D-device dp
+    axis, dp position i holds the m = K/D consecutive logical shards
+    [i·m, (i+1)·m) — the same multiplexing contract
+    :func:`cocoa_tpu.parallel.fanout.shards_per_device` runs the solvers
+    under.  This is the placement map the distributed dataset builders
+    (whole-file and streaming ingest alike) use to materialize ONLY the
+    shards whose device lives in this process.
+    """
+    import numpy as np
+
+    d = mesh.shape[DP_AXIS]
+    if k % d != 0:
+        raise ValueError(
+            f"{k} shards cannot multiplex evenly onto the {d}-device dp "
+            f"axis; K must be a multiple of the mesh size"
+        )
+    m = k // d
+    grid = np.asarray(mesh.devices).reshape(d, -1)
+    me = jax.process_index()
+    return [
+        (grid[i, 0], i * m, (i + 1) * m)
+        for i in range(d)
+        if grid[i, 0].process_index == me
+    ]
+
+
 def sharded_rows(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
     """Sharding for per-shard stacked arrays of shape (K, ...): axis 0 on dp."""
     return NamedSharding(mesh, P(DP_AXIS, *([None] * extra_dims)))

@@ -47,6 +47,9 @@ EVENT_TYPES = (
     "host_transfer",    # one sanctioned device→host fetch (intended_fetch)
     "momentum_restart", # --accel: a gap rise reset the outer momentum
     "theta_stage",      # --accel: the Θ local-accuracy ladder stepped up
+    "ingest",           # one loaded LIBSVM file (data/ingest.IngestReport:
+                        # mode, parse seconds, bytes read, rows/nnz this
+                        # process materialized, peak host RSS)
 )
 
 

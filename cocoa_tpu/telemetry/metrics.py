@@ -21,6 +21,11 @@ run that is otherwise one opaque device dispatch:
 - ``cocoa_host_transfers_total``counter — sanctioned device→host fetch
   points (``intended_fetch``).  The drive loop's contract is ~1 per
   super-block; per-ROUND growth means a host sync leaked into the loop
+- ``cocoa_ingest_seconds``      gauge   — cumulative data-ingest parse
+  seconds this process spent (train + test files; the ``ingest`` event)
+- ``cocoa_ingest_bytes``        gauge   — cumulative bytes this process
+  read to ingest data (streamed runs read ~2/P of the file vs the whole
+  of it — the streaming win, observable)
 - ``cocoa_last_gap``            gauge   — most recent duality gap
 - ``cocoa_round_seconds``       histogram — observed per-round wall time
   (host-clock deltas between consecutive evals divided by the rounds
@@ -51,6 +56,8 @@ class MetricsWriter:
         self.theta_stage = None
         self.compiles_total = 0
         self.host_transfers_total = 0
+        self.ingest_seconds = 0.0
+        self.ingest_bytes = 0
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -105,6 +112,11 @@ class MetricsWriter:
             self.compiles_total += 1
         elif ev == "host_transfer":
             self.host_transfers_total += 1
+        elif ev == "ingest":
+            if rec.get("parse_seconds") is not None:
+                self.ingest_seconds += float(rec["parse_seconds"])
+            if rec.get("bytes_read") is not None:
+                self.ingest_bytes += int(rec["bytes_read"])
         self.write()
 
     def render(self) -> str:
@@ -123,6 +135,10 @@ class MetricsWriter:
             f"cocoa_compiles_total {self.compiles_total}",
             "# TYPE cocoa_host_transfers_total counter",
             f"cocoa_host_transfers_total {self.host_transfers_total}",
+            "# TYPE cocoa_ingest_seconds gauge",
+            f"cocoa_ingest_seconds {self.ingest_seconds!r}",
+            "# TYPE cocoa_ingest_bytes gauge",
+            f"cocoa_ingest_bytes {self.ingest_bytes}",
         ]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",

@@ -41,6 +41,24 @@ LAYOUT_SPLIT_FIELDS = {
     "panel_bytes": (int,),
 }
 
+# the run_start manifest's ingest record (--ingest provenance,
+# data/ingest.IngestReport): how the training data reached the device —
+# which mode, what this process parsed, and what it cost (the same fields
+# ride the typed ``ingest`` event)
+INGEST_FIELDS = {
+    "mode": (str,),
+    "path": (str,),
+    "file_bytes": (int,),
+    "processes": (int,),
+    "parse_seconds": _NUM,
+    "bytes_read": (int,),
+    "rows": (int,),
+    "nnz": (int,),
+    "n": (int,),
+    "total_nnz": (int,),
+    "peak_rss_bytes": (int,),
+}
+
 # event type -> {field: allowed types}; every event also needs seq/ts
 EVENT_FIELDS = {
     "run_start": {"manifest": (dict,)},
@@ -67,6 +85,9 @@ EVENT_FIELDS = {
                          "restarts_total": (int,)},
     "theta_stage": {"algorithm": (str,), "t": (int,), "stage": (int,),
                     "h": (int, type(None))},
+    # streaming/whole ingest of one LIBSVM file (data/ingest.py): what
+    # feeds cocoa_ingest_seconds / cocoa_ingest_bytes in --metrics
+    "ingest": INGEST_FIELDS,
 }
 
 TRAJ_RECORD_FIELDS = {
@@ -114,6 +135,13 @@ RESULTS_FIELDS = {
     "control_rounds": (int,), "rounds_ratio": _NUM,
     "accel_floor_rounds": (int,), "stopped": (str, type(None)),
     "sigma_ladder": (str,),
+    # the ingest A/B rows (benchmarks/run.py bench_ingest): per-process
+    # parse wallclock / bytes / peak host RSS, stream vs whole, with the
+    # perf.ingest_model predictions alongside
+    "mode": (str,), "processes": (int,), "file_mb": _NUM,
+    "parse_s": _NUM, "bytes_read_mb": _NUM, "peak_rss_mb": _NUM,
+    "rss_delta_mb": _NUM, "rss_vs_whole": _NUM,
+    "predicted_parse_s": _NUM, "predicted_csr_mb": _NUM,
 }
 
 
@@ -173,6 +201,13 @@ def check_event_lines(objs) -> list:
                 else:
                     _typecheck(split, LAYOUT_SPLIT_FIELDS,
                                f"{where}: layout_split", errors)
+            ing = man.get("ingest") if isinstance(man, dict) else None
+            if ing is not None:
+                if not isinstance(ing, dict):
+                    errors.append(f"{where}: ingest must be an object")
+                else:
+                    _typecheck(ing, INGEST_FIELDS,
+                               f"{where}: ingest", errors)
     return errors
 
 

@@ -65,6 +65,9 @@ struct Sink {
   double* values;
   int64_t cap_rows;   // hard bounds: a file that GROWS between the count
   int64_t cap_pairs;  // and parse passes must truncate, never overflow
+  int64_t* row_off = nullptr;  // optional: absolute byte offset of each
+                               // row's line start (the streaming-ingest
+                               // row index; nullptr = don't record)
   int64_t rows = 0;
   int64_t pairs = 0;
   bool truncated = false;
@@ -112,12 +115,18 @@ inline bool is_ws(char c) {
 // non-whitespace byte strictly before the line end (whitespace after
 // 'idx:' is treated as a malformed tail), so the parse cannot escape the
 // region.
-void parse_region(const char* p, const char* fend, Sink* out) {
+// ``abs_off`` is the absolute file offset of ``p`` (the tail of an
+// unterminated final line parses from a bounced copy, so pointer
+// arithmetic alone cannot recover file positions for the row index).
+void parse_region(const char* p, const char* fend, int64_t abs_off,
+                  Sink* out) {
+  const char* region_base = p;
   while (p < fend) {
     if (out->rows >= out->cap_rows) {
       out->truncated = true;
       return;
     }
+    const char* line_start = p;
     const char* eol = static_cast<const char*>(memchr(p, '\n', fend - p));
     if (!eol) eol = fend;
 
@@ -128,6 +137,8 @@ void parse_region(const char* p, const char* fend, Sink* out) {
       const char* sp = p;
       while (sp < eol && !is_ws(*sp)) ++sp;
       out->labels[out->rows] = parse_label(p, sp);
+      if (out->row_off)
+        out->row_off[out->rows] = abs_off + (line_start - region_base);
 
       // idx:val pairs
       p = sp;
@@ -214,6 +225,104 @@ Mapping map_file(const char* path) {
 
 constexpr size_t kWindow = size_t(16) << 20;
 
+#ifndef _WIN32
+// Resolve a raw byte range [lo, hi) to the line-aligned span [s_lo, s_hi)
+// it OWNS under the streaming-ingest ownership rule: a line belongs to the
+// range containing its first byte.  s_lo is the first line start >= lo
+// (lo itself when lo == 0 or the previous byte is '\n'); the last owned
+// line (start < hi) is parsed to ITS end, so s_hi runs to the first '\n'
+// at or past hi-1 (or EOF).  Ranges that tile the file therefore yield
+// spans that tile the newline structure exactly — no row parsed twice,
+// none skipped, regardless of where the raw split lands (mid-line, inside
+// a malformed tail, on a lone '\r', inside a run of blank lines).
+// Returns false when the range owns no lines.
+bool resolve_span(const char* buf, size_t size, int64_t lo, int64_t hi,
+                  size_t* s_lo, size_t* s_hi) {
+  if (lo < 0) lo = 0;
+  if (hi > static_cast<int64_t>(size)) hi = static_cast<int64_t>(size);
+  if (lo >= hi) return false;
+  size_t start;
+  if (lo == 0) {
+    start = 0;
+  } else {
+    const char* nl = static_cast<const char*>(
+        memchr(buf + (lo - 1), '\n', size - (lo - 1)));
+    if (!nl) return false;
+    start = static_cast<size_t>(nl - buf) + 1;
+  }
+  if (start >= static_cast<size_t>(hi)) return false;
+  const char* nl2 = static_cast<const char*>(
+      memchr(buf + (hi - 1), '\n', size - (hi - 1)));
+  *s_lo = start;
+  *s_hi = nl2 ? static_cast<size_t>(nl2 - buf) + 1 : size;
+  return true;
+}
+
+// Windowed parse of the line-aligned span [s_lo, s_hi): the newline-
+// terminated body parses in place (consumed pages released with
+// MADV_DONTNEED), and a final unterminated line (only possible when the
+// span ends at EOF) is bounced through a NUL-terminated copy so strtod
+// can never read past the mapping.
+void parse_span(const Mapping& m, size_t s_lo, size_t s_hi, Sink* sink) {
+  const char* fend = m.buf + s_hi;
+  const char* last_nl = static_cast<const char*>(
+      memrchr(m.buf + s_lo, '\n', s_hi - s_lo));
+  const char* main_end = last_nl ? last_nl + 1 : m.buf + s_lo;
+  const char* p = m.buf + s_lo;
+  while (p < main_end) {
+    const char* wend = p + kWindow;
+    if (wend >= main_end) {
+      wend = main_end;
+    } else {
+      wend = static_cast<const char*>(memrchr(p, '\n', wend - p));
+      wend = wend ? wend + 1 : main_end;  // pathological: one huge line
+    }
+    parse_region(p, wend, static_cast<int64_t>(p - m.buf), sink);
+    // drop only the newly-consumed pages (page-aligned inner range)
+    const long page = sysconf(_SC_PAGESIZE);
+    uintptr_t plo = (reinterpret_cast<uintptr_t>(p) + page - 1)
+                    / page * page;
+    uintptr_t phi = reinterpret_cast<uintptr_t>(wend) / page * page;
+    if (phi > plo)
+      madvise(reinterpret_cast<void*>(plo), phi - plo, MADV_DONTNEED);
+    p = wend;
+  }
+  if (main_end < fend) {
+    size_t tail = fend - main_end;
+    char* tbuf = static_cast<char*>(malloc(tail + 1));
+    if (tbuf) {
+      memcpy(tbuf, main_end, tail);
+      tbuf[tail] = '\0';
+      parse_region(tbuf, tbuf + tail,
+                   static_cast<int64_t>(main_end - m.buf), sink);
+      free(tbuf);
+    }
+  }
+}
+
+// Count '\n' and ':' within [s_lo, s_hi) (windowed, pages released).
+void count_span(const Mapping& m, size_t s_lo, size_t s_hi,
+                int64_t* newlines, int64_t* colons) {
+  *newlines = 0;
+  *colons = 0;
+  for (size_t off = s_lo; off < s_hi; off += kWindow) {
+    size_t len = s_hi - off < kWindow ? s_hi - off : kWindow;
+    const char* q = m.buf + off;
+    const char* qe = q + len;
+    while ((q = static_cast<const char*>(memchr(q, ':', qe - q)))) {
+      ++*colons;
+      ++q;
+    }
+    q = m.buf + off;
+    while ((q = static_cast<const char*>(memchr(q, '\n', qe - q)))) {
+      ++*newlines;
+      ++q;
+    }
+    madvise(m.buf + off, len, MADV_DONTNEED);
+  }
+}
+#endif
+
 }  // namespace
 
 extern "C" {
@@ -270,43 +379,7 @@ int cocoa_libsvm_parse(const char* path, double* labels, int64_t* indptr,
   Sink sink{labels, indptr, indices, values, cap_rows, cap_pairs};
   sink.indptr[0] = 0;
   if (m.size) {
-    const char* fend = m.buf + m.size;
-    const char* last_nl =
-        static_cast<const char*>(memrchr(m.buf, '\n', m.size));
-    const char* main_end = last_nl ? last_nl + 1 : m.buf;
-    const char* p = m.buf;
-    // windowed parse of the newline-terminated body; release consumed text
-    while (p < main_end) {
-      const char* wend = p + kWindow;
-      if (wend >= main_end) {
-        wend = main_end;
-      } else {
-        wend = static_cast<const char*>(memrchr(p, '\n', wend - p));
-        wend = wend ? wend + 1 : main_end;  // pathological: one huge line
-      }
-      parse_region(p, wend, &sink);
-      // drop only the newly-consumed pages (page-aligned inner range)
-      const long page = sysconf(_SC_PAGESIZE);
-      uintptr_t lo = (reinterpret_cast<uintptr_t>(p) + page - 1)
-                     / page * page;
-      uintptr_t hi = reinterpret_cast<uintptr_t>(wend) / page * page;
-      if (hi > lo)
-        madvise(reinterpret_cast<void*>(lo), hi - lo, MADV_DONTNEED);
-      p = wend;
-    }
-    // tail: a final line with no trailing newline could make strtod read
-    // one byte past the mapping (exact-page-multiple files) — bounce it
-    // through a small NUL-terminated copy
-    if (main_end < fend) {
-      size_t tail = fend - main_end;
-      char* tbuf = static_cast<char*>(malloc(tail + 1));
-      if (tbuf) {
-        memcpy(tbuf, main_end, tail);
-        tbuf[tail] = '\0';
-        parse_region(tbuf, tbuf + tail, &sink);
-        free(tbuf);
-      }
-    }
+    parse_span(m, 0, m.size, &sink);
     munmap(m.buf, m.size);
   }
   *rows_out = sink.rows;
@@ -316,6 +389,65 @@ int cocoa_libsvm_parse(const char* path, double* labels, int64_t* indptr,
   (void)path; (void)labels; (void)indptr; (void)indices; (void)values;
   (void)cap_rows; (void)cap_pairs;
   (void)rows_out; (void)pairs_out;
+  return -1;
+#endif
+}
+
+// Upper-bound counts for the byte range [lo, hi) under the streaming-
+// ingest ownership rule (see resolve_span): rows <= newlines-in-span + 1,
+// pairs <= ':'-count-in-span.  Returns 0 on success, -1 when the file
+// cannot be mmap'd.  A range that owns no lines reports 0/0.
+int cocoa_libsvm_count_range(const char* path, int64_t lo, int64_t hi,
+                             int64_t* rows_out, int64_t* pairs_out) {
+#ifndef _WIN32
+  Mapping m = map_file(path);
+  if (!m.ok) return -1;
+  *rows_out = 0;
+  *pairs_out = 0;
+  size_t s_lo, s_hi;
+  if (m.size && resolve_span(m.buf, m.size, lo, hi, &s_lo, &s_hi)) {
+    int64_t newlines, colons;
+    count_span(m, s_lo, s_hi, &newlines, &colons);
+    *rows_out = newlines + 1;
+    *pairs_out = colons;
+  }
+  if (m.buf) munmap(m.buf, m.size);
+  return 0;
+#else
+  (void)path; (void)lo; (void)hi; (void)rows_out; (void)pairs_out;
+  return -1;
+#endif
+}
+
+// Parse the rows OWNED by the byte range [lo, hi) (ownership rule in
+// resolve_span) into caller-allocated buffers sized from
+// cocoa_libsvm_count_range.  ``row_off`` (cap_rows entries, may be null)
+// receives the absolute byte offset of each row's line start — the
+// per-row index streaming ingest uses to map shard row ranges back to
+// exact byte ranges for pass 2.  Return codes as cocoa_libsvm_parse.
+int cocoa_libsvm_parse_range(const char* path, int64_t lo, int64_t hi,
+                             double* labels, int64_t* indptr,
+                             int32_t* indices, double* values,
+                             int64_t* row_off, int64_t cap_rows,
+                             int64_t cap_pairs, int64_t* rows_out,
+                             int64_t* pairs_out) {
+#ifndef _WIN32
+  Mapping m = map_file(path);
+  if (!m.ok) return -1;
+  Sink sink{labels, indptr, indices, values, cap_rows, cap_pairs};
+  sink.row_off = row_off;
+  sink.indptr[0] = 0;
+  size_t s_lo, s_hi;
+  if (m.size && resolve_span(m.buf, m.size, lo, hi, &s_lo, &s_hi))
+    parse_span(m, s_lo, s_hi, &sink);
+  if (m.buf) munmap(m.buf, m.size);
+  *rows_out = sink.rows;
+  *pairs_out = sink.pairs;
+  return sink.truncated ? 1 : 0;
+#else
+  (void)path; (void)lo; (void)hi; (void)labels; (void)indptr;
+  (void)indices; (void)values; (void)row_off; (void)cap_rows;
+  (void)cap_pairs; (void)rows_out; (void)pairs_out;
   return -1;
 #endif
 }

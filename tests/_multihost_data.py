@@ -1,6 +1,6 @@
-"""The deterministic dataset shared by tests/test_multihost.py's in-process
-comparison and its subprocess workers (both import this module, so the two
-sides can never desynchronize)."""
+"""The deterministic dataset shared by tests/test_multihost.py's and
+tests/test_ingest.py's in-process comparisons and their subprocess workers
+(all sides import this module, so they can never desynchronize)."""
 
 import numpy as np
 
@@ -26,3 +26,19 @@ def build_data():
         values=np.concatenate(values),
         num_features=D,
     )
+
+
+def write_libsvm(path):
+    """The same dataset as LIBSVM text (1-based indices, repr-precision
+    values so the f64 parse round-trips bit-exactly) — the file the
+    streaming-ingest harness (tests/test_ingest.py) feeds both the
+    streamed workers and the whole-file control."""
+    data = build_data()
+    with open(path, "w") as f:
+        for i in range(data.n):
+            lo, hi = data.indptr[i], data.indptr[i + 1]
+            pairs = " ".join(
+                f"{j + 1}:{float(v)!r}"
+                for j, v in zip(data.indices[lo:hi], data.values[lo:hi]))
+            f.write(f"{int(data.labels[i])} {pairs}\n")
+    return data

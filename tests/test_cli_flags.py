@@ -159,3 +159,47 @@ def test_cli_sigma_schedule_and_warm_start_flags():
     assert extras["sigmaSchedule"] == "anneal"
     assert extras["warmStart"] == "0.1,300"
     assert extras["gapTarget"] == "1e-4"
+
+
+def test_cli_ingest_flag(tmp_path, capsys):
+    """--ingest lands in the run-level extras; bad values and unsupported
+    combinations (lasso, fp meshes) fail with the CLI convention — the
+    streaming path must reject loudly, never fall back silently."""
+    cfg, extras = parse_args(["--ingest=stream"])
+    assert extras["ingest"] == "stream"
+
+    from cocoa_tpu.cli import main
+    from cocoa_tpu.data.synth import synth_sparse, write_libsvm
+
+    path = str(tmp_path / "t.dat")
+    write_libsvm(synth_sparse(64, 400, nnz_mean=8, seed=0), path)
+    base = [f"--trainFile={path}", "--numFeatures=400", "--numSplits=4",
+            "--mesh=1", "--numRounds=1", "--debugIter=0"]
+    assert main(base + ["--ingest=shard"]) == 2
+    assert "stream|whole|auto" in capsys.readouterr().err
+    assert main(base + ["--ingest=stream", "--objective=lasso",
+                        "--lambda=0.1"]) == 2
+    assert "lasso" in capsys.readouterr().err
+    # an explicit stream on a single process still streams (exit 0): the
+    # replicated build path, byte-range parsed
+    assert main(base + ["--ingest=stream", "--quiet"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_ingest_stream_whole_same_result(tmp_path, capsys):
+    """End-to-end CLI A/B: --ingest=stream and --ingest=whole print the
+    same final summary lines (same trained model) on the same file."""
+    from conftest import SMALL_TRAIN as train
+
+    from cocoa_tpu.cli import main
+
+    base = [f"--trainFile={train}", "--numFeatures=9947", "--numSplits=4",
+            "--mesh=1", "--numRounds=2", "--debugIter=1",
+            "--justCoCoA=true"]
+    assert main(base + ["--ingest=whole"]) == 0
+    whole = [ln for ln in capsys.readouterr().out.splitlines()
+             if "primal" in ln.lower() or "gap" in ln.lower()]
+    assert main(base + ["--ingest=stream"]) == 0
+    stream = [ln for ln in capsys.readouterr().out.splitlines()
+              if "primal" in ln.lower() or "gap" in ln.lower()]
+    assert whole and whole == stream

@@ -241,3 +241,133 @@ def test_native_parser_malformed_whitespace_tails(tmp_path):
     np.testing.assert_array_equal(py.indptr, [0, 2, 1 + 2, 1 + 2, 1 + 2, 2 + 2])
     np.testing.assert_array_equal(py.indices, [0, 1, 0, 2**31 - 1])
     np.testing.assert_array_equal(py.values, [2.0, 3.0, 4.0, 5.0])
+
+
+# --- byte-range (chunk-boundary) parity -----------------------------------
+#
+# Streaming ingest (data/ingest.py) parses the file as byte ranges that
+# tile it.  The ownership rule — a line belongs to the range containing
+# its FIRST byte; the last owned line parses to its own end even past hi
+# — must make any tiling parse to exactly the whole-file result, each row
+# once, on BOTH parsers, byte-for-byte.  The fixture packs the nastiest
+# grammar cases (malformed idx:val tail, a lone '\r', empty lines) so
+# every split point lands inside one of them at some sweep position.
+
+_RANGE_FIXTURE = (
+    b"1 1:1.0 2:2.5\n"        # clean row
+    b"\n"                     # empty line (no row)
+    b"-1 3: \n"               # malformed tail: space after ':'
+    b"1 1:4.0\r2:3.0\n"       # lone '\r' = in-line whitespace, one row
+    b"\r\n"                   # '\r' alone on a line: blank row, dropped
+    b"-1 2:3.0x 4:9\n"        # junk glued to a value ends the pair list
+    b"1 5:6.25"               # final row without trailing newline
+)
+
+
+def _range_parsers(tmp_path):
+    from cocoa_tpu.data import native_loader
+    from cocoa_tpu.data.libsvm import load_libsvm_python_range
+
+    parsers = [("python", load_libsvm_python_range)]
+    if native_loader.available():
+        parsers.append(
+            ("native", lambda p, d, lo, hi: native_loader.parse_range(
+                p, lo, hi, d)))
+    return parsers
+
+
+def _concat_ranges(parse, path, d, splits):
+    """Parse [0,s1), [s1,s2), ..., [sn,size) and concatenate."""
+    datas, offs = [], []
+    bounds = [0, *splits, os.path.getsize(path)]
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        data, off = parse(path, d, lo, hi)
+        datas.append(data)
+        offs.append(off)
+    labels = np.concatenate([x.labels for x in datas])
+    indices = np.concatenate([x.indices for x in datas])
+    values = np.concatenate([x.values for x in datas])
+    nnzs = np.concatenate([np.diff(x.indptr) for x in datas])
+    indptr = np.concatenate([[0], np.cumsum(nnzs)])
+    return labels, indptr, indices, values, np.concatenate(offs)
+
+
+def test_range_parse_tiles_to_whole_every_split(tmp_path):
+    """Every single split point of the nasty fixture: the two-range parse
+    equals the whole parse byte-for-byte on both parsers (the
+    chunk-boundary guarantee streaming ingest stands on)."""
+    path = tmp_path / "range.svm"
+    path.write_bytes(_RANGE_FIXTURE)
+    d = 10
+    for name, parse in _range_parsers(tmp_path):
+        whole, woff = parse(str(path), d, 0, len(_RANGE_FIXTURE))
+        assert whole.n == 5
+        np.testing.assert_array_equal(whole.labels, [1, -1, 1, -1, 1])
+        for cut in range(len(_RANGE_FIXTURE) + 1):
+            labels, indptr, indices, values, offs = _concat_ranges(
+                parse, str(path), d, [cut])
+            np.testing.assert_array_equal(labels, whole.labels, err_msg=f"{name} cut={cut}")
+            np.testing.assert_array_equal(indptr, whole.indptr, err_msg=f"{name} cut={cut}")
+            np.testing.assert_array_equal(indices, whole.indices, err_msg=f"{name} cut={cut}")
+            np.testing.assert_array_equal(values, whole.values, err_msg=f"{name} cut={cut}")
+            np.testing.assert_array_equal(offs, woff, err_msg=f"{name} cut={cut}")
+
+
+def test_range_parse_native_python_parity_every_split(tmp_path):
+    """Native and Python range parsers agree on every split point —
+    including the row_off byte offsets (the streaming index rides them)."""
+    from cocoa_tpu.data import native_loader
+    from cocoa_tpu.data.libsvm import load_libsvm_python_range
+
+    if not native_loader.available():
+        pytest.skip("native parser not built (make -C native)")
+    path = tmp_path / "parity_range.svm"
+    path.write_bytes(_RANGE_FIXTURE)
+    d = 10
+    for cut in range(len(_RANGE_FIXTURE) + 1):
+        for lo, hi in ((0, cut), (cut, len(_RANGE_FIXTURE))):
+            py, py_off = load_libsvm_python_range(str(path), d, lo, hi)
+            nat, nat_off = native_loader.parse_range(str(path), lo, hi, d)
+            np.testing.assert_array_equal(nat.labels, py.labels)
+            np.testing.assert_array_equal(nat.indptr, py.indptr)
+            np.testing.assert_array_equal(nat.indices, py.indices)
+            np.testing.assert_array_equal(nat.values, py.values)
+            np.testing.assert_array_equal(nat_off, py_off)
+
+
+def test_range_parse_three_way_tiling_real_file():
+    """Multi-range tilings of the real small_train file reassemble the
+    whole parse exactly (both parsers), at awkward uneven boundaries."""
+    d = 2**31
+    size = os.path.getsize(SMALL_TRAIN)
+    for name, parse in _range_parsers(None):
+        whole, _ = parse(SMALL_TRAIN, d, 0, size)
+        for splits in ([size // 3, 2 * size // 3],
+                       [1, size - 1],
+                       [997, 998, size // 2 + 13]):
+            labels, indptr, indices, values, _ = _concat_ranges(
+                parse, SMALL_TRAIN, d, splits)
+            np.testing.assert_array_equal(labels, whole.labels)
+            np.testing.assert_array_equal(indptr, whole.indptr)
+            np.testing.assert_array_equal(indices, whole.indices)
+            np.testing.assert_array_equal(values, whole.values)
+
+
+def test_to_dense_vectorized_semantics():
+    """to_dense is one global scatter now; a duplicate column inside a row
+    must still keep the LAST occurrence (the per-row fancy-assignment
+    semantics it replaced), and empty rows stay zero."""
+    from cocoa_tpu.data.libsvm import LibsvmData
+
+    data = LibsvmData(
+        labels=np.asarray([1.0, -1.0, 1.0]),
+        indptr=np.asarray([0, 3, 3, 5], np.int64),
+        indices=np.asarray([2, 0, 2, 1, 4], np.int32),  # row0 dups col 2
+        values=np.asarray([5.0, 1.0, 7.0, 2.0, 3.0]),
+        num_features=6,
+    )
+    out = data.to_dense()
+    expect = np.zeros((3, 6))
+    expect[0, 0], expect[0, 2] = 1.0, 7.0   # last occurrence wins
+    expect[2, 1], expect[2, 4] = 2.0, 3.0
+    np.testing.assert_array_equal(out, expect)

@@ -205,3 +205,47 @@ def test_predict_accel_rounds_validations():
         perf.predict_accel_rounds(100, 1e-4, 1.0)
     with pytest.raises(ValueError, match="rounds_plain"):
         perf.predict_accel_rounds(0, 1.0, 1e-4)
+
+
+def test_ingest_model_whole_fixture():
+    """whole mode, hand-computed: one full-file parse at the calibrated
+    rate, full host CSR held.  file 90 MB (exactly 1 s at 90e6 B/s),
+    n=10_000, nnz=750_000, d=47_236."""
+    m = perf.ingest_model(90_000_000, 10_000, 750_000, 4,
+                          mode="whole", d=47_236)
+    assert m["bytes_read"] == 90_000_000.0
+    assert m["parse_seconds"] == pytest.approx(1.0)
+    # 8n labels + 8(n+1) indptr + 4nnz indices + 8nnz values
+    assert m["csr_peak_bytes"] == (8 * 10_000 + 8 * 10_001
+                                   + 4 * 750_000 + 8 * 750_000)
+
+
+def test_ingest_model_stream_fixture():
+    """stream mode, hand-computed at P=4: 2·(file/4) parsed, the held CSR
+    shrinks to CSR/4 + the global index (row_off + row_nnz + hist)."""
+    m = perf.ingest_model(90_000_000, 10_000, 750_000, 4,
+                          mode="stream", d=47_236)
+    assert m["bytes_read"] == 45_000_000.0
+    exchange = 3 * (8 * 10_000 + 8 * 47_236)
+    assert m["parse_seconds"] == pytest.approx(
+        45_000_000 / 90e6 + exchange / 50e6)
+    csr = 8 * 10_000 + 8 * 10_001 + 4 * 750_000 + 8 * 750_000
+    index = 8 * 10_001 + 8 * 10_000 + 8 * 47_236
+    assert m["csr_peak_bytes"] == pytest.approx(csr / 4 + index)
+
+
+def test_ingest_model_ratios_and_validation():
+    """The model's headline ratios: at P processes the streamed parse
+    work is ~2/P of whole (P/2 speedup once P > 2), and the held CSR is
+    ~1/P — the ≤60% RSS acceptance bar of the ingest bench row follows
+    at P=2 for any dataset whose CSR dominates the index."""
+    # big file so the KV exchange term is negligible in the ratio
+    whole = perf.ingest_model(8e9, 1_000_000, 75_000_000, 8,
+                              mode="whole", d=47_236)
+    stream = perf.ingest_model(8e9, 1_000_000, 75_000_000, 8,
+                               mode="stream", d=47_236)
+    assert stream["bytes_read"] == pytest.approx(
+        whole["bytes_read"] / 4)                   # 2/P at P=8
+    assert stream["csr_peak_bytes"] < 0.2 * whole["csr_peak_bytes"]
+    with pytest.raises(ValueError, match="whole|stream"):
+        perf.ingest_model(1e6, 10, 100, 2, mode="mmap", d=10)

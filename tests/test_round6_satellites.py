@@ -2,6 +2,8 @@
 
 - data/sharding.py: multi-process + multiplexed dp mesh (D != K) must be
   an explicit error, not a silent fall-through to the replicated builder.
+  (Round 13 lifts this for divisible K — the distributed builder stacks
+  m = K/D shards per device; only a NON-divisor K stays a loud error.)
 - solvers/base.py: the divergence guard is a resolvable flag
   (--divergenceGuard=auto|on|off; auto arms only below the safe K·γ σ′).
 - solvers/base.py drive_on_device: a stall-guard fire on the FINAL chunk
@@ -40,13 +42,25 @@ def _dense_data(n=48, d=16, seed=0):
 # --- data/sharding.py: multi-process multiplexed-mesh guard ---------------
 
 
-def test_multiprocess_multiplexed_mesh_rejected(monkeypatch):
+def test_multiprocess_multiplexed_mesh_accepted(monkeypatch):
+    """Round 13 lifts the round-6 rejection: a multi-process multiplexed
+    dp mesh (K divisible by D) routes through the distributed builder —
+    with every device addressable it must reproduce the replicated
+    control bit-for-bit; a non-divisor K stays a loud error."""
     data = _dense_data()
     mesh = make_mesh(2)
+    ctrl = shard_dataset(data, k=4, layout="dense", dtype=jnp.float32,
+                         mesh=mesh)
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    with pytest.raises(ValueError, match="numSplits == device count"):
-        shard_dataset(data, k=4, layout="dense", dtype=jnp.float32,
+    with pytest.raises(ValueError, match="divisible by the dp mesh"):
+        shard_dataset(data, k=3, layout="dense", dtype=jnp.float32,
                       mesh=mesh)
+    ds = shard_dataset(data, k=4, layout="dense", dtype=jnp.float32,
+                       mesh=mesh)
+    assert ds.k == 4
+    for field, want in ctrl.shard_arrays().items():
+        np.testing.assert_array_equal(np.asarray(ds.shard_arrays()[field]),
+                                      np.asarray(want), err_msg=field)
 
 
 def test_singleprocess_multiplexed_mesh_still_works():
