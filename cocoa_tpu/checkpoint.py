@@ -8,16 +8,36 @@ equivalent: a round-stamped device→host save of the full optimizer state
 Plain ``.npz`` + a JSON sidecar is deliberate: the state is two arrays and
 three scalars; orbax would be justified the day state becomes a nested
 pytree across hosts.
+
+Failure hardening (docs/DESIGN.md §13): the writer keeps the last
+:data:`KEEP_GENERATIONS` round-stamped checkpoints per algorithm (older
+generations are pruned — a long run must not grow its directory without
+bound, and one healthy predecessor is the torn-file fallback);
+:func:`latest` VALIDATES each generation on discovery — npz readable,
+meta parses, array shapes match the shapes the meta records — and falls
+back to the previous generation when the newest is torn or corrupt,
+emitting a typed ``checkpoint_corrupt`` event.  The atomic-rename write
+protocol already makes a mid-save kill safe; validation covers what the
+protocol cannot: disk-level corruption, a torn copy from remote storage,
+or a file damaged after it landed.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import sys
 from typing import Optional
 
 import jax
 import numpy as np
+
+# round-stamped generations kept per algorithm; the newest is the resume
+# point, the one before it the fallback when the newest fails validation
+KEEP_GENERATIONS = 2
+
+_STAMP = r"-r(\d+)\.npz$"
 
 
 def save(
@@ -56,6 +76,11 @@ def save(
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
     meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
+    # array shapes recorded in the meta give :func:`validate` a
+    # self-contained integrity check: a torn or bit-rotted archive whose
+    # zip structure still opens is caught by the shape (or the member
+    # decompression) disagreeing with what the writer recorded
+    shapes = {"w": list(np.shape(w))}
     if sched is not None:
         # float32 -> python float is exact; json.dump emits Infinity for
         # the watch's untouched best-gap slots (python json reads it back)
@@ -71,6 +96,11 @@ def save(
         from jax.experimental import multihost_utils
 
         alpha = multihost_utils.process_allgather(alpha, tiled=True)
+    if alpha is not None:
+        shapes["alpha"] = list(np.shape(alpha))
+    if hist is not None:
+        shapes["hist"] = list(np.shape(hist))
+    meta["shapes"] = shapes
     # meta travels INSIDE the .npz (a unicode array — no pickling), so the
     # archive is self-describing and a stale same-named .npz from an
     # earlier run in a reused directory can never be paired with a fresh
@@ -102,6 +132,24 @@ def save(
                 os.unlink(os.path.join(directory, name))
             except OSError:
                 pass
+    # generation pruning: keep the newest KEEP_GENERATIONS round-stamped
+    # checkpoints of this algorithm (+ sidecars), drop the rest — bounded
+    # disk for long runs, one predecessor retained as the corruption
+    # fallback.  Only rounds <= the round just written are candidates: a
+    # reused directory can hold HIGHER-round leftovers from an earlier
+    # run, and pruning against those would delete the file this save
+    # just produced (stale files stay exactly as benign/visible as they
+    # were before pruning existed).  Multi-host peers prune the same set
+    # concurrently; a peer winning the unlink race is fine (OSError pass).
+    stamp = re.compile(re.escape(algorithm) + _STAMP)
+    ours = [p for p in generations(directory, algorithm)
+            if int(stamp.search(p).group(1)) <= round_t]
+    for old in ours[:-KEEP_GENERATIONS]:
+        for victim in (old, old + ".json"):
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass
     # every save flows through here (all drive* paths), so this is the one
     # emission point for the checkpoint_write event — what the elastic
     # supervisor's progress watch and external monitors key on
@@ -112,16 +160,89 @@ def save(
     return path
 
 
-def latest(directory: str, algorithm: str) -> Optional[str]:
-    """Most recent checkpoint path for ``algorithm``, or None."""
+def generations(directory: str, algorithm: str) -> list:
+    """Round-stamped checkpoint paths for ``algorithm``, oldest → newest
+    (no validation — :func:`latest` is the validating reader).  The exact
+    ``<algorithm>-r<round>.npz`` stamp is matched, so ``CoCoA`` never
+    claims ``CoCoA+``'s files (the ADVICE-r5 prefix trap)."""
     if not os.path.isdir(directory):
-        return None
+        return []
     algorithm = algorithm.replace(" ", "_")
-    files = sorted(
-        f for f in os.listdir(directory)
-        if f.startswith(f"{algorithm}-r") and f.endswith(".npz")
-    )
-    return os.path.join(directory, files[-1]) if files else None
+    pat = re.compile(re.escape(algorithm) + _STAMP)
+    stamped = [(m, f) for f in os.listdir(directory)
+               if f.startswith(f"{algorithm}-r") and (m := pat.search(f))]
+    # NUMERIC round order: past round 999999 the 06d stamp widens and a
+    # lexicographic sort would rank r1000000 before r999999 — with
+    # KEEP_GENERATIONS pruning that would delete the newest file on
+    # every save thereafter, not just mis-order latest()
+    stamped.sort(key=lambda mf: (int(mf[0].group(1)), mf[1]))
+    return [os.path.join(directory, f) for _, f in stamped]
+
+
+def validate(path: str) -> Optional[str]:
+    """None when the checkpoint at ``path`` is healthy, else a reason
+    string.  Healthy = the npz opens, every array member decompresses
+    (zip CRC — catches torn/overwritten bytes), the meta parses, and each
+    array shape matches the shape the meta recorded at write time
+    (pre-``shapes`` checkpoints skip that last comparison)."""
+    try:
+        data = np.load(path)
+    except Exception as e:
+        return f"unreadable npz ({type(e).__name__}: {e})"
+    if not hasattr(data, "files"):
+        # np.load happily returns a bare ndarray for .npy bytes — a
+        # stray/overwritten file, not a checkpoint archive (and it has
+        # no close(), so it must never reach the finally below)
+        return "not an npz archive"
+    try:
+        if "_meta" in data.files:
+            meta = json.loads(str(data["_meta"]))
+        else:
+            with open(path + ".json") as f:
+                meta = json.load(f)
+        if not isinstance(meta.get("round"), int):
+            return "meta carries no integer 'round'"
+        arrays = {name: data[name] for name in data.files
+                  if name != "_meta"}  # full decompression = CRC check
+        if "w" not in arrays:
+            return "archive carries no 'w' array"
+        for name, shape in (meta.get("shapes") or {}).items():
+            if name not in arrays:
+                return f"array {name!r} recorded in meta is missing"
+            if list(arrays[name].shape) != list(shape):
+                return (f"array {name!r} has shape "
+                        f"{list(arrays[name].shape)}, meta recorded "
+                        f"{list(shape)}")
+    except Exception as e:
+        return f"corrupt ({type(e).__name__}: {e})"
+    finally:
+        data.close()
+    return None
+
+
+def latest(directory: str, algorithm: str) -> Optional[str]:
+    """Most recent HEALTHY checkpoint path for ``algorithm``, or None.
+
+    Each retained generation is validated newest-first
+    (:func:`validate`); a torn or corrupt one is skipped — with a typed
+    ``checkpoint_corrupt`` event and a stderr note — and the reader falls
+    back to the previous generation.  The cost of that fallback is
+    bounded by the checkpoint cadence, exactly like the cost of a crash;
+    the alternative (resuming round 1, or crashing on a half-written
+    file) is what this guards against."""
+    for path in reversed(generations(directory, algorithm)):
+        reason = validate(path)
+        if reason is None:
+            return path
+        from cocoa_tpu.telemetry import events as _tele
+
+        _tele.get_bus().emit(
+            "checkpoint_corrupt", algorithm=algorithm.replace(" ", "_"),
+            path=path, reason=reason)
+        print(f"checkpoint: {path} failed validation ({reason}); "
+              f"falling back to the previous generation",
+              file=sys.stderr, flush=True)
+    return None
 
 
 def load(path: str):

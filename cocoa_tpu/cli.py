@@ -55,7 +55,13 @@ explicit sub-safe ``--sigma=<float>`` anneals from that start),
 ``--warmStart=<s>,<rounds>`` (smooth_hinge(s) warm phase handing off to
 hinge at the first debugIter boundary ≥ rounds, inside the same device
 loop; requires --loss=hinge), ``--elastic=N`` (gang supervisor: N worker
-processes, restart-from-checkpoint on any death), and
+processes, restart-from-checkpoint on any death; after ``max_restarts``
+consecutive failed same-size generations the gang is REFORMED at the
+largest P′ < P whose devices divide numSplits — shrink-to-survivors,
+cocoa_tpu/elastic.py, docs/DESIGN.md §13), ``--elastic=shrink`` /
+``--elastic=N,shrink`` (shrink immediately on the first worker loss —
+for deployments whose dead host is not coming back; the bare ``shrink``
+form takes the gang size from ``--numProcesses``), and
 ``--stallTimeout=S`` (with --elastic: also restart a gang that stops
 making checkpoint progress for S seconds without any process dying).
 
@@ -349,18 +355,87 @@ def main(argv=None) -> int:
         # and a supervisor-chosen coordinator port) and gang-restarts them
         # from the latest checkpoint when any worker dies.  The Spark-
         # lineage-recovery analogue for an all-reduce runtime
-        # (cocoa_tpu/elastic.py).
+        # (cocoa_tpu/elastic.py).  When the same-size gang cannot be kept
+        # alive (max_restarts consecutive failures — or immediately with
+        # the "shrink" spec), the supervisor reforms it at P′ < P
+        # survivors: numSplits shards re-divide over the smaller gang and
+        # each survivor streams in only its inherited shards
+        # (docs/DESIGN.md §13).
         from cocoa_tpu import elastic
 
-        try:
-            n_workers = int(extras["elastic"])
-        except ValueError:
-            print("error: --elastic must be an integer worker count",
-                  file=sys.stderr)
-            return 2
+        shrink_mode = "auto"
+        n_workers = None
+        devices_per_worker = 1
+        for part in str(extras["elastic"]).split(","):
+            part = part.strip()
+            if part == "shrink":
+                shrink_mode = "now"
+            elif part.startswith("devices="):
+                # local devices each worker owns (the per-host chip count
+                # on TPU; 1 for a localhost CPU gang) — the granularity
+                # shrink must keep K divisible by.  Declared, not probed:
+                # the supervisor must never initialize a backend itself
+                # (on a TPU host it would steal the chips from its own
+                # workers)
+                try:
+                    devices_per_worker = int(part[len("devices="):])
+                except ValueError:
+                    devices_per_worker = 0
+                if devices_per_worker < 1:
+                    print(f"error: --elastic devices= takes a positive "
+                          f"per-worker device count, got {part!r}",
+                          file=sys.stderr)
+                    return 2
+            elif part:
+                try:
+                    n_workers = int(part)
+                except ValueError:
+                    print("error: --elastic takes an integer worker count "
+                          "and/or 'shrink' and/or 'devices=D' "
+                          "(--elastic=4, --elastic=4,shrink, "
+                          "--elastic=shrink, --elastic=4,shrink,devices=4), "
+                          f"got {extras['elastic']!r}",
+                          file=sys.stderr)
+                    return 2
+        if n_workers is None:
+            # bare --elastic=shrink: the gang size comes from
+            # --numProcesses (the flag that already names it)
+            if not extras["numProcesses"]:
+                print("error: --elastic=shrink needs a gang size; pass "
+                      "--elastic=N,shrink or add --numProcesses=N",
+                      file=sys.stderr)
+                return 2
+            try:
+                n_workers = int(extras["numProcesses"])
+            except ValueError:
+                print("error: --numProcesses must be an integer",
+                      file=sys.stderr)
+                return 2
         if n_workers < 1:
             print("error: --elastic needs at least 1 worker", file=sys.stderr)
             return 2
+        try:
+            elastic_fp = int(extras["fp"]) if extras["fp"] else 1
+        except ValueError:
+            print(f"error: --fp must be an integer, got {extras['fp']!r}",
+                  file=sys.stderr)
+            return 2
+        if elastic_fp > 1:
+            # the fp axis pins w's column split to the device grid — a
+            # resized gang cannot restore the old checkpoints' placement.
+            # Explicit shrink is rejected loudly; the default degrades to
+            # the pre-shrink same-size supervision with a note.
+            if shrink_mode == "now":
+                print("error: --elastic=shrink does not support "
+                      "feature-parallel (fp) meshes: w's column split is "
+                      "pinned to the device grid, so a reformed gang "
+                      "cannot resume the checkpoints; drop --fp or use "
+                      "--elastic=N", file=sys.stderr)
+                return 2
+            shrink_mode = "off"
+            print("note: --elastic with --fp keeps same-size restarts "
+                  "only (an fp gang cannot shrink; see docs/DESIGN.md "
+                  "§13)", file=sys.stderr)
         if not cfg.chkpt_dir:
             print("warning: --elastic without --chkptDir restarts from "
                   "round 1 on failure (no checkpoints to resume from)",
@@ -424,17 +499,33 @@ def main(argv=None) -> int:
                       f">= 120s (and a --chkptIter the gang can reach "
                       f"within the timeout)", file=sys.stderr)
 
-        if extras["events"]:
-            # the supervisor's gang-restart events land in the SAME event
-            # JSONL worker 0 writes (whole-line appends interleave safely)
-            # — one machine-readable stream for the whole supervised run
+        if extras["events"] or extras["metrics"]:
+            # the supervisor's gang-restart/resize events land in the SAME
+            # event JSONL worker 0 writes (whole-line appends interleave
+            # safely) — one machine-readable stream for the whole
+            # supervised run.  The gang gauges (cocoa_gang_size,
+            # cocoa_gang_generations_total, cocoa_restart_backoff_seconds)
+            # land in a SIBLING textfile `<metrics>.gang` rendering ONLY
+            # those families: worker 0 owns `<metrics>` and rewrites it
+            # per event, so sharing one file would have two processes
+            # flip-flopping its contents — and duplicating the worker
+            # families here would break textfile collectors that glob
+            # the directory
             from cocoa_tpu import telemetry
 
-            telemetry.get_bus().configure(jsonl_path=extras["events"])
+            bus_sup = telemetry.get_bus()
+            bus_sup.configure(jsonl_path=extras["events"])
+            if extras["metrics"]:
+                from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+                bus_sup.subscribe(MetricsWriter(
+                    extras["metrics"] + ".gang", families="gang"))
         return elastic.supervise(
             elastic.strip_elastic_flags(argv), n_workers,
             resume=bool(cfg.chkpt_dir), progress_token=progress_token,
             stall_timeout_s=stall,
+            num_splits=cfg.num_splits, shrink=shrink_mode,
+            devices_per_worker=devices_per_worker,
         )
 
     # multi-host: --master=host:port connects this process to the pod's

@@ -37,6 +37,18 @@ to the whole-file ``bincount(...).max()``).
 The single-process replicated builder (``shard_dataset``) stays bit-exact
 as the A/B control; ``stream_shard_dataset`` with one process produces
 the identical ``ShardedDataset`` (pinned by tests/test_ingest.py).
+
+This pipeline is also the elastic supervisor's RESHARDING entry
+(cocoa_tpu/elastic.py shrink-to-survivors, docs/DESIGN.md §13): after a
+gang reforms at P′ < P, each survivor's relaunch lands here with the new
+process count and materializes exactly the byte ranges of its newly
+inherited m = K/D′ shards — shard assignment is re-solved by the same
+``mesh_lib.dp_local_shards`` placement map every multi-process run uses,
+so no shrink-specific build code exists to drift.  Every cross-process
+exchange below rides the bounded, retrying KV ops
+(distributed.blocking_kv_get): a peer that died between the supervisor's
+relaunch and this exchange fails the build in bounded time with the
+peer named, which the supervisor observes as a worker death and handles.
 """
 
 from __future__ import annotations
@@ -272,6 +284,10 @@ def stream_shard_dataset(
     distributed_build = (mesh is not None and jax.process_count() > 1)
     if distributed_build:
         if k % mesh.devices.size != 0:
+            # same divisibility contract as sharding.shard_dataset; the
+            # elastic shrink path only relaunches divisor-sized gangs
+            # (elastic.shrink_gang_size), so a reformed survivor gang can
+            # never trip this — only a hand-built mismatched launch does
             raise ValueError(
                 f"multi-process runs need numSplits divisible by the dp "
                 f"mesh size: K={k} shards cannot multiplex onto "

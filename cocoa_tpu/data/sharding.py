@@ -473,7 +473,11 @@ def shard_dataset(
         if k % mesh.devices.size != 0:
             # the multiplexed distributed builder stacks m = K/D shards
             # per device; a non-divisor D has no even placement — the same
-            # rule fanout.shards_per_device enforces for the solvers
+            # rule fanout.shards_per_device enforces for the solvers, and
+            # the divisibility contract the elastic supervisor's
+            # shrink-to-survivors path resolves gang sizes against
+            # (elastic.shrink_gang_size: a reformed gang is always a
+            # divisor, so a post-failure relaunch can never trip this)
             raise ValueError(
                 f"multi-process runs need numSplits divisible by the dp "
                 f"mesh size: K={k} shards cannot multiplex onto "

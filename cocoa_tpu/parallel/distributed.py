@@ -18,6 +18,7 @@ the coordinator address.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 
@@ -110,15 +111,82 @@ def kv_client():
 # message ceiling (4 MB default; 2 MB raw -> ~2.7 MB encoded)
 _KV_CHUNK = 2 << 20
 
+# KV-store wait policy (docs/DESIGN.md §13): one logical get is a BOUNDED
+# sequence of short blocking attempts with exponential backoff between
+# them, not one monolithic 600 s block.  Same total budget, but a
+# transient coordinator error retries instead of aborting the run, and
+# the final failure is an actionable message naming the missing peer/key
+# instead of a bare 10-minute gRPC deadline traceback.
+KV_TIMEOUT_S = 600.0       # total budget per logical key
+KV_ATTEMPT_S = 20.0        # per-attempt blocking wait
+_KV_BACKOFF_BASE_S = 0.25  # pause after a FAST failure (doubled, capped)
+_KV_BACKOFF_CAP_S = 5.0
+
+
+def blocking_kv_get(client, key: str, *, timeout_s: float = KV_TIMEOUT_S,
+                    attempt_s: float = KV_ATTEMPT_S,
+                    what: Optional[str] = None) -> str:
+    """A bounded, retrying ``blocking_key_value_get``.
+
+    Retries short blocking attempts (with capped exponential backoff
+    after fast failures) until ``timeout_s`` is spent, then raises a
+    RuntimeError naming the key — and ``what``, the peer/exchange it
+    stands for — with the remedy, chaining the last underlying error.
+    """
+    deadline = time.monotonic() + timeout_s
+    attempts = 0
+    fast_failures = 0
+    last_err = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        attempts += 1
+        wait_s = min(attempt_s, remaining)
+        t0 = time.monotonic()
+        try:
+            return client.blocking_key_value_get(
+                key, max(1, int(wait_s * 1000)))
+        except Exception as e:  # timeout / transient coordinator error
+            last_err = e
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        # back off only after FAST failures (coordinator refused or
+        # errored immediately): an attempt that consumed its blocking
+        # wait was already listening the whole time — sleeping after it
+        # would spend budget deaf and notice a late-published key up to
+        # cap-seconds late
+        if time.monotonic() - t0 < wait_s / 2.0:
+            fast_failures += 1
+            pause = min(_KV_BACKOFF_CAP_S,
+                        _KV_BACKOFF_BASE_S * (2.0 ** (fast_failures - 1)))
+            time.sleep(min(pause, remaining))
+    raise RuntimeError(
+        f"KV-store key {key!r}"
+        + (f" ({what})" if what else "")
+        + f" never appeared within {timeout_s:g}s ({attempts} attempt(s)): "
+        f"the process that should publish it likely died, or never "
+        f"reached this exchange — check its log; under --elastic the "
+        f"supervisor restarts (or shrinks) the gang automatically"
+    ) from last_err
+
 
 def host_allgather_bytes(tag: str, payload: bytes,
-                         timeout_s: float = 600.0) -> list:
+                         timeout_s: float = KV_TIMEOUT_S,
+                         attempt_s: float = KV_ATTEMPT_S) -> list:
     """All-gather one bytes payload per process through the KV store.
 
     Returns the payloads in process order (every process sees the same
     list).  ``tag`` must be unique per logical exchange AND identical
     across processes — callers derive it from an SPMD-deterministic
     counter.  Single-process: returns ``[payload]`` with no coordinator.
+
+    Every get rides :func:`blocking_kv_get`, so a peer that died before
+    publishing fails THIS process in bounded time with a message naming
+    the peer — the elastic supervisor then tears the gang down and
+    restarts or shrinks it, instead of every survivor hanging ~10
+    minutes in an uninformative gRPC deadline.
     """
     import base64
 
@@ -134,18 +202,20 @@ def host_allgather_bytes(tag: str, payload: bytes,
         client.key_value_set(f"cocoa/{tag}/{me}/{i}",
                              base64.b64encode(chunk).decode())
     client.key_value_set(f"cocoa/{tag}/{me}/n", str(nchunk))
-    timeout_ms = int(timeout_s * 1000)
     out = []
     for p in range(jax.process_count()):
         if p == me:
             out.append(payload)
             continue
-        n = int(client.blocking_key_value_get(f"cocoa/{tag}/{p}/n",
-                                              timeout_ms))
+        n = int(blocking_kv_get(
+            client, f"cocoa/{tag}/{p}/n", timeout_s=timeout_s,
+            attempt_s=attempt_s,
+            what=f"peer process {p}, exchange {tag!r}"))
         parts = [
-            base64.b64decode(
-                client.blocking_key_value_get(f"cocoa/{tag}/{p}/{i}",
-                                              timeout_ms))
+            base64.b64decode(blocking_kv_get(
+                client, f"cocoa/{tag}/{p}/{i}", timeout_s=timeout_s,
+                attempt_s=attempt_s,
+                what=f"peer process {p}, exchange {tag!r} chunk {i}/{n}"))
             for i in range(n)
         ]
         out.append(b"".join(parts))

@@ -114,7 +114,9 @@ def dp_local_shards(mesh: Mesh, k: int) -> list:
     if k % d != 0:
         raise ValueError(
             f"{k} shards cannot multiplex evenly onto the {d}-device dp "
-            f"axis; K must be a multiple of the mesh size"
+            f"axis; K must be a multiple of the mesh size (the elastic "
+            f"supervisor's shrink path only ever reforms gangs whose "
+            f"device count divides K — elastic.shrink_gang_size)"
         )
     m = k // d
     grid = np.asarray(mesh.devices).reshape(d, -1)

@@ -50,6 +50,12 @@ EVENT_TYPES = (
     "ingest",           # one loaded LIBSVM file (data/ingest.IngestReport:
                         # mode, parse seconds, bytes read, rows/nnz this
                         # process materialized, peak host RSS)
+    "gang_resize",      # the elastic supervisor reformed the gang at
+                        # P′ < P survivors (shrink-to-survivors,
+                        # cocoa_tpu/elastic.py, docs/DESIGN.md §13)
+    "checkpoint_corrupt",  # a checkpoint generation failed validation on
+                        # load; the reader fell back to the previous one
+                        # (checkpoint.latest)
 )
 
 

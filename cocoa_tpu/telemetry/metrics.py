@@ -26,6 +26,19 @@ run that is otherwise one opaque device dispatch:
 - ``cocoa_ingest_bytes``        gauge   — cumulative bytes this process
   read to ingest data (streamed runs read ~2/P of the file vs the whole
   of it — the streaming win, observable)
+- ``cocoa_gang_size``           gauge   — current elastic gang size after
+  a shrink-to-survivors resize (the ``gang_resize`` event; absent until
+  the first resize — the configured size is in the run manifest)
+- ``cocoa_gang_generations_total`` counter — elastic gang generations
+  launched (initial + every restart/resize; from the ``generation`` field
+  the supervisor stamps on restart/resize events)
+- ``cocoa_restart_backoff_seconds`` gauge — the backoff the supervisor
+  slept before the most recent relaunch (exponential with jitter, reset
+  on progress — a rising value means a crash loop, a reset means the run
+  advanced)
+- ``cocoa_checkpoint_corrupt_total`` counter — checkpoint generations
+  rejected by validation on load (the reader fell back to the previous
+  generation; any nonzero value deserves a disk/preemption look)
 - ``cocoa_last_gap``            gauge   — most recent duality gap
 - ``cocoa_round_seconds``       histogram — observed per-round wall time
   (host-clock deltas between consecutive evals divided by the rounds
@@ -46,13 +59,29 @@ BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 
 
 class MetricsWriter:
-    def __init__(self, path: str):
+    def __init__(self, path: str, families: str = "all"):
+        # families="gang": render ONLY the supervisor-owned gang families
+        # (cocoa_gang_size / cocoa_gang_generations_total /
+        # cocoa_restart_backoff_seconds) — the elastic supervisor's
+        # sibling `<metrics>.gang` textfile must not duplicate worker
+        # 0's series (a textfile collector globbing the directory
+        # rejects duplicate families, and the counters would mean
+        # different things in each file).  "all" (workers, single
+        # process) renders everything, with the gang families gated on
+        # having actually seen gang data for the same reason.
+        if families not in ("all", "gang"):
+            raise ValueError(f"families must be all|gang, got {families!r}")
+        self.families = families
         self.path = path
         self.rounds_total = 0
         self.evals_total = 0
         self.sigma_backoffs_total = 0
         self.restarts_total = 0
         self.momentum_restarts_total = 0
+        self.gang_size = None
+        self.gang_generations_total = 0
+        self.restart_backoff_seconds = None
+        self.checkpoint_corrupt_total = 0
         self.theta_stage = None
         self.compiles_total = 0
         self.host_transfers_total = 0
@@ -104,6 +133,23 @@ class MetricsWriter:
             self.sigma_backoffs_total += 1
         elif ev == "restart":
             self.restarts_total += 1
+            # elastic supervisor restarts carry the gang bookkeeping the
+            # σ′ trial rerun (same event type) does not
+            if rec.get("gang_size") is not None:
+                self.gang_size = int(rec["gang_size"])
+            if rec.get("backoff_s") is not None:
+                self.restart_backoff_seconds = float(rec["backoff_s"])
+            if rec.get("generation") is not None:
+                # generation = gangs spawned so far; the restart event
+                # precedes the relaunch that makes it generation+1
+                self.gang_generations_total = max(
+                    self.gang_generations_total, int(rec["generation"]) + 1)
+        elif ev == "gang_resize":
+            self.gang_size = int(rec["new_size"])
+            self.gang_generations_total = max(
+                self.gang_generations_total, int(rec["generation"]) + 1)
+        elif ev == "checkpoint_corrupt":
+            self.checkpoint_corrupt_total += 1
         elif ev == "momentum_restart":
             self.momentum_restarts_total += 1
         elif ev == "theta_stage":
@@ -119,7 +165,22 @@ class MetricsWriter:
                 self.ingest_bytes += int(rec["bytes_read"])
         self.write()
 
+    def _gang_lines(self) -> list:
+        lines = ["# TYPE cocoa_gang_generations_total counter",
+                 f"cocoa_gang_generations_total "
+                 f"{self.gang_generations_total}"]
+        if self.gang_size is not None:
+            lines += ["# TYPE cocoa_gang_size gauge",
+                      f"cocoa_gang_size {self.gang_size}"]
+        if self.restart_backoff_seconds is not None:
+            lines += ["# TYPE cocoa_restart_backoff_seconds gauge",
+                      f"cocoa_restart_backoff_seconds "
+                      f"{self.restart_backoff_seconds!r}"]
+        return lines
+
     def render(self) -> str:
+        if self.families == "gang":
+            return "\n".join(self._gang_lines()) + "\n"
         lines = [
             "# TYPE cocoa_rounds_total counter",
             f"cocoa_rounds_total {self.rounds_total}",
@@ -139,7 +200,14 @@ class MetricsWriter:
             f"cocoa_ingest_seconds {self.ingest_seconds!r}",
             "# TYPE cocoa_ingest_bytes gauge",
             f"cocoa_ingest_bytes {self.ingest_bytes}",
+            "# TYPE cocoa_checkpoint_corrupt_total counter",
+            f"cocoa_checkpoint_corrupt_total {self.checkpoint_corrupt_total}",
         ]
+        if self.gang_generations_total:
+            # gang families appear in an "all" file only when this
+            # process actually saw gang events (a worker never does —
+            # its file must not shadow the supervisor's .gang series)
+            lines += self._gang_lines()
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]

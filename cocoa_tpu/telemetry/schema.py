@@ -88,6 +88,17 @@ EVENT_FIELDS = {
     # streaming/whole ingest of one LIBSVM file (data/ingest.py): what
     # feeds cocoa_ingest_seconds / cocoa_ingest_bytes in --metrics
     "ingest": INGEST_FIELDS,
+    # the elastic supervisor reformed the gang at P′ < P survivors
+    # (cocoa_tpu/elastic.py shrink-to-survivors): what feeds the
+    # cocoa_gang_size gauge.  ``restart`` events additionally carry
+    # gang_size / backoff_s (not required here: the σ′ trial rerun emits
+    # restarts too, without a gang)
+    "gang_resize": {"reason": (str,), "old_size": (int,),
+                    "new_size": (int,), "generation": (int,)},
+    # a checkpoint generation failed validation on load and the reader
+    # fell back (checkpoint.latest) — the torn/corrupt-file recovery path
+    "checkpoint_corrupt": {"algorithm": (str,), "path": (str,),
+                           "reason": (str,)},
 }
 
 TRAJ_RECORD_FIELDS = {

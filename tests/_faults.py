@@ -1,0 +1,146 @@
+"""Deterministic fault injection for chaos tests and the CI chaos smoke.
+
+Faults are declarative: arm a :class:`FaultPlan` on the elastic
+supervisor's ``on_generation`` hook; each :class:`Fault` waits for its
+trigger (a file-system predicate — e.g. "a checkpoint at round >= r
+exists") on a daemon thread and then applies its actions to the live
+worker processes.  Actions are tiny composable closures:
+
+- :func:`sigkill` — SIGKILL one worker (host loss);
+- :func:`sigstop` — SIGSTOP one worker (alive but silent: the wedge the
+  ``--stallTimeout`` watchdog exists for);
+- :func:`truncate_newest_checkpoint` — tear the newest ``.npz`` (the
+  torn-write/bit-rot case ``checkpoint.validate`` guards).
+
+Everything is polled and file-based — no wall-clock races — so a chaos
+run is reproducible and CI-able: the same plan against the same worker
+command produces the same generation/kill/corruption sequence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import signal
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+
+def sigkill(idx: int) -> Callable:
+    """Action: SIGKILL worker ``idx`` (simulated host loss — the
+    supervisor sees a death; its peers wedge and are torn down)."""
+    def act(procs):
+        if idx < len(procs) and procs[idx].poll() is None:
+            procs[idx].send_signal(signal.SIGKILL)
+    return act
+
+
+def sigstop(idx: int) -> Callable:
+    """Action: SIGSTOP worker ``idx`` — alive, silent, making no
+    progress.  Death-only supervision polls this forever; only the
+    ``--stallTimeout`` watchdog recovers it."""
+    def act(procs):
+        if idx < len(procs) and procs[idx].poll() is None:
+            procs[idx].send_signal(signal.SIGSTOP)
+    return act
+
+
+def truncate_newest_checkpoint(ckdir, keep_bytes: int = 64) -> Callable:
+    """Action: tear the most recently WRITTEN ``.npz`` in ``ckdir`` down
+    to ``keep_bytes`` — the half-written/corrupt-copy file
+    ``checkpoint.validate`` must reject so ``latest`` falls back to the
+    previous generation.  Selected by mtime, not filename: a lexical
+    sort would rank every ``CoCoA-`` stamp after every ``CoCoA+`` one
+    ('+' < '-') and could tear a finished algorithm's file instead of
+    the in-flight one a preemption actually interrupts."""
+    def act(procs):
+        paths = [os.path.join(str(ckdir), f)
+                 for f in os.listdir(str(ckdir)) if f.endswith(".npz")]
+        if paths:
+            newest = max(paths, key=lambda p: (os.path.getmtime(p), p))
+            with open(newest, "r+b") as f:
+                f.truncate(keep_bytes)
+    return act
+
+
+def checkpoint_at_least(ckdir, algorithm: str,
+                        min_round: int = 1) -> Callable:
+    """Trigger: a round-stamped checkpoint for ``algorithm`` at round >=
+    ``min_round`` exists — "the run is demonstrably mid-flight"."""
+    stamp = re.compile(
+        re.escape(algorithm.replace(" ", "_")) + r"-r(\d+)\.npz$")
+    def ready() -> bool:
+        if not os.path.isdir(str(ckdir)):
+            return False
+        for f in os.listdir(str(ckdir)):
+            m = stamp.search(f)
+            if m and int(m.group(1)) >= min_round:
+                return True
+        return False
+    return ready
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault: on gang generation ``generation``, wait for
+    ``trigger`` (None = fire immediately), then apply ``actions`` in
+    order to the generation's worker processes."""
+
+    generation: int
+    actions: Sequence[Callable]
+    trigger: Optional[Callable] = None
+    name: str = ""
+
+
+class FaultPlan:
+    """Arms :class:`Fault`\\ s from the supervisor's ``on_generation``
+    hook.  ``fired`` records the faults that ran (assert on it);
+    ``errors`` records triggers that never came true before
+    ``timeout_s`` or after every worker exited — a chaos test must
+    assert ``errors == []`` so a silently-unfired fault cannot pass as
+    a survived one."""
+
+    def __init__(self, *faults: Fault, poll_s: float = 0.1,
+                 timeout_s: float = 180.0):
+        self.faults = list(faults)
+        self.poll_s = poll_s
+        self.timeout_s = timeout_s
+        self.fired: list = []
+        self.errors: list = []
+        self.generations: list = []
+        self._threads: list = []
+
+    def on_generation(self, gen: int, procs) -> None:
+        """The elastic supervisor hook (``supervise(on_generation=...)``)."""
+        self.generations.append(gen)
+        for fault in self.faults:
+            if fault.generation == gen:
+                t = threading.Thread(target=self._run,
+                                     args=(fault, list(procs)),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _run(self, fault: Fault, procs) -> None:
+        name = fault.name or f"fault@gen{fault.generation}"
+        deadline = time.monotonic() + self.timeout_s
+        while fault.trigger is not None and not fault.trigger():
+            if all(p.poll() is not None for p in procs):
+                self.errors.append(f"{name}: every worker exited before "
+                                   f"the trigger came true")
+                return
+            if time.monotonic() > deadline:
+                self.errors.append(f"{name}: trigger never came true "
+                                   f"within {self.timeout_s:g}s")
+                return
+            time.sleep(self.poll_s)
+        for act in fault.actions:
+            act(procs)
+        self.fired.append(name)
+
+    def join(self, timeout_s: float = 10.0) -> None:
+        """Wait for armed fault threads (call before asserting)."""
+        for t in self._threads:
+            t.join(timeout_s)

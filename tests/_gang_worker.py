@@ -1,0 +1,105 @@
+"""Minimal REAL-process gang worker for supervisor chaos tests.
+
+Runs under the elastic supervisor exactly like the CLI would
+(``python -m _gang_worker <flags> --master=... --processId=i
+--numProcesses=n [--resume]``): joins the jax.distributed runtime (real
+coordinator rendezvous), splits the K logical shards over the gang,
+advances a deterministic round-keyed state with one
+``host_allgather_bytes`` exchange per round (the hardened KV path,
+exercised against a real coordination service), and checkpoints through
+``cocoa_tpu.checkpoint`` — so the supervision mechanics (death
+detection, shrink-to-survivors, resume, checkpoint-generation fallback)
+run end to end with real processes WITHOUT cross-process XLA
+collectives, which the pinned jax lacks on CPU (the real-training chaos
+pin is tests/test_chaos.py's slow suite, same guard as the existing
+multi-host gang tests).
+
+The state is a pure function of (K, rounds) — each shard's per-round
+increment is owner-independent and each w[s] receives exactly one
+nonzero addend per round — so a kill/shrink/resume run must reproduce
+the unfailed control's final checkpoint bit for bit, the same invariant
+the real solvers get from round-keyed sampling.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+ALGORITHM = "ToyGang"
+
+
+def parse(argv):
+    opts = {}
+    for a in argv:
+        s = a.lstrip("-")
+        k, _, v = s.partition("=")
+        opts[k] = v if v else "true"
+    return opts
+
+
+def round_increments(t: int, k: int, lo: int, hi: int) -> np.ndarray:
+    """The deterministic per-round update for shards [lo, hi): keyed to
+    (round, shard) only — never to the process layout."""
+    out = np.zeros(k, np.float64)
+    for s in range(lo, hi):
+        out[s] = ((t * 1000003 + s * 7919) % 104729) / 104729.0
+    return out
+
+
+def main(argv=None) -> int:
+    opts = parse(sys.argv[1:] if argv is None else argv)
+    pid = int(opts.get("processId", 0))
+    nproc = int(opts.get("numProcesses", 1))
+    k = int(opts["numSplits"])
+    rounds = int(opts["numRounds"])
+    ckdir = opts.get("chkptDir", "")
+    ck_iter = int(opts.get("chkptIter", 5))
+    step_s = float(opts.get("stepSeconds", 0.05))
+
+    from cocoa_tpu.parallel.distributed import (host_allgather_bytes,
+                                                maybe_initialize)
+
+    maybe_initialize(opts.get("master"), pid, nproc)
+    if k % nproc != 0:
+        # the same loud divisibility rejection the real dataset builders
+        # raise — a supervisor bug (non-divisor relaunch) fails fast here
+        print(f"error: K={k} shards cannot divide over {nproc} workers",
+              file=sys.stderr)
+        return 2
+    m = k // nproc
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+
+    w = np.zeros(k, np.float64)
+    start = 1
+    if "resume" in opts and ckdir:
+        path = ckpt_lib.latest(ckdir, ALGORITHM)
+        if path is not None:
+            meta, w0, _ = ckpt_lib.load(path)
+            w = np.array(w0, np.float64)
+            start = meta["round"] + 1
+            print(f"resuming {ALGORITHM} from round {meta['round']} "
+                  f"({path})", flush=True)
+
+    for t in range(start, rounds + 1):
+        mine = round_increments(t, k, pid * m, (pid + 1) * m)
+        # short KV budget: a dead peer must fail THIS worker quickly so
+        # the supervisor (which already saw the death) isn't racing a
+        # 10-minute hang in the teardown path
+        parts = host_allgather_bytes(f"toy{t}", mine.tobytes(),
+                                     timeout_s=30.0, attempt_s=2.0)
+        for p in parts:
+            w = w + np.frombuffer(p, np.float64)
+        time.sleep(step_s)
+        if ckdir and t % ck_iter == 0:
+            ckpt_lib.save(ckdir, ALGORITHM, t, w, None, seed=0)
+    print(f"{ALGORITHM}: done at round {rounds}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""CI chaos smoke: a real 2-process gang, an injected SIGKILL, shrink to
+the survivor, schema-validated events and gang gauges.
+
+Not a pytest file (no ``test_`` prefix): run it directly —
+
+    PYTHONPATH=.:tests python tests/chaos_smoke.py <artifact-dir>
+
+It supervises the real-process toy gang (tests/_gang_worker.py: real
+jax.distributed rendezvous + per-round KV allgather + real checkpoints)
+with a deterministic kill from tests/_faults.py, requires the supervisor
+to reform the gang at P′=1 and the survivor to finish bit-identically to
+an unfailed 2-process control, then validates the emitted event JSONL
+with the shared schema checker and greps the gang gauges out of the
+metrics textfile.  Exit code 0 = every check held.  The same scenario is
+pinned as tests (tests/test_chaos.py); this script keeps it visible as
+its own CI signal with uploadable artifacts.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from _faults import Fault, FaultPlan, checkpoint_at_least, sigkill
+from cocoa_tpu import checkpoint as ckpt_lib
+from cocoa_tpu import elastic
+from cocoa_tpu.telemetry import events as tele_events
+from cocoa_tpu.telemetry import schema as tele_schema
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    outdir = argv[0] if argv else tempfile.mkdtemp(prefix="chaos-smoke-")
+    os.makedirs(outdir, exist_ok=True)
+    events_path = os.path.join(outdir, "chaos-events.jsonl")
+    metrics_path = os.path.join(outdir, "chaos-metrics.prom")
+    workdir = tempfile.mkdtemp(prefix="chaos-gang-")
+    ck = os.path.join(workdir, "ck")
+    ck_ref = os.path.join(workdir, "ck_ref")
+
+    env_pp = os.environ.get("PYTHONPATH", "")
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (os.path.dirname(os.path.abspath(__file__)),
+                     os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__))), env_pp) if p])
+
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    bus = tele_events.get_bus()
+    bus.configure(jsonl_path=events_path)
+    bus.subscribe(MetricsWriter(metrics_path, families="gang"))
+
+    def toy_argv(ckdir):
+        return [f"--chkptDir={ckdir}", "--numSplits=4", "--numRounds=20",
+                "--chkptIter=5", "--stepSeconds=0.05"]
+
+    plan = FaultPlan(
+        Fault(generation=0, actions=(sigkill(1),),
+              trigger=checkpoint_at_least(ck, "ToyGang", 5),
+              name="kill-worker-1"),
+    )
+    print("chaos-smoke: 2-process gang, SIGKILL worker 1 mid-run, "
+          "shrink to the survivor", flush=True)
+    rc = elastic.supervise(
+        toy_argv(ck), 2, module="_gang_worker", max_restarts=3,
+        poll_s=0.05, num_splits=4, shrink="now", backoff_base_s=0.2,
+        on_generation=plan.on_generation,
+    )
+    plan.join()
+    failures = []
+    if rc != 0:
+        failures.append(f"supervised run exited {rc}")
+    if plan.errors:
+        failures.append(f"fault plan errors: {plan.errors}")
+    if plan.fired != ["kill-worker-1"]:
+        failures.append(f"fault never fired: {plan.fired}")
+
+    path = ckpt_lib.latest(ck, "ToyGang")
+    meta = w = None
+    if path is None:
+        failures.append("no final checkpoint from the survived run")
+    else:
+        meta, w, _ = ckpt_lib.load(path)
+        if meta["round"] != 20:
+            failures.append(f"survivor stopped at round {meta['round']}")
+
+    print("chaos-smoke: unfailed 2-process control", flush=True)
+    rc_ref = elastic.supervise(toy_argv(ck_ref), 2, module="_gang_worker",
+                               max_restarts=0, poll_s=0.05)
+    if rc_ref != 0:
+        failures.append(f"control run exited {rc_ref}")
+    else:
+        _, w_ref, _ = ckpt_lib.load(ckpt_lib.latest(ck_ref, "ToyGang"))
+        if w is not None and not np.array_equal(w, w_ref):
+            failures.append("survived run != unfailed control (the shrink "
+                            "bit-identity contract broke)")
+
+    errs = tele_schema.check_file(events_path)
+    if errs:
+        failures.append(f"events schema violations: {errs[:5]}")
+    recs = [json.loads(ln) for ln in open(events_path)]
+    if not any(r["event"] == "gang_resize" and r["new_size"] == 1
+               for r in recs):
+        failures.append("no gang_resize event to P'=1 in the stream")
+    metrics_text = open(metrics_path).read()
+    for needle in ("cocoa_gang_size 1", "cocoa_gang_generations_total"):
+        if needle not in metrics_text:
+            failures.append(f"metrics textfile lacks {needle!r}")
+
+    if failures:
+        for f in failures:
+            print(f"chaos-smoke FAIL: {f}", file=sys.stderr)
+        return 1
+    print("chaos-smoke: OK — kill survived, gang shrunk 2->1, final "
+          "state bit-identical to the control, events schema-valid, "
+          "gang gauges present", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

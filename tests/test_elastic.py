@@ -35,7 +35,8 @@ def test_supervise_worker_argv_and_resume_flag(monkeypatch):
     for resume in (True, False):
         spawned.clear()
         elastic.supervise(["--lambda=.01"], 2, python="py", module="m",
-                          resume=resume, poll_s=0.05, max_restarts=0)
+                          resume=resume, poll_s=0.05, max_restarts=0,
+                          backoff_base_s=0.0)
         assert len(spawned) == 2
         for i, argv in enumerate(spawned):
             assert argv[:2] == ["py", "-m"] and argv[2] == "m"
@@ -50,6 +51,7 @@ def test_supervise_gives_up_after_consecutive_failures():
     rc = elastic.supervise(
         ["-c", "import sys; sys.exit(3)"], 1, python=sys.executable,
         module="timeit", max_restarts=1, poll_s=0.05, resume=False,
+        backoff_base_s=0.0,
     )
     assert rc != 0
 
@@ -85,7 +87,8 @@ def test_supervise_progress_resets_budget(monkeypatch):
 
     try:
         elastic.supervise([], 1, max_restarts=1, poll_s=0.0,
-                          resume=False, progress_token=token)
+                          resume=False, progress_token=token,
+                          backoff_base_s=0.0)
     except KeyboardInterrupt:
         pass
     assert stop["gen"] > 3  # survived past max_restarts because of progress
@@ -93,7 +96,8 @@ def test_supervise_progress_resets_budget(monkeypatch):
     # constant token: gives up after max_restarts+1 generations
     calls["n"] = 0
     rc = elastic.supervise([], 1, max_restarts=2, poll_s=0.0,
-                           resume=False, progress_token=lambda: 42)
+                           resume=False, progress_token=lambda: 42,
+                           backoff_base_s=0.0)
     assert rc == 3
     assert calls["n"] == 3  # initial + 2 restarts
 
@@ -130,6 +134,7 @@ def test_supervise_stall_watchdog_restarts_wedged_gang(monkeypatch):
     rc = elastic.supervise(
         [], 2, max_restarts=1, poll_s=0.0, resume=False,
         progress_token=lambda: 42, stall_timeout_s=0.05,
+        backoff_base_s=0.0,
     )
     assert rc == 1              # no exit code to report -> generic failure
     assert spawned["n"] == 4    # 2 workers x (initial + 1 restart)
@@ -164,6 +169,7 @@ def test_supervise_stall_watchdog_progress_keeps_gang_alive(monkeypatch):
     rc = elastic.supervise(
         [], 1, max_restarts=0, poll_s=0.0, resume=False,
         progress_token=token, stall_timeout_s=0.05,
+        backoff_base_s=0.0,
     )
     assert rc == 0
     assert spawned["n"] == 1  # one generation, zero restarts

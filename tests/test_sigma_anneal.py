@@ -150,11 +150,16 @@ def test_anneal_no_backoff_is_bitexact_vs_fixed_sigma():
     assert all(r.sigma == 2.0 for r in t_a.records)
 
 
-def test_anneal_checkpoint_resume_mid_schedule_bit_identical(tmp_path):
+def test_anneal_checkpoint_resume_mid_schedule_bit_identical(tmp_path,
+                                                             monkeypatch):
     """Resume from a checkpoint taken MID-WINDOW at stage 0 (stall counters
     accumulated, no backoff yet): the restored schedule state must
     reproduce the uninterrupted run bit-for-bit — the backoff fires at the
     same round and the final state is identical."""
+    # this test resumes from a SPECIFIC mid-run generation (r400, chosen
+    # for its mid-window stage-0 sched state); keep every generation so
+    # the default keep-2 pruning cannot rotate it away
+    monkeypatch.setattr(ckpt_lib, "KEEP_GENERATIONS", 1000)
     w0, a0, t0 = _anneal_run(device_loop=True, tmp=tmp_path, chkpt_iter=100)
     assert t0.stopped == "target"
     path = os.path.join(str(tmp_path), "CoCoA+-r000400.npz")

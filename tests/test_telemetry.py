@@ -245,8 +245,15 @@ def test_checkpoint_write_events(tmp_path):
     assert writes, "chkptIter=100 must have produced checkpoint events"
     for e in writes:
         assert e["algorithm"] == "CoCoA+"
-        assert os.path.exists(e["path"])
         assert f"r{e['round']:06d}" in e["path"]
+    # only the newest KEEP_GENERATIONS survive on disk (generation
+    # pruning); every event still names the path it wrote at the time
+    from cocoa_tpu import checkpoint as _ck
+
+    for e in writes[-_ck.KEEP_GENERATIONS:]:
+        assert os.path.exists(e["path"])
+    for e in writes[:-_ck.KEEP_GENERATIONS]:
+        assert not os.path.exists(e["path"])
 
 
 def test_sigma_trial_restart_event(tmp_path, monkeypatch):
