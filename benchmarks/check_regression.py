@@ -1,0 +1,251 @@
+"""CI bench-regression gate: fresh CPU runs vs the committed baselines.
+
+The benchmark lineage (BENCH_r01..r05.json at the repo root, distilled
+into benchmarks/results.jsonl) records, per config, the comm-ROUND count
+to the certified duality-gap target.  Rounds are the one benchmark axis
+that is backend-independent (the math is bit-exact per platform and
+platform-stable to within a few evals), so CI can guard it on plain CPU
+runners without the TPU the wallclock columns need:
+
+    python benchmarks/check_regression.py --report=report.jsonl
+
+re-runs each gated config through the real CLI (fresh process, CPU),
+reads the trajectory artifact, and FAILS (exit 1) when
+
+- the run no longer certifies its gap target at all (``stopped`` is not
+  ``"target"``), or
+- the fresh round count exceeds the committed baseline round count by
+  more than the config's explicit tolerance (a convergence regression —
+  the kind a bad σ′ default, sampling change, or accel bug causes).
+
+``--fresh=PATH`` skips the runs and checks an existing results.jsonl
+(rows matched by ``config``) against the same committed bounds — the
+mode for wiring an already-produced benchmark artifact into the gate.
+
+The report is one JSONL row per gated config in the benchmarks-results
+dialect, schema-validated (telemetry/schema.py) before the gate exits —
+a malformed report is itself a failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(ROOT, "benchmarks", "results.jsonl")
+
+# run as `python benchmarks/check_regression.py`: sys.path[0] is
+# benchmarks/, so the package needs the repo root added for the schema
+# validation import
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+# The gated configs.  ``flags`` reproduce the committed results.jsonl
+# row's run through the CLI (benchmarks/run.py bench_demo is the
+# producer: dense layout, H=50, λ=1e-3, 1e-4 gap target — the BENCH_r*
+# lineage headline config).  ``rounds_tol`` is the explicit relative
+# slack on the committed round count: float32 reduction order differs
+# across CPU microarchitectures by a few evals, never by 15%.
+GATES = (
+    {
+        "config": "demo-cocoa+",
+        "algorithm": "CoCoA+",
+        "gap_target": 1e-4,
+        "rounds_tol": 0.15,
+        "flags": [
+            "--trainFile=data/small_train.dat", "--numFeatures=9947",
+            "--numSplits=4", "--numRounds=600", "--debugIter=10",
+            "--localIterFrac=0.1", "--lambda=0.001", "--layout=dense",
+            "--math=fast", "--deviceLoop", "--gapTarget=1e-4",
+            "--justCoCoA=true", "--quiet",
+        ],
+    },
+    {
+        "config": "demo-cocoa+(permuted)",
+        "algorithm": "CoCoA+",
+        "gap_target": 1e-4,
+        "rounds_tol": 0.15,
+        "flags": [
+            "--trainFile=data/small_train.dat", "--numFeatures=9947",
+            "--numSplits=4", "--numRounds=600", "--debugIter=10",
+            "--localIterFrac=0.1", "--lambda=0.001", "--layout=dense",
+            "--math=fast", "--deviceLoop", "--gapTarget=1e-4",
+            "--rng=permuted", "--justCoCoA=true", "--quiet",
+        ],
+    },
+)
+
+
+def committed_baselines(path: str = RESULTS) -> dict:
+    """config -> committed row from benchmarks/results.jsonl."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            # perf-accounting rows share the config name but carry no
+            # round count — only rows with BOTH fields can anchor the
+            # gate, regardless of row order in the file
+            if isinstance(row, dict) and "config" in row \
+                    and "rounds" in row:
+                # first qualifying row per config wins (the file appends
+                # refreshed rows last in regen; the gate keys on the
+                # curated head)
+                out.setdefault(row["config"], row)
+    return out
+
+
+def run_fresh(gate: dict, workdir: str) -> dict:
+    """One fresh CPU run of the gate's config through the real CLI (own
+    process: clean jit caches, clean telemetry); returns the fresh row.
+    Never raises: a hung/torn run becomes a per-config ``error`` row so
+    the gate still evaluates the remaining configs and writes its
+    report."""
+    traj_base = os.path.join(workdir, gate["config"].replace("/", "_"))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "cocoa_tpu.cli", *gate["flags"],
+             f"--trajOut={traj_base}"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=900)
+        if proc.returncode != 0:
+            return {"config": gate["config"], "error":
+                    f"CLI exited {proc.returncode}: {proc.stderr[-500:]}"}
+        traj_path = (f"{traj_base}."
+                     f"{gate['algorithm'].replace(' ', '_')}.jsonl")
+        with open(traj_path) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        # line 0 is the manifest header; a run killed before its first
+        # eval leaves no record lines at all
+        records = [ln for ln in lines if "round" in ln]
+        if not records:
+            return {"config": gate["config"], "error":
+                    f"trajectory {traj_path} carries no round records"}
+        last = records[-1]
+        return {
+            "config": gate["config"],
+            "rounds": int(last["round"]),
+            "gap": float(last["gap"]),
+            "stopped": last.get("stopped"),
+            "gap_target": gate["gap_target"],
+            "type": "bench-regression-fresh",
+        }
+    except (subprocess.TimeoutExpired, OSError, ValueError, KeyError,
+            TypeError) as e:
+        return {"config": gate["config"], "error":
+                f"{type(e).__name__}: {e}"}
+
+
+def evaluate(gate: dict, fresh: dict, committed: dict) -> list:
+    """Failure strings for one gate (empty = pass)."""
+    cfg = gate["config"]
+    if "error" in fresh:
+        return [f"{cfg}: fresh run failed — {fresh['error']}"]
+    failures = []
+    if fresh.get("stopped") != "target":
+        failures.append(
+            f"{cfg}: fresh run no longer certifies the "
+            f"{gate['gap_target']:g} gap target within its round budget "
+            f"(stopped={fresh.get('stopped')!r}, gap={fresh.get('gap')})")
+    base = committed.get(cfg)
+    if base is None:
+        failures.append(f"{cfg}: no committed baseline row in "
+                        f"benchmarks/results.jsonl — the gate has nothing "
+                        f"to compare against")
+        return failures
+    bound = int(base["rounds"] * (1.0 + gate["rounds_tol"]))
+    if fresh.get("rounds", 0) > bound:
+        failures.append(
+            f"{cfg}: ROUND REGRESSION — fresh {fresh['rounds']} rounds vs "
+            f"committed {base['rounds']} (+{gate['rounds_tol'] * 100:.0f}% "
+            f"tolerance = {bound}); a convergence change must update the "
+            f"baseline deliberately (benchmarks/regen.py), not ride in")
+    return failures
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    report_path = None
+    fresh_path = None
+    only = None
+    for a in argv:
+        if a.startswith("--report="):
+            report_path = a.split("=", 1)[1]
+        elif a.startswith("--fresh="):
+            fresh_path = a.split("=", 1)[1]
+        elif a.startswith("--only="):
+            only = a.split("=", 1)[1]
+        else:
+            print(f"usage: python benchmarks/check_regression.py "
+                  f"[--report=PATH] [--fresh=results.jsonl] "
+                  f"[--only=CONFIG]  (got {a!r})", file=sys.stderr)
+            return 2
+    committed = committed_baselines()
+    gates = [g for g in GATES if only is None or g["config"] == only]
+    if not gates:
+        print(f"no gated config named {only!r}", file=sys.stderr)
+        return 2
+
+    rows = []
+    failures = []
+    if fresh_path:
+        fresh_rows = committed_baselines(fresh_path)  # same config keying
+        for gate in gates:
+            row = fresh_rows.get(gate["config"])
+            if row is None:
+                failures.append(f"{gate['config']}: no row in "
+                                f"{fresh_path}")
+                continue
+            fresh = {"config": gate["config"],
+                     "rounds": int(row["rounds"]),
+                     "gap": (float(row["gap"])
+                             if row.get("gap") is not None else None),
+                     # results.jsonl rows certify by construction; honor
+                     # an explicit stopped column when present
+                     "stopped": row.get("stopped", "target")}
+            rows.append({**fresh, "type": "bench-regression-fresh"})
+            failures += evaluate(gate, fresh, committed)
+    else:
+        workdir = tempfile.mkdtemp(prefix="bench-regress-")
+        for gate in gates:
+            print(f"check_regression: running {gate['config']} "
+                  f"(committed baseline "
+                  f"{committed.get(gate['config'], {}).get('rounds')} "
+                  f"rounds)", flush=True)
+            fresh = run_fresh(gate, workdir)
+            rows.append(fresh)
+            failures += evaluate(gate, fresh, committed)
+
+    if report_path:
+        with open(report_path, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        from cocoa_tpu.telemetry import schema as tele_schema
+
+        errs = tele_schema.check_file(report_path, kind="results")
+        if errs:
+            failures.append(f"report schema violations: {errs[:5]}")
+
+    for row in rows:
+        if "error" not in row:
+            print(f"check_regression: {row['config']}: "
+                  f"{row.get('rounds')} rounds, gap {row.get('gap')}, "
+                  f"stopped={row.get('stopped')}", flush=True)
+    if failures:
+        for msg in failures:
+            print(f"check_regression FAIL: {msg}", file=sys.stderr)
+        return 1
+    print(f"check_regression: OK — {len(rows)} config(s) within "
+          f"tolerance of the committed baselines")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

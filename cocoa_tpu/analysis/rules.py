@@ -33,6 +33,13 @@ Rules (ids are what ``jaxlint: allow=<rule>`` and the baseline key on):
   must live in a module that declares a VMEM budget constant and a
   ``*_fits`` gate, and every gate must actually be consulted outside its
   own module (a gate nobody calls protects nothing).
+- ``span-hygiene`` — the tracing contract (telemetry/tracing.py): a span
+  enter/exit (``span(...)`` context manager or ``@traced`` decorator)
+  must never appear inside jit/lax bodies — there it times the TRACE,
+  not the execution, and fires once per compile — and span attributes
+  must never read traced values (emitting one materializes the array on
+  the host: a silent device sync).  Rides the host-sync rule's
+  traced-context machinery.
 """
 
 from __future__ import annotations
@@ -714,9 +721,131 @@ def check_pallas_budget_ast(src: SourceFile, index: ModuleIndex,
     return findings
 
 
+# --- rule: span-hygiene -----------------------------------------------------
+
+# the tracing surface (telemetry/tracing.py): the context-manager form
+# and the decorator form, module-level or on a Tracer instance
+_SPAN_CALLEES = {"span", "traced"}
+
+# receiver names that identify the tracing module/object — required for
+# the attribute form so ``re.Match.span()`` and other unrelated ``span``
+# methods in traced host code are never flagged
+_TRACING_RECEIVERS = ("tracing", "tracer")
+
+
+def _is_span_call(node: ast.Call) -> Optional[str]:
+    """'span'/'traced' when ``node`` is a TRACING call, else None.
+    Matches ``tracing.span(...)`` / ``_tracing.span(...)`` /
+    ``get_tracer().span(...)`` (receiver names the tracing surface), a
+    bare imported ``span("phase", ...)``/``traced("phase")`` (string
+    phase argument — what distinguishes it from e.g. ``m.span()``)."""
+    tail = _callee_tail(node)
+    if tail not in _SPAN_CALLEES:
+        return None
+    phase_is_str = bool(node.args) and isinstance(
+        node.args[0], ast.Constant) and isinstance(node.args[0].value, str)
+    if isinstance(node.func, ast.Name):
+        return tail if phase_is_str else None
+    if isinstance(node.func, ast.Attribute):
+        recv = node.func.value
+        chain = (_attr_chain(recv) or "").lower()
+        if any(r in chain for r in _TRACING_RECEIVERS):
+            return tail
+        # get_tracer().span(...) — receiver is a call to get_tracer
+        if isinstance(recv, ast.Call) and \
+                _callee_tail(recv) == "get_tracer":
+            return tail
+        return tail if phase_is_str else None
+    return None
+
+
+def check_span_hygiene(src: SourceFile, index: ModuleIndex) -> list:
+    """Span enter/exit must stay on the host (telemetry/tracing.py
+    contract): inside jit/lax bodies a span is a trace-time no-op at
+    best (it would time the TRACE, not the execution, and emit once per
+    compile instead of once per run) and a host sync at worst (a traced
+    value in the span attrs materializes on the host at emit).  Reuses
+    the host-sync machinery's traced-context resolution: jit targets,
+    control-flow/combinator callees, everything lexically nested —
+    minus host-callback targets (an io_callback target may span freely;
+    it runs on the host by construction)."""
+    findings = []
+    traced = index.traced_defs()
+    parents = _build_parents(src.tree)
+
+    def flag(node, msg):
+        findings.append(Finding(
+            rule="span-hygiene", severity="error", path=src.path,
+            line=node.lineno, col=node.col_offset, message=msg))
+
+    for d in index.defs:
+        if id(d) not in traced:
+            continue
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if _nearest_def(node, parents) is not d:
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                form = _is_span_call(node)
+                if form is None:
+                    continue
+                flag(node,
+                     f"tracing `{form}(...)` inside traced code — a span "
+                     f"enter/exit in a jit/lax body times the trace, not "
+                     f"the execution, and fires once per COMPILE; hoist "
+                     f"it to the host boundary (the dispatch/fetch site, "
+                     f"solvers/base.py pattern)")
+                continue
+    # the decorator form on a function that is itself traced: the span
+    # would wrap the traced body — same failure, different spelling
+    for d in index.defs:
+        if id(d) not in traced or not isinstance(
+                d, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in d.decorator_list:
+            form = (_is_span_call(dec) if isinstance(dec, ast.Call)
+                    else None)
+            if form == "traced":
+                findings.append(Finding(
+                    rule="span-hygiene", severity="error", path=src.path,
+                    line=dec.lineno, col=dec.col_offset,
+                    message=(f"@traced decorator on `{d.name}`, which is "
+                             f"jitted/traced — the span would wrap the "
+                             f"trace, not the execution; decorate the "
+                             f"host-side caller instead")))
+    # span attrs that read traced values from an ENCLOSING traced scope:
+    # a host-side closure built inside a kernel builder may legally span,
+    # but passing a traced array as an attribute materializes it on the
+    # host at emit time (a silent device sync on the hot path)
+    for d in index.defs:
+        if id(d) in traced:
+            continue  # already flagged wholesale above
+        p = index.parent_def.get(d)
+        if p is None or id(p) not in traced:
+            continue
+        pnames = index.traced_params(p, traced)
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call) or \
+                        _is_span_call(node) is None:
+                    continue
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if any(_mentions(a, pnames) for a in args):
+                    flag(node,
+                         "span attribute reads a traced value — emitting "
+                         "it materializes the array on the host (silent "
+                         "device sync); tag scalars the host already "
+                         "holds, or fetch after the dispatch")
+    return findings
+
+
 # --- registry ---------------------------------------------------------------
 
-RULES = ("donation", "host-sync", "f64", "mesh-api", "pallas-budget")
+RULES = ("donation", "host-sync", "f64", "mesh-api", "pallas-budget",
+         "span-hygiene")
 
 
 def run_static_rules(sources: dict) -> list:
@@ -729,4 +858,5 @@ def run_static_rules(sources: dict) -> list:
         findings += check_f64(src, index)
         findings += check_mesh_api(src, index)
         findings += check_pallas_budget_ast(src, index, sources)
+        findings += check_span_hygiene(src, index)
     return findings

@@ -72,6 +72,16 @@ def save(
     for) can never leave a discoverable-but-corrupt checkpoint: either
     the rename happened and both files are complete, or the checkpoint
     does not exist."""
+    from cocoa_tpu.telemetry import tracing as _tracing
+
+    with _tracing.span("checkpoint_save", algorithm=algorithm,
+                       round=int(round_t)):
+        return _save(directory, algorithm, round_t, w, alpha=alpha,
+                     seed=seed, sched=sched, hist=hist)
+
+
+def _save(directory, algorithm, round_t, w, alpha=None, seed=0,
+          sched=None, hist=None) -> str:
     os.makedirs(directory, exist_ok=True)
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
@@ -185,6 +195,13 @@ def validate(path: str) -> Optional[str]:
     (zip CRC — catches torn/overwritten bytes), the meta parses, and each
     array shape matches the shape the meta recorded at write time
     (pre-``shapes`` checkpoints skip that last comparison)."""
+    from cocoa_tpu.telemetry import tracing as _tracing
+
+    with _tracing.span("checkpoint_validate", path=path):
+        return _validate(path)
+
+
+def _validate(path: str) -> Optional[str]:
     try:
         data = np.load(path)
     except Exception as e:

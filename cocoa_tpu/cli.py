@@ -97,6 +97,18 @@ reject ``--ingest=stream`` loudly.
 ``--lambda`` the L1 weight, ``--l2`` the optional elastic-net weight;
 A's columns are sharded over the workers and the printed certificate is
 the lasso duality gap.
+
+Observability (round 15, docs/DESIGN.md §14): ``--trace`` arms
+gang-wide span tracing (per-phase, per-worker timing through the
+``--events`` stream; assemble with
+``python -m cocoa_tpu.telemetry.trace_report``),
+``--flightRecorder=auto|on|off`` the crash flight recorder (last-N
+events dumped to ``<events>.flightrec`` on divergence / unhandled
+exception / SIGTERM, and by the ``--elastic`` supervisor when a worker
+dies), ``--eventsMaxMB=N`` size-caps the event JSONL with an atomic
+``.1`` rollover, and ``--metricsInterval=S`` debounces the metrics
+textfile rewrites.  Multi-process runs stream events per process
+(worker 0 owns ``<events>``, worker p ``<events>.p<p>``).
 """
 
 from __future__ import annotations
@@ -121,7 +133,9 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "blockPipeline", "divergenceGuard",
                 "sigmaSchedule", "warmStart", "accel", "theta",
                 "elastic", "stallTimeout", "evalDense", "hotCols",
-                "ingest", "metrics", "events", "quiet")  # run-level
+                "ingest", "metrics", "events", "quiet",
+                "trace", "flightRecorder", "eventsMaxMB",
+                "metricsInterval")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -211,6 +225,62 @@ def main(argv=None) -> int:
     # a quiet run still leaves the full machine-readable trace.
     quiet = (extras["quiet"] is not None
              and str(extras["quiet"]).lower() != "false")
+
+    # --trace: gang-wide span tracing (telemetry/tracing.py) — per-phase,
+    # per-worker timing through the event stream; --flightRecorder: the
+    # bounded last-N-events ring dumped to `<events>.flightrec` on
+    # divergence / unhandled exception / SIGTERM (and by the --elastic
+    # supervisor on a worker death); --eventsMaxMB: size-capped JSONL
+    # with atomic `.1` rollover; --metricsInterval: the metrics-textfile
+    # write debounce.  Validated up front so a typo fails before the run.
+    trace_on = (extras["trace"] is not None
+                and str(extras["trace"]).lower() != "false")
+    if trace_on and not (extras["events"] or extras["metrics"]):
+        print("error: --trace records spans through the telemetry sinks "
+              "and needs --events (for trace_report/Perfetto) or "
+              "--metrics (for the phase-seconds gauges)", file=sys.stderr)
+        return 2
+    flightrec_mode = (extras["flightRecorder"] or "auto").lower()
+    if flightrec_mode == "true":
+        flightrec_mode = "on"   # bare --flightRecorder
+    if flightrec_mode not in ("auto", "on", "off"):
+        print(f"error: --flightRecorder must be auto|on|off, got "
+              f"{extras['flightRecorder']!r}", file=sys.stderr)
+        return 2
+    if flightrec_mode == "on" and not extras["events"]:
+        print("error: --flightRecorder=on needs --events (the dump lands "
+              "at <events>.flightrec, and the supervisor-side dump tails "
+              "the per-process event streams)", file=sys.stderr)
+        return 2
+    events_max_bytes = None
+    if extras["eventsMaxMB"]:
+        try:
+            events_max_bytes = int(extras["eventsMaxMB"]) << 20
+        except ValueError:
+            events_max_bytes = 0
+        if events_max_bytes <= 0:
+            print(f"error: --eventsMaxMB takes a positive integer of "
+                  f"mebibytes, got {extras['eventsMaxMB']!r}",
+                  file=sys.stderr)
+            return 2
+        if not extras["events"]:
+            print("error: --eventsMaxMB caps the --events JSONL and "
+                  "needs --events", file=sys.stderr)
+            return 2
+    metrics_interval = 0.0
+    if extras["metricsInterval"]:
+        try:
+            metrics_interval = float(extras["metricsInterval"])
+        except ValueError:
+            metrics_interval = -1.0
+        if metrics_interval < 0:
+            print(f"error: --metricsInterval takes seconds >= 0, got "
+                  f"{extras['metricsInterval']!r}", file=sys.stderr)
+            return 2
+        if not extras["metrics"]:
+            print("error: --metricsInterval debounces the --metrics "
+                  "textfile and needs --metrics", file=sys.stderr)
+            return 2
 
     # --profile=DIR traces the whole run; --profile=DIR,START,STOP traces
     # the round window [START, STOP) by riding the telemetry event stream
@@ -445,10 +515,11 @@ def main(argv=None) -> int:
             # the restart budget bounds CONSECUTIVE failures: any new or
             # renamed checkpoint file since the last generation means the
             # run advanced, so the streak resets.  The worker's --metrics
-            # textfile (refreshed on every telemetry event) is a FINER
-            # progress signal than checkpoint files — it advances on every
-            # eval, so the stall watchdog can catch a wedge well inside a
-            # long chkptIter interval.
+            # textfile (refreshed per event, or per --metricsInterval
+            # window under the debounce — see the warning above) is a
+            # FINER progress signal than checkpoint files — it advances
+            # on every eval, so the stall watchdog can catch a wedge
+            # well inside a long chkptIter interval.
             ckpts = None
             if cfg.chkpt_dir and os.path.isdir(cfg.chkpt_dir):
                 ckpts = tuple(sorted(
@@ -498,6 +569,18 @@ def main(argv=None) -> int:
                       f"healthy gangs may be killed as stalled — consider "
                       f">= 120s (and a --chkptIter the gang can reach "
                       f"within the timeout)", file=sys.stderr)
+            if (extras["metrics"] and metrics_interval > 0
+                    and metrics_interval * 2 > stall):
+                # the watchdog's finest progress signal is worker 0's
+                # metrics textfile, and the debounce delays its rewrites
+                # by up to one interval — an interval near (or past) the
+                # stall timeout blinds the watchdog to live progress and
+                # SIGKILLs healthy gangs
+                print(f"warning: --metricsInterval={metrics_interval:g}s "
+                      f"debounces the metrics progress signal the "
+                      f"--stallTimeout={stall:g}s watchdog reads; keep "
+                      f"the interval well under half the timeout (or "
+                      f"rely on --chkptDir progress)", file=sys.stderr)
 
         if extras["events"] or extras["metrics"]:
             # the supervisor's gang-restart/resize events land in the SAME
@@ -514,12 +597,25 @@ def main(argv=None) -> int:
             from cocoa_tpu import telemetry
 
             bus_sup = telemetry.get_bus()
+            # no max_bytes here: the supervisor shares worker 0's file,
+            # and a file must have exactly ONE rotating owner (two
+            # emitters racing os.replace would clobber the fresh `.1`
+            # archive) — worker 0 rotates; the supervisor's handful of
+            # restart/resize events ride whichever file is current
             bus_sup.configure(jsonl_path=extras["events"])
             if extras["metrics"]:
                 from cocoa_tpu.telemetry.metrics import MetricsWriter
 
                 bus_sup.subscribe(MetricsWriter(
-                    extras["metrics"] + ".gang", families="gang"))
+                    extras["metrics"] + ".gang", families="gang",
+                    flush_interval_s=metrics_interval))
+            if trace_on:
+                # supervisor spans (gang generations, restart backoffs)
+                # join the same stream; no worker tag — trace_report
+                # attributes them by pid
+                from cocoa_tpu.telemetry import tracing
+
+                tracing.configure(enabled=True)
         return elastic.supervise(
             elastic.strip_elastic_flags(argv), n_workers,
             resume=bool(cfg.chkpt_dir), progress_token=progress_token,
@@ -568,12 +664,35 @@ def main(argv=None) -> int:
     # data layout is resolved (below) so the manifest can record the
     # hot/cold split provenance; cfg/extras are not mutated in between.
     from cocoa_tpu import telemetry
+    from cocoa_tpu.telemetry import recorder as flightrec_lib
+    from cocoa_tpu.telemetry import tracing
 
     bus = telemetry.get_bus()
     is_primary = (proc_id or 0) == 0
-    if is_primary and (extras["metrics"] or extras["events"]):
-        bus.configure(jsonl_path=extras["events"],
-                      metrics_path=extras["metrics"])
+    # per-process event streams: worker 0 owns `<events>` (shared with the
+    # elastic supervisor's appends, as before); worker p > 0 streams to
+    # `<events>.p<p>` — so every worker's spans and events survive its own
+    # death for the supervisor's flight-recorder dump, and
+    # telemetry/trace_report.py can merge the gang's streams into one
+    # timeline.  The metrics textfile stays worker-0-only (the
+    # supervisor's `.gang` sibling carries the gang families).
+    events_path = None
+    if extras["events"]:
+        events_path = flightrec_lib.worker_stream_path(
+            extras["events"], proc_id or 0)
+    if events_path or (is_primary and extras["metrics"]):
+        bus.configure(
+            jsonl_path=events_path,
+            metrics_path=extras["metrics"] if is_primary else None,
+            max_bytes=events_max_bytes,
+            metrics_interval_s=metrics_interval)
+    if trace_on:
+        tracing.configure(enabled=True, worker=proc_id or 0)
+    if events_path and flightrec_mode != "off":
+        # the in-process half of the flight recorder: ring of the last N
+        # events, dumped on divergence / unhandled exception / SIGTERM
+        # (telemetry/recorder.py; the supervisor covers SIGKILL)
+        flightrec_lib.install(bus, events_path)
     cfg_manifest = {**dataclasses.asdict(cfg),
                     **{k: v for k, v in extras.items() if v is not None}}
     run_meta = {"dataset": cfg.train_file, "seed": cfg.seed,

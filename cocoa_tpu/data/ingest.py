@@ -70,6 +70,7 @@ from cocoa_tpu.data.libsvm import load_libsvm_range
 from cocoa_tpu.data.sharding import ShardedDataset
 from cocoa_tpu.parallel import distributed
 from cocoa_tpu.parallel import mesh as mesh_lib
+from cocoa_tpu.telemetry import tracing as _tracing
 
 # pass-1 window: bounds the transient CSR a scan holds (rows are parsed
 # and dropped per window; only offsets/nnz/histogram survive)
@@ -147,6 +148,12 @@ def build_index(path: str, num_features: int, *,
     whole-file row order; histogram summed as int64, bit-identical to the
     whole-file ``np.bincount``).
     """
+    with _tracing.span("ingest_pass1", path=path):
+        return _build_index(path, num_features, window=window)
+
+
+def _build_index(path: str, num_features: int, *,
+                 window: int = PASS1_WINDOW) -> IngestIndex:
     size = os.path.getsize(path)
     nproc = jax.process_count()
     me = jax.process_index()
@@ -207,6 +214,32 @@ class StreamBuildInfo:
 
 
 def stream_shard_dataset(
+    path: str,
+    num_features: int,
+    k: int,
+    *,
+    layout: str = "auto",
+    dtype=jnp.float32,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    max_nnz: Optional[int] = None,
+    eval_dense: bool = False,
+    hot_cols: int = 0,
+    index: Optional[IngestIndex] = None,
+):
+    """Streamed twin of :func:`cocoa_tpu.data.sharding.shard_dataset`
+    (see :func:`_stream_build` for the mechanics; this wrapper only
+    resolves the pass-1 index first so the ``ingest_pass2`` span times
+    exactly the shard parse + slab build)."""
+    if index is None:
+        index = build_index(path, num_features)
+    with _tracing.span("ingest_pass2", path=path):
+        return _stream_build(
+            path, num_features, k, layout=layout, dtype=dtype, mesh=mesh,
+            max_nnz=max_nnz, eval_dense=eval_dense, hot_cols=hot_cols,
+            index=index)
+
+
+def _stream_build(
     path: str,
     num_features: int,
     k: int,

@@ -204,53 +204,67 @@ def supervise(
                    # reset it: the run is still failing, keep backing off)
     gen = 0
     last_token = progress_token() if progress_token else None
+    from cocoa_tpu.telemetry import tracing as _tracing
+
     while True:
         port = free_port()
-        procs = [
-            _spawn(argv_cur, i, n_cur, port, python, module,
-                   quiet_tail, resume)
-            for i in range(n_cur)
-        ]
-        if on_generation is not None:
-            on_generation(gen, procs)
-        gen += 1
-        failed = None
-        stalled = False
-        last_change = time.monotonic()
-        try:
-            while True:
-                codes = [p.poll() for p in procs]
-                bad = [c for c in codes if c not in (None, 0)]
-                if bad:
-                    failed = bad[0]
-                    break
-                if all(c == 0 for c in codes):
-                    return 0
-                if stall_timeout_s is not None:
-                    token = progress_token()
-                    if token != last_token:
-                        last_token = token
-                        last_change = time.monotonic()
-                        restarts = 0   # live progress breaks the streak
-                        streak = 0
-                    elif time.monotonic() - last_change > stall_timeout_s:
-                        stalled = True
+        # span numbering matches the restart/gang_resize EVENTS and the
+        # flightrec manifest ("gangs spawned so far", 1-based: this gang
+        # is gen+1 until the post-spawn increment below) — only the
+        # on_generation test hook keeps its historical 0-based index
+        with _tracing.span("gang_generation", generation=gen + 1,
+                           gang_size=n_cur):
+            procs = [
+                _spawn(argv_cur, i, n_cur, port, python, module,
+                       quiet_tail, resume)
+                for i in range(n_cur)
+            ]
+            if on_generation is not None:
+                on_generation(gen, procs)
+            gen += 1
+            failed = None
+            failed_idx = None
+            stalled = False
+            last_change = time.monotonic()
+            try:
+                while True:
+                    codes = [p.poll() for p in procs]
+                    for idx, c in enumerate(codes):
+                        if c not in (None, 0):
+                            failed = c
+                            failed_idx = idx
+                            break
+                    if failed is not None:
                         break
-                time.sleep(poll_s)
-        finally:
-            # any survivors are wedged inside a collective whose peer died
-            # (or we are unwinding on KeyboardInterrupt) — kill the gang
-            for p in procs:
-                if p.poll() is None:
+                    if all(c == 0 for c in codes):
+                        return 0
+                    if stall_timeout_s is not None:
+                        token = progress_token()
+                        if token != last_token:
+                            last_token = token
+                            last_change = time.monotonic()
+                            restarts = 0  # live progress breaks the streak
+                            streak = 0
+                        elif (time.monotonic() - last_change
+                                > stall_timeout_s):
+                            stalled = True
+                            break
+                    time.sleep(poll_s)
+            finally:
+                # any survivors are wedged inside a collective whose peer
+                # died (or we are unwinding on KeyboardInterrupt) — kill
+                # the gang
+                for p in procs:
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(signal.SIGKILL)
+                        except OSError:
+                            pass
+                for p in procs:
                     try:
-                        p.send_signal(signal.SIGKILL)
-                    except OSError:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
                         pass
-            for p in procs:
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    pass
         if progress_token is not None:
             token = progress_token()
             if token != last_token:
@@ -268,6 +282,28 @@ def supervise(
         # by the CLI's --events; inert otherwise) appends to the same
         # JSONL the workers write — whole-line appends interleave safely
         from cocoa_tpu.telemetry import events as _tele
+
+        # flight-recorder dump on the victim's behalf: a SIGKILLed worker
+        # cannot dump its own ring, but its events were streaming to its
+        # per-process JSONL — tail it and leave the `.flightrec`
+        # explanation artifact next to it (telemetry/recorder.py).  A
+        # stall has no single victim; dump worker 0's tail as the gang's
+        # last-known state instead.
+        if _tele.get_bus().jsonl_path:
+            from cocoa_tpu.telemetry import recorder as _recorder
+
+            # victim_pid scopes the tail to the dead process's own
+            # records (worker 0 shares its file with the supervisor, and
+            # every stream accumulates prior generations); a stall has
+            # no single victim — dump worker 0's stream unscoped as the
+            # gang's last-known state
+            victim_pid = (getattr(procs[failed_idx], "pid", None)
+                          if failed_idx is not None else None)
+            _recorder.dump_victim(
+                _tele.get_bus().jsonl_path,
+                failed_idx if failed_idx is not None else 0,
+                reason, exit_code=failed, generation=gen,
+                victim_pid=victim_pid)
 
         old_n = n_cur
         can_shrink = (num_splits is not None and shrink != "off"
@@ -334,7 +370,9 @@ def supervise(
                   + (f" after {backoff:.1f}s backoff" if backoff else ""),
                   file=sys.stderr, flush=True)
         if backoff > 0:
-            time.sleep(backoff)
+            with _tracing.span("restart_backoff", generation=gen,
+                               backoff_s=backoff):
+                time.sleep(backoff)
 
 
 def strip_elastic_flags(argv: list) -> list:

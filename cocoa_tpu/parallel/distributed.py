@@ -133,6 +133,15 @@ def blocking_kv_get(client, key: str, *, timeout_s: float = KV_TIMEOUT_S,
     RuntimeError naming the key — and ``what``, the peer/exchange it
     stands for — with the remedy, chaining the last underlying error.
     """
+    from cocoa_tpu.telemetry import tracing as _tracing
+
+    with _tracing.span("kv_get", key=key, what=what):
+        return _blocking_kv_get(client, key, timeout_s=timeout_s,
+                                attempt_s=attempt_s, what=what)
+
+
+def _blocking_kv_get(client, key: str, *, timeout_s: float,
+                     attempt_s: float, what: Optional[str]) -> str:
     deadline = time.monotonic() + timeout_s
     attempts = 0
     fast_failures = 0
@@ -188,13 +197,20 @@ def host_allgather_bytes(tag: str, payload: bytes,
     restarts or shrinks it, instead of every survivor hanging ~10
     minutes in an uninformative gRPC deadline.
     """
-    import base64
-
-    import jax
+    from cocoa_tpu.telemetry import tracing as _tracing
 
     client = kv_client()
     if client is None:
         return [payload]
+    with _tracing.span("kv_allgather", tag=tag, bytes=len(payload)):
+        return _host_allgather(client, tag, payload, timeout_s, attempt_s)
+
+
+def _host_allgather(client, tag, payload, timeout_s, attempt_s) -> list:
+    import base64
+
+    import jax
+
     me = jax.process_index()
     nchunk = (len(payload) + _KV_CHUNK - 1) // _KV_CHUNK
     for i in range(nchunk):

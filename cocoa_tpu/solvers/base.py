@@ -31,6 +31,7 @@ from cocoa_tpu import checkpoint as ckpt_lib
 from cocoa_tpu.config import DebugParams, Params
 from cocoa_tpu.data.sharding import ShardedDataset
 from cocoa_tpu.parallel.fanout import fanout  # noqa: F401  (re-export)
+from cocoa_tpu.telemetry import tracing as _tracing
 from cocoa_tpu.utils.logging import Trajectory
 from cocoa_tpu.utils.prng import sample_indices_per_shard
 
@@ -528,7 +529,8 @@ def drive(
         state = round_fn(t, state)
 
         if debug.debug_iter > 0 and t % debug.debug_iter == 0:
-            primal, gap, test_err = eval_fn(state)
+            with _tracing.span("eval", algorithm=name, round=t):
+                primal, gap, test_err = eval_fn(state)
             traj.log_round(t, primal=primal, gap=gap, test_error=test_err)
             if gap_target is not None and gap is not None and gap <= gap_target:
                 traj.stopped = "target"
@@ -596,11 +598,14 @@ def drive_chunked(
         if ckpt_on:
             end = min(end, ((t - 1) // debug.chkpt_iter + 1) * debug.chkpt_iter)
         c = end - t + 1
-        state = chunk_fn(t, c, state)
+        with _tracing.span("local_solve", algorithm=name, round=end,
+                           t0=t, rounds=c):
+            state = chunk_fn(t, c, state)
         t = end + 1
 
         if debug.debug_iter > 0 and end % debug.debug_iter == 0:
-            primal, gap, test_err = eval_fn(state)
+            with _tracing.span("eval", algorithm=name, round=end):
+                primal, gap, test_err = eval_fn(state)
             anneal_on = (gap_target is not None and divergence_guard
                          and anneal)
             hit = (gap_target is not None and gap is not None
@@ -1049,7 +1054,16 @@ def drive_on_device(
     # sanctioned tap machinery, not a leak.
     import contextlib as _ctx
 
-    with _sanitize.device_loop_guard(), \
+    # the super-block span: one dispatch + the run's single host fetch —
+    # the drive* ladder's host boundary.  Per-eval timing INSIDE the
+    # device loop is unobservable by construction (one dispatch, one
+    # sync; docs/DESIGN.md clock model), so this span is the finest
+    # local-solve timing the device-resident path can honestly report.
+    n_chunks = int(jax.tree.leaves(idxs_all)[0].shape[0])
+    with _tracing.span("local_solve", algorithm=name, t0=start_round,
+                       round=start_round - 1 + n_chunks * c,
+                       rounds=n_chunks * c, cadence=c), \
+            _sanitize.device_loop_guard(), \
             _tele.device_tap(tap if stream else None):
         with (_sanitize.allow_transfers() if stream
               else _ctx.nullcontext()):
@@ -1189,10 +1203,13 @@ def drive_device_full(
     # anchored to t % debugIter == 0 exactly like the host drivers
     head_end = min(params.num_rounds, ((t - 1) // c + 1) * c)
     if (t - 1) % c != 0 and head_end >= t:
-        state = chunk_fn(t, head_end - t + 1, state)
+        with _tracing.span("local_solve", algorithm=name, round=head_end,
+                           t0=t, rounds=head_end - t + 1):
+            state = chunk_fn(t, head_end - t + 1, state)
         t = head_end + 1
         if head_end % c == 0:
-            primal, gap, test_err = eval_fn(state)
+            with _tracing.span("eval", algorithm=name, round=head_end):
+                primal, gap, test_err = eval_fn(state)
             sigma_val = stage = stall_v = None
             backed = False
             hit = (gap_target is not None and gap is not None
@@ -1344,7 +1361,9 @@ def drive_device_full(
     rem = params.num_rounds - (t - 1)
     if rem > 0 and not hit_target() and traj.stopped is None:
         # sub-cadence tail: run it, no eval (off the debugIter cadence)
-        state = chunk_fn(t, rem, state)
+        with _tracing.span("local_solve", algorithm=name,
+                           round=params.num_rounds, t0=t, rounds=rem):
+            state = chunk_fn(t, rem, state)
         maybe_ckpt(params.num_rounds)
     return state, traj
 

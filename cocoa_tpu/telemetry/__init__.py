@@ -23,6 +23,18 @@ until its final host sync.  This package closes that gap:
   core (promoted from benchmarks/trace.py so production runs and
   benchmarks share one implementation) and the round-windowed
   ``--profile=<dir>,<start>,<stop>`` capture riding the event stream.
+- :mod:`cocoa_tpu.telemetry.tracing` — gang-wide span tracing
+  (``--trace``): per-phase, per-worker timed spans emitted through the
+  bus as typed ``span`` events (ingest passes, KV exchanges, local-solve
+  super-blocks, eval windows, checkpoints, supervisor generations).
+- :mod:`cocoa_tpu.telemetry.trace_report` — the offline assembler:
+  merges a gang's per-process span streams, exports Perfetto/Chrome
+  trace JSON, computes the per-round critical path, and attributes
+  stragglers worker × phase by slack.
+- :mod:`cocoa_tpu.telemetry.recorder` — the crash flight recorder: a
+  bounded ring of recent events dumped to ``<events>.flightrec`` on
+  divergence/exception/SIGTERM, plus the supervisor-side dump of a
+  SIGKILLed worker's stream tail.
 
 Soundness: telemetry is side-effect-only.  The device bridge adds an
 ordered ``io_callback`` that READS the eval row the loop already

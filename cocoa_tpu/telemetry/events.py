@@ -56,6 +56,15 @@ EVENT_TYPES = (
     "checkpoint_corrupt",  # a checkpoint generation failed validation on
                         # load; the reader fell back to the previous one
                         # (checkpoint.latest)
+    "span",             # one closed tracing span (telemetry/tracing.py):
+                        # phase + worker + wall start + monotonic
+                        # duration + call-site attributes — what
+                        # trace_report.py assembles into the gang
+                        # timeline / critical path / straggler table
+    "events_rotate",    # the JSONL sink hit its size cap and rolled the
+                        # full file to `<path>.1` (first event of the
+                        # fresh file, so the rotation itself is in the
+                        # machine-readable record)
 )
 
 
@@ -90,21 +99,34 @@ class EventBus:
         self._lock = threading.RLock()
         self.jsonl_path = None
         self.metrics_path = None
+        self.max_bytes = None
         self._subscribers = []
         self._seq = 0
 
-    def configure(self, jsonl_path=None, metrics_path=None):
+    def configure(self, jsonl_path=None, metrics_path=None,
+                  max_bytes=None, metrics_interval_s=0.0):
         """Attach sinks; either may be None.  The metrics path attaches a
-        :class:`cocoa_tpu.telemetry.metrics.MetricsWriter` subscriber.
-        Any active sink also installs the compile→event bridge, so
+        :class:`cocoa_tpu.telemetry.metrics.MetricsWriter` subscriber
+        (``metrics_interval_s`` is its write-debounce window).  Any
+        active sink also installs the compile→event bridge, so
         ``compiles_total``/``compile`` events come for free on telemetry
-        runs (the sanitizer invariants, observable in production)."""
+        runs (the sanitizer invariants, observable in production).
+
+        ``max_bytes`` (``--eventsMaxMB``): size cap on the JSONL sink —
+        when an append pushes the file past it, the full file atomically
+        rolls to ``<path>.1`` (replacing any previous rollover) and the
+        fresh file opens with a typed ``events_rotate`` event, so a
+        long serving/elastic run holds at most ~2× the cap on disk
+        instead of growing without bound."""
         with self._lock:
             self.jsonl_path = jsonl_path or None
+            if max_bytes is not None:
+                self.max_bytes = int(max_bytes) or None
             if metrics_path and metrics_path != self.metrics_path:
                 from cocoa_tpu.telemetry.metrics import MetricsWriter
 
-                self.subscribe(MetricsWriter(metrics_path))
+                self.subscribe(MetricsWriter(
+                    metrics_path, flush_interval_s=metrics_interval_s))
                 self.metrics_path = metrics_path
         if self.active():
             from cocoa_tpu.analysis import sanitize
@@ -130,6 +152,7 @@ class EventBus:
         with self._lock:
             self.jsonl_path = None
             self.metrics_path = None
+            self.max_bytes = None
             self._subscribers = []
             self._seq = 0
 
@@ -154,14 +177,52 @@ class EventBus:
             rec = {"event": event, "seq": self._seq, "pid": os.getpid(),
                    "ts": time.time(),
                    **{k: _clean(v) for k, v in fields.items()}}
+            rotated = None
             if self.jsonl_path:
                 # open-append per event: whole-line writes interleave
                 # safely with other emitters of the same file (the elastic
                 # supervisor appends restart events between generations)
                 with open(self.jsonl_path, "a") as f:
                     f.write(json.dumps(rec) + "\n")
+                    size = f.tell()
+                if (self.max_bytes and size >= self.max_bytes
+                        and event != "events_rotate"):
+                    rotated = self._rotate(size)
             for fn in list(self._subscribers):
                 fn(rec)
+            if rotated is not None:
+                for fn in list(self._subscribers):
+                    fn(rotated)
+        return rec
+
+    def _rotate(self, size: int):
+        """Roll the full JSONL sink to ``<path>.1`` (atomic rename,
+        replacing any previous rollover — the cap bounds disk at ~2×,
+        it does not archive history) and open the fresh file with a
+        typed ``events_rotate`` record.  Caller holds the lock.
+
+        Concurrent emitters: each shared file has exactly ONE rotating
+        owner (cli.py arms ``max_bytes`` on the workers only — the
+        supervisor appends to worker 0's file uncapped), so the re-stat
+        below is a belt-and-suspenders guard, not the coordination
+        mechanism: if the file on disk is already below the cap, some
+        other process rotated between our append and now — renaming
+        again would clobber the just-archived ``.1`` with a near-empty
+        fresh file."""
+        rolled = self.jsonl_path + ".1"
+        try:
+            if os.path.getsize(self.jsonl_path) < self.max_bytes:
+                return None
+            os.replace(self.jsonl_path, rolled)
+        except OSError:
+            return None  # the file vanished under us — nothing to roll
+        self._seq += 1
+        rec = {"event": "events_rotate", "seq": self._seq,
+               "pid": os.getpid(), "ts": time.time(),
+               "path": self.jsonl_path, "rotated_to": rolled,
+               "bytes": int(size)}
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
         return rec
 
 

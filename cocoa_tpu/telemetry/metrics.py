@@ -2,9 +2,12 @@
 
 The contract (docs/DESIGN.md §Observability): a single plain-text file in
 the Prometheus exposition format, rewritten ATOMICALLY (temp + rename, the
-node-exporter textfile-collector convention) on every event, so the
-elastic supervisor's stall watchdog and any external scraper can watch a
-run that is otherwise one opaque device dispatch:
+node-exporter textfile-collector convention) on every event — or, with a
+``flush_interval_s`` debounce, at most once per interval plus a trailing
+timer flush (``--metricsInterval``; run boundaries and recovery
+transitions always write immediately) — so the elastic supervisor's
+stall watchdog and any external scraper can watch a run that is
+otherwise one opaque device dispatch:
 
 - ``cocoa_rounds_total``        counter — training rounds advanced
 - ``cocoa_evals_total``         counter — debugIter-cadence evaluations
@@ -39,6 +42,12 @@ run that is otherwise one opaque device dispatch:
 - ``cocoa_checkpoint_corrupt_total`` counter — checkpoint generations
   rejected by validation on load (the reader fell back to the previous
   generation; any nonzero value deserves a disk/preemption look)
+- ``cocoa_phase_seconds{phase=...}`` gauge — cumulative seconds this
+  process spent in each traced phase (the ``span`` events of
+  telemetry/tracing.py; present only on ``--trace`` runs).  The
+  cross-worker straggler gauges (``cocoa_straggler_slack_seconds``)
+  come from telemetry/trace_report.py, which merges every process's
+  stream
 - ``cocoa_last_gap``            gauge   — most recent duality gap
 - ``cocoa_round_seconds``       histogram — observed per-round wall time
   (host-clock deltas between consecutive evals divided by the rounds
@@ -53,13 +62,26 @@ their rounds accumulate).  The writer is a plain bus subscriber —
 from __future__ import annotations
 
 import os
+import threading
+import time
 
 BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
            0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# events whose state change must be visible immediately regardless of the
+# write debounce: run boundaries, bail-outs, and every supervisor-visible
+# recovery transition (the stall watchdog reads this file as a progress
+# token — a debounced restart/resize would blind it exactly when it
+# matters)
+_FLUSH_EVENTS = frozenset((
+    "run_start", "run_end", "divergence", "restart", "gang_resize",
+    "checkpoint_corrupt", "events_rotate",
+))
+
 
 class MetricsWriter:
-    def __init__(self, path: str, families: str = "all"):
+    def __init__(self, path: str, families: str = "all",
+                 flush_interval_s: float = 0.0):
         # families="gang": render ONLY the supervisor-owned gang families
         # (cocoa_gang_size / cocoa_gang_generations_total /
         # cocoa_restart_backoff_seconds) — the elastic supervisor's
@@ -69,10 +91,23 @@ class MetricsWriter:
         # different things in each file).  "all" (workers, single
         # process) renders everything, with the gang families gated on
         # having actually seen gang data for the same reason.
+        # flush_interval_s > 0: coalesce textfile rewrites to at most one
+        # per interval (plus a trailing timer flush, so the file always
+        # converges to the final state within one interval even when the
+        # event stream stops).  The default 0.0 keeps the original
+        # behavior — one atomic rewrite per event — which is already
+        # right at eval cadence; span-heavy or tight-cadence runs pass
+        # --metricsInterval so a µs-scale event burst costs one rename,
+        # not hundreds.  _FLUSH_EVENTS bypass the debounce either way.
         if families not in ("all", "gang"):
             raise ValueError(f"families must be all|gang, got {families!r}")
         self.families = families
         self.path = path
+        self.flush_interval_s = float(flush_interval_s)
+        self._lock = threading.RLock()
+        self._last_write = 0.0
+        self._dirty = False
+        self._timer = None
         self.rounds_total = 0
         self.evals_total = 0
         self.sigma_backoffs_total = 0
@@ -87,6 +122,7 @@ class MetricsWriter:
         self.host_transfers_total = 0
         self.ingest_seconds = 0.0
         self.ingest_bytes = 0
+        self.phase_seconds: dict = {}   # span phase -> cumulative seconds
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -107,6 +143,11 @@ class MetricsWriter:
         self.bucket_counts[-1] += 1
 
     def __call__(self, rec: dict):
+        with self._lock:
+            self._update(rec)
+            self._maybe_write(rec.get("event"))
+
+    def _update(self, rec: dict):
         ev = rec.get("event")
         if ev == "run_start":
             self._prev.clear()
@@ -163,7 +204,46 @@ class MetricsWriter:
                 self.ingest_seconds += float(rec["parse_seconds"])
             if rec.get("bytes_read") is not None:
                 self.ingest_bytes += int(rec["bytes_read"])
-        self.write()
+        elif ev == "span":
+            # per-phase wall-clock gauge (tracing.py spans): cumulative
+            # seconds this process spent in each instrumented phase —
+            # the single-process half of the straggler story (the
+            # cross-worker slack gauges come from trace_report.py,
+            # which sees every process's stream)
+            phase = rec.get("phase")
+            if phase is not None and rec.get("dur_s") is not None:
+                self.phase_seconds[str(phase)] = (
+                    self.phase_seconds.get(str(phase), 0.0)
+                    + float(rec["dur_s"]))
+
+    def _maybe_write(self, ev):
+        """The write debounce (caller holds the lock): flush-now events
+        and elapsed intervals write; everything else marks dirty and arms
+        a one-shot trailing timer for the remainder of the window."""
+        self._dirty = True
+        now = time.monotonic()
+        if (self.flush_interval_s <= 0 or ev in _FLUSH_EVENTS
+                or now - self._last_write >= self.flush_interval_s):
+            self.write()
+            return
+        if self._timer is None:
+            delay = self.flush_interval_s - (now - self._last_write)
+            self._timer = threading.Timer(max(delay, 0.001), self.flush)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def flush(self):
+        """Write the current state if anything changed since the last
+        write (the trailing-timer target; also callable by owners at
+        shutdown).  Best-effort on the timer path: the target directory
+        may already be gone at process teardown — a late flush must not
+        turn that into a thread-crash traceback."""
+        with self._lock:
+            if self._dirty:
+                try:
+                    self.write()
+                except OSError:
+                    pass
 
     def _gang_lines(self) -> list:
         lines = ["# TYPE cocoa_gang_generations_total counter",
@@ -208,6 +288,11 @@ class MetricsWriter:
             # process actually saw gang events (a worker never does —
             # its file must not shadow the supervisor's .gang series)
             lines += self._gang_lines()
+        if self.phase_seconds:
+            lines.append("# TYPE cocoa_phase_seconds gauge")
+            lines += [f'cocoa_phase_seconds{{phase="{p}"}} '
+                      f"{self.phase_seconds[p]!r}"
+                      for p in sorted(self.phase_seconds)]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]
@@ -226,7 +311,13 @@ class MetricsWriter:
         return "\n".join(lines) + "\n"
 
     def write(self):
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            f.write(self.render())
-        os.replace(tmp, self.path)
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._dirty = False
+            self._last_write = time.monotonic()
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(self.render())
+            os.replace(tmp, self.path)

@@ -1,7 +1,7 @@
 """JSONL schema checker for the telemetry artifacts.
 
 One dependency-free validator shared by tests/test_telemetry.py and the CI
-telemetry step, covering the four JSONL dialects this repo emits:
+telemetry step, covering the five JSONL dialects this repo emits:
 
 - **event streams** (``--events``, telemetry/events.py): every line has
   ``event``/``seq``/``ts``, per-type required fields, and ``seq`` is
@@ -15,6 +15,10 @@ telemetry step, covering the four JSONL dialects this repo emits:
 - **analysis reports** (``python -m cocoa_tpu.analysis --report=...``):
   an ``analysis_manifest`` header plus one finding per line, unique
   fingerprints (what the jaxlint baseline keys on).
+- **flight-recorder dumps** (``<events>.flightrec``,
+  telemetry/recorder.py): a ``flightrec_manifest`` header (dump reason,
+  victim pid, ring size) followed by the last-N event records the ring
+  held when the dump fired.
 
 Usage: ``python -m cocoa_tpu.telemetry.schema FILE...`` — the dialect is
 sniffed per file from its first line; exit code 1 on any violation.
@@ -99,6 +103,19 @@ EVENT_FIELDS = {
     # fell back (checkpoint.latest) — the torn/corrupt-file recovery path
     "checkpoint_corrupt": {"algorithm": (str,), "path": (str,),
                            "reason": (str,)},
+    # one closed tracing span (telemetry/tracing.py): the per-phase,
+    # per-worker timing record trace_report.py assembles into the gang
+    # timeline / per-round critical path / straggler table.  parent_id
+    # None = a top-level span; worker None = tracer configured without a
+    # process index (single-process runs)
+    "span": {"phase": (str,), "span_id": (int,),
+             "parent_id": (int, type(None)),
+             "worker": (int, type(None)),
+             "start_ts": _NUM, "dur_s": _NUM},
+    # the JSONL sink hit its --eventsMaxMB cap and rolled to `.1`
+    # (events.EventBus._rotate) — always the first event of a fresh file
+    "events_rotate": {"path": (str,), "rotated_to": (str,),
+                      "bytes": (int,)},
 }
 
 TRAJ_RECORD_FIELDS = {
@@ -294,9 +311,36 @@ def check_analysis_lines(objs) -> list:
     return errors
 
 
+def check_flightrec_lines(objs) -> list:
+    """Validate a flight-recorder dump (``<events>.flightrec``,
+    telemetry/recorder.py — the 5th dialect): a ``flightrec_manifest``
+    header naming the dump reason, then the ring's last-N event records,
+    each a valid typed event (per-emitter seq ordering holds — the ring
+    preserves emission order, and a victim-tail dump is one emitter)."""
+    errors = []
+    if not objs:
+        return ["empty flight-recorder dump"]
+    ln0, head = objs[0]
+    man = head.get("flightrec_manifest")
+    if not isinstance(man, dict):
+        errors.append(f"line {ln0}: first line must carry the "
+                      f"flightrec_manifest header")
+    else:
+        for name in ("reason", "ts", "n_events"):
+            if name not in man:
+                errors.append(f"line {ln0}: flightrec_manifest missing "
+                              f"{name!r}")
+        n = man.get("n_events")
+        if isinstance(n, int) and n != len(objs) - 1:
+            errors.append(f"line {ln0}: manifest says n_events={n} but "
+                          f"the dump carries {len(objs) - 1} records "
+                          f"(torn dump?)")
+    return errors + check_event_lines(objs[1:])
+
+
 def sniff(objs) -> str:
-    """Dialect from the first line:
-    'events' | 'trajectory' | 'results' | 'analysis'."""
+    """Dialect from the first line: 'events' | 'trajectory' | 'results'
+    | 'analysis' | 'flightrec'."""
     if not objs:
         return "events"
     head = objs[0][1]
@@ -304,6 +348,8 @@ def sniff(objs) -> str:
         return "events"
     if "analysis_manifest" in head:
         return "analysis"
+    if "flightrec_manifest" in head:
+        return "flightrec"
     if "manifest" in head:
         return "trajectory"
     return "results"
@@ -312,7 +358,8 @@ def sniff(objs) -> str:
 _CHECKERS = {"events": check_event_lines,
              "trajectory": check_trajectory_lines,
              "results": check_results_lines,
-             "analysis": check_analysis_lines}
+             "analysis": check_analysis_lines,
+             "flightrec": check_flightrec_lines}
 
 
 def check_file(path: str, kind: str = "auto") -> list:

@@ -59,6 +59,29 @@ def main(argv=None) -> int:
     ckdir = opts.get("chkptDir", "")
     ck_iter = int(opts.get("chkptIter", 5))
     step_s = float(opts.get("stepSeconds", 0.05))
+    # per-worker step skew (--stepSkew=S): worker i sleeps step_s + i*S —
+    # a deterministic straggler for the trace_report attribution tests
+    skew_s = float(opts.get("stepSkew", 0.0))
+
+    # --events/--trace: the same telemetry surface the real CLI wires —
+    # worker 0 owns the given path, worker p > 0 streams to `.p<p>`
+    # (telemetry/recorder.worker_stream_path), spans tagged with the
+    # worker index — so the supervisor's flight-recorder dump and the
+    # trace_report merge run against real per-process artifacts here too
+    from cocoa_tpu.telemetry import events as tele_events
+    from cocoa_tpu.telemetry import recorder as tele_recorder
+    from cocoa_tpu.telemetry import tracing
+
+    stream = (tele_recorder.worker_stream_path(opts["events"], pid)
+              if opts.get("events") else None)
+    # same ownership split as the real CLI: worker 0 owns <metrics>; the
+    # supervisor owns the sibling <metrics>.gang (families="gang")
+    metrics = opts.get("metrics") if pid == 0 else None
+    if stream or metrics:
+        tele_events.get_bus().configure(jsonl_path=stream,
+                                        metrics_path=metrics)
+    if opts.get("trace"):
+        tracing.configure(enabled=True, worker=pid)
 
     from cocoa_tpu.parallel.distributed import (host_allgather_bytes,
                                                 maybe_initialize)
@@ -86,17 +109,23 @@ def main(argv=None) -> int:
                   f"({path})", flush=True)
 
     for t in range(start, rounds + 1):
-        mine = round_increments(t, k, pid * m, (pid + 1) * m)
-        # short KV budget: a dead peer must fail THIS worker quickly so
-        # the supervisor (which already saw the death) isn't racing a
-        # 10-minute hang in the teardown path
-        parts = host_allgather_bytes(f"toy{t}", mine.tobytes(),
-                                     timeout_s=30.0, attempt_s=2.0)
-        for p in parts:
-            w = w + np.frombuffer(p, np.float64)
-        time.sleep(step_s)
-        if ckdir and t % ck_iter == 0:
-            ckpt_lib.save(ckdir, ALGORITHM, t, w, None, seed=0)
+        # the round span carries the round number; the nested
+        # kv_allgather / local_step / checkpoint_save spans inherit it
+        # (trace_report.attribute_rounds), which is what the per-round
+        # critical path and the worker x phase straggler table key on
+        with tracing.span("round", round=t):
+            mine = round_increments(t, k, pid * m, (pid + 1) * m)
+            # short KV budget: a dead peer must fail THIS worker quickly
+            # so the supervisor (which already saw the death) isn't
+            # racing a 10-minute hang in the teardown path
+            parts = host_allgather_bytes(f"toy{t}", mine.tobytes(),
+                                         timeout_s=30.0, attempt_s=2.0)
+            for p in parts:
+                w = w + np.frombuffer(p, np.float64)
+            with tracing.span("local_step"):
+                time.sleep(step_s + pid * skew_s)
+            if ckdir and t % ck_iter == 0:
+                ckpt_lib.save(ckdir, ALGORITHM, t, w, None, seed=0)
     print(f"{ALGORITHM}: done at round {rounds}", flush=True)
     return 0
 

@@ -56,9 +56,16 @@ def main(argv=None) -> int:
     bus.configure(jsonl_path=events_path)
     bus.subscribe(MetricsWriter(metrics_path, families="gang"))
 
-    def toy_argv(ckdir):
-        return [f"--chkptDir={ckdir}", "--numSplits=4", "--numRounds=20",
+    def toy_argv(ckdir, telemetry=False):
+        argv = [f"--chkptDir={ckdir}", "--numSplits=4", "--numRounds=20",
                 "--chkptIter=5", "--stepSeconds=0.05"]
+        if telemetry:
+            # workers stream events + spans per process (worker 0 shares
+            # the supervisor's file, worker 1 writes `.p1`) so the kill
+            # leaves a flight-recorder artifact and trace_report has a
+            # real gang timeline to assemble
+            argv += [f"--events={events_path}", "--trace"]
+        return argv
 
     plan = FaultPlan(
         Fault(generation=0, actions=(sigkill(1),),
@@ -68,7 +75,8 @@ def main(argv=None) -> int:
     print("chaos-smoke: 2-process gang, SIGKILL worker 1 mid-run, "
           "shrink to the survivor", flush=True)
     rc = elastic.supervise(
-        toy_argv(ck), 2, module="_gang_worker", max_restarts=3,
+        toy_argv(ck, telemetry=True), 2, module="_gang_worker",
+        max_restarts=3,
         poll_s=0.05, num_splits=4, shrink="now", backoff_base_s=0.2,
         on_generation=plan.on_generation,
     )
@@ -113,13 +121,57 @@ def main(argv=None) -> int:
         if needle not in metrics_text:
             failures.append(f"metrics textfile lacks {needle!r}")
 
+    # the crash flight recorder (ISSUE 10): the SIGKILLed worker 1's
+    # last-N events, dumped by the supervisor from the victim's stream
+    frec = events_path + ".p1.flightrec"
+    if not os.path.exists(frec):
+        failures.append(f"no flight-recorder dump at {frec}")
+    else:
+        errs = tele_schema.check_file(frec)
+        if errs:
+            failures.append(f"flightrec schema violations: {errs[:5]}")
+        frecs = [json.loads(ln) for ln in open(frec)]
+        man = frecs[0].get("flightrec_manifest", {})
+        if man.get("reason") != "worker_died" or len(frecs) < 2:
+            failures.append(f"flightrec manifest wrong: {man}")
+
+    # the span streams assemble into a schema-valid Perfetto trace with
+    # a nonempty per-round critical path and a worker x phase straggler
+    # table (telemetry/trace_report.py)
+    from cocoa_tpu.telemetry import trace_report
+
+    streams = [p for p in (events_path, events_path + ".p1")
+               if os.path.exists(p)]
+    spans = trace_report.load_spans(streams)
+    if not spans:
+        failures.append("no spans in the gang's event streams")
+    else:
+        trace = trace_report.chrome_trace(spans)
+        terrs = trace_report.check_chrome_trace(trace)
+        if terrs:
+            failures.append(f"chrome trace invalid: {terrs[:5]}")
+        with open(os.path.join(outdir, "chaos-trace.json"), "w") as f:
+            json.dump(trace, f)
+        cpath = trace_report.critical_path(spans)
+        if not cpath:
+            failures.append("empty per-round critical path")
+        rows = trace_report.stragglers(spans)
+        if not rows:
+            failures.append("empty straggler table")
+        else:
+            top = rows[0]
+            print(f"chaos-smoke: top straggler worker {top['worker']} x "
+                  f"{top['phase']} (slack {top['slack_s']:.4f}s)",
+                  flush=True)
+
     if failures:
         for f in failures:
             print(f"chaos-smoke FAIL: {f}", file=sys.stderr)
         return 1
     print("chaos-smoke: OK — kill survived, gang shrunk 2->1, final "
           "state bit-identical to the control, events schema-valid, "
-          "gang gauges present", flush=True)
+          "gang gauges present, flightrec dumped, trace assembled",
+          flush=True)
     return 0
 
 

@@ -350,6 +350,148 @@ def test_pallas_budget_detects_gate_estimate_drift(monkeypatch):
     assert any("exceeds VMEM_BUDGET" in f.message for f in found)
 
 
+# --- span-hygiene rule ------------------------------------------------------
+
+SPAN_IN_JIT = """
+import jax
+from cocoa_tpu.telemetry import tracing
+
+@jax.jit
+def step(w, alpha):
+    with tracing.span("round"):
+        return w + alpha.sum(), alpha
+"""
+
+SPAN_IN_LAX_BODY = """
+import jax
+from jax import lax
+from cocoa_tpu.telemetry import tracing as _tracing
+
+def run(w):
+    def body(s):
+        with _tracing.span("chunk"):
+            return s + 1.0
+    return lax.while_loop(lambda s: s < 10.0, body, w)
+"""
+
+TRACED_DECORATOR_ON_JITTED = """
+import functools
+import jax
+from cocoa_tpu.telemetry import tracing
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+@tracing.traced("round_step")
+def round_step(w, idxs):
+    return w + w[idxs].sum()
+"""
+
+SPAN_READS_TRACED_VALUE = """
+import jax
+from jax import lax
+from jax.experimental import io_callback
+from cocoa_tpu.telemetry import tracing
+
+@jax.jit
+def run(w):
+    def tap(row):
+        # host-side by construction (io_callback target), so spanning is
+        # legal — but tagging the enclosing TRACED w syncs it at emit
+        with tracing.span("eval", w_now=w):
+            pass
+    def body(s):
+        io_callback(tap, None, s, ordered=True)
+        return s + 1.0
+    return lax.while_loop(lambda s: s < 3.0, body, w)
+"""
+
+SPAN_ON_HOST_CLEAN = """
+import jax
+from cocoa_tpu.telemetry import tracing
+
+@jax.jit
+def step(w):
+    return w + 1.0
+
+def drive(w, rounds):
+    for t in range(rounds):
+        with tracing.span("local_solve", round=t):
+            w = step(w)
+    with tracing.span("eval", round=rounds):
+        gap = float(w.sum())
+    return w, gap
+"""
+
+SPAN_IN_CALLBACK_CLEAN = """
+import jax
+from jax import lax
+from jax.experimental import io_callback
+from cocoa_tpu.telemetry import tracing
+
+def run(w):
+    def tap(row):
+        # io_callback targets run on the HOST — spans are fine here
+        with tracing.span("decode"):
+            pass
+    def body(s):
+        io_callback(tap, None, s, ordered=True)
+        return s + 1.0
+    return lax.while_loop(lambda s: s < 3.0, body, w)
+"""
+
+
+def test_span_hygiene_span_in_jit_caught(tmp_path):
+    found = lint(tmp_path, SPAN_IN_JIT, rule="span-hygiene")
+    assert len(found) == 1
+    assert "times the trace" in found[0].message
+
+
+def test_span_hygiene_span_in_lax_body_caught(tmp_path):
+    found = lint(tmp_path, SPAN_IN_LAX_BODY, rule="span-hygiene")
+    assert len(found) == 1 and found[0].severity == "error"
+
+
+def test_span_hygiene_traced_decorator_on_jitted_caught(tmp_path):
+    found = lint(tmp_path, TRACED_DECORATOR_ON_JITTED,
+                 rule="span-hygiene")
+    assert found and any("decorate the host-side caller" in f.message
+                         for f in found)
+
+
+def test_span_hygiene_traced_attr_in_callback_caught(tmp_path):
+    """An io_callback target runs on the host and may span freely — but
+    a span attribute reading a value traced in the ENCLOSING scope is a
+    silent device sync at emit time."""
+    found = lint(tmp_path, SPAN_READS_TRACED_VALUE, rule="span-hygiene")
+    assert len(found) == 1
+    assert "traced value" in found[0].message
+
+
+def test_span_hygiene_host_and_callback_spans_clean(tmp_path):
+    assert lint(tmp_path, SPAN_ON_HOST_CLEAN, rule="span-hygiene") == []
+    assert lint(tmp_path, SPAN_IN_CALLBACK_CLEAN,
+                rule="span-hygiene") == []
+
+
+UNRELATED_SPAN_METHOD = """
+import re
+import jax
+
+@jax.jit
+def step(w, names):
+    # trace-time host work: re.Match.span() is NOT the tracing API —
+    # the rule must key on the tracing receiver / string phase arg
+    m = re.match(r"w(\\d+)", "w3")
+    lo, hi = m.span()
+    spans = [m.span(0)]
+    return w[lo:hi]
+"""
+
+
+def test_span_hygiene_ignores_unrelated_span_methods(tmp_path):
+    assert lint(tmp_path, UNRELATED_SPAN_METHOD,
+                rule="span-hygiene") == []
+
+
 # --- fingerprints / baseline / report --------------------------------------
 
 

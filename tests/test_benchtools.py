@@ -129,3 +129,62 @@ def test_maybe_real_rejects_wrong_shape(tmp_path):
         run_mod._maybe_real(str(tmp_path), "rcv1_train.binary")
     assert run_mod._maybe_real(str(tmp_path / "nope"),
                                "rcv1_train.binary") is None
+
+
+# --- the CI bench-regression gate (benchmarks/check_regression.py) ----------
+
+
+def test_check_regression_evaluate_logic():
+    """The comparison core: certify + stay within the committed round
+    bound = pass; more rounds than committed*(1+tol) or a lost
+    certificate = fail with an actionable message."""
+    import check_regression as cr
+
+    gate = {"config": "demo-cocoa+", "gap_target": 1e-4,
+            "rounds_tol": 0.15}
+    committed = {"demo-cocoa+": {"config": "demo-cocoa+", "rounds": 440}}
+    ok = {"config": "demo-cocoa+", "rounds": 440, "gap": 9e-5,
+          "stopped": "target"}
+    assert cr.evaluate(gate, ok, committed) == []
+    # the tolerance is explicit: the bound is int(440 * 1.15) = 505
+    assert cr.evaluate(gate, {**ok, "rounds": 505}, committed) == []
+    fails = cr.evaluate(gate, {**ok, "rounds": 506}, committed)
+    assert len(fails) == 1 and "ROUND REGRESSION" in fails[0]
+    # a run that stopped on budget instead of certifying fails even at a
+    # low round count
+    fails = cr.evaluate(gate, {**ok, "stopped": None}, committed)
+    assert fails and "no longer certifies" in fails[0]
+    # no committed row -> the gate has nothing to stand on; loud fail
+    assert cr.evaluate(gate, ok, {}) != []
+    # a fresh run that errored out propagates the error
+    assert cr.evaluate(gate, {"config": "demo-cocoa+",
+                              "error": "CLI exited 2"}, committed) != []
+
+
+def test_check_regression_fresh_mode(tmp_path):
+    """--fresh=results.jsonl checks an existing artifact against the
+    committed bounds without re-running anything."""
+    import check_regression as cr
+
+    fresh = tmp_path / "fresh.jsonl"
+    # a perf-accounting row precedes the results row (both carry
+    # 'config'; only the one with 'rounds' can anchor the gate)
+    fresh.write_text(
+        json.dumps({"config": "demo-cocoa+", "type": "perf",
+                    "us_per_step": 0.1}) + "\n"
+        + json.dumps(
+            {"config": "demo-cocoa+", "rounds": 400, "gap": 9e-5}) + "\n")
+    rc = cr.main([f"--fresh={fresh}", "--only=demo-cocoa+",
+                  f"--report={tmp_path / 'rep.jsonl'}"])
+    assert rc == 0
+    # the report validates as the benchmarks-results dialect
+    from cocoa_tpu.telemetry import schema as tele_schema
+
+    assert tele_schema.check_file(str(tmp_path / "rep.jsonl"),
+                                  kind="results") == []
+    fresh.write_text(json.dumps(
+        {"config": "demo-cocoa+", "rounds": 4000, "gap": 9e-5}) + "\n")
+    assert cr.main([f"--fresh={fresh}", "--only=demo-cocoa+"]) == 1
+    # unknown config / bad flag -> usage
+    assert cr.main(["--only=nope"]) == 2
+    assert cr.main(["--bogus"]) == 2

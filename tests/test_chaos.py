@@ -239,6 +239,37 @@ def test_supervise_emits_gang_resize_and_schema_valid(monkeypatch,
     assert all(r["attempt"] >= 1 for r in restarts)
 
 
+def test_supervise_dumps_victim_flightrec(monkeypatch, tmp_path):
+    """A worker death with --events configured leaves a `.flightrec`
+    explanation artifact: the supervisor tails the victim's stream
+    (telemetry/recorder.dump_victim) before deciding the restart.  Dead
+    worker 0 here, so the victim stream is the shared events file — the
+    pre-seeded worker events must be what the dump carries."""
+    ev = tmp_path / "events.jsonl"
+    with open(ev, "w") as f:
+        for t in (5, 10):
+            f.write(json.dumps(
+                {"event": "checkpoint_write", "seq": t, "pid": 777,
+                 "ts": float(t), "algorithm": "ToyGang", "round": t,
+                 "path": "x"}) + "\n")
+    tele_events.get_bus().configure(jsonl_path=str(ev))
+    monkeypatch.setattr(elastic, "_spawn", _dead_spawner([]))
+    elastic.supervise([], 2, max_restarts=0, poll_s=0.0, resume=False,
+                      num_splits=4, shrink="now", backoff_base_s=0.0)
+    path = str(ev) + ".flightrec"
+    assert os.path.exists(path)
+    assert tele_schema.check_file(path) == []
+    recs = [json.loads(ln) for ln in open(path)]
+    man = recs[0]["flightrec_manifest"]
+    assert man["reason"] == "worker_died" and man["source"] == "supervisor"
+    assert man["victim_index"] == 0 and man["exit_code"] == 3
+    # _DeadProc has no pid to scope by — the dump is the stream's
+    # last-known state, and says so
+    assert man["scope"] == "stream"
+    assert any(r.get("event") == "checkpoint_write" and r["pid"] == 777
+               for r in recs[1:])
+
+
 def test_metrics_writer_gang_gauges(tmp_path):
     """gang_resize / restart / checkpoint_corrupt events drive the new
     gauges and counters; the gang families render as a dedicated subset
@@ -608,7 +639,10 @@ def test_gang_sigkill_shrinks_to_survivor_bit_identical(tmp_path,
     rendezvous, real KV allgather per round, real checkpoints) loses
     worker 1 to SIGKILL mid-run; the supervisor reforms at P'=1, the
     survivor resumes and completes — final state bit-identical to the
-    unfailed 2-process control."""
+    unfailed 2-process control.  With --events on the workers, the
+    SIGKILL additionally yields a validated `.flightrec` dump from the
+    supervisor path carrying the victim's last-N events (the ISSUE-10
+    acceptance pin)."""
     _gang_env(monkeypatch)
     ck = tmp_path / "ck"
     ev = tmp_path / "events.jsonl"
@@ -619,8 +653,14 @@ def test_gang_sigkill_shrinks_to_survivor_bit_identical(tmp_path,
               name="kill-worker-1"),
     )
     resizes = []
+    # --trace as well: spans flow from round 1, so the victim's stream
+    # is deterministically nonempty whenever the kill lands (checkpoint
+    # events alone would race — the trigger can fire on worker 0's save
+    # before worker 1 has written anything)
     rc = elastic.supervise(
-        _toy_argv(ck), 2, module="_gang_worker", max_restarts=3,
+        _toy_argv(ck) + [f"--events={ev}", "--trace"], 2,
+        module="_gang_worker",
+        max_restarts=3,
         poll_s=0.05, num_splits=4, shrink="now", backoff_base_s=0.0,
         on_generation=plan.on_generation,
         on_restart=lambda gen, reason, old, new, backoff:
@@ -645,6 +685,27 @@ def test_gang_sigkill_shrinks_to_survivor_bit_identical(tmp_path,
     recs = [json.loads(ln) for ln in ev.read_text().splitlines()]
     assert any(r["event"] == "gang_resize" and r["new_size"] == 1
                for r in recs)
+
+    # the crash explanation artifact: the SIGKILLed worker 1 could not
+    # dump its own ring, so the supervisor tailed worker 1's stream
+    # (`<events>.p1`) and dumped on its behalf — a validated flightrec
+    # naming the victim and carrying its last events (the checkpoint
+    # writes that were its final observable acts)
+    frec = str(ev) + ".p1.flightrec"
+    assert os.path.exists(frec)
+    assert tele_schema.check_file(frec) == []
+    frecs = [json.loads(ln) for ln in open(frec)]
+    man = frecs[0]["flightrec_manifest"]
+    assert man["reason"] == "worker_died"
+    assert man["source"] == "supervisor" and man["victim_index"] == 1
+    # a real Popen victim: the tail is scoped to the dead process's pid
+    assert man["scope"] == "victim"
+    victim_events = frecs[1:]
+    assert victim_events, "the dump must carry the victim's events"
+    assert {r["pid"] for r in victim_events} == {man["victim_pid"]}
+    # worker 1 was mid-flight: its last observable acts — round spans
+    # (guaranteed from round 1) and usually its round-5 checkpoint
+    assert any(r["event"] == "span" for r in victim_events)
 
 
 @pytest.mark.slow

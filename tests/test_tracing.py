@@ -1,0 +1,591 @@
+"""Gang-wide span tracing, the trace assembler, and the flight recorder
+(cocoa_tpu/telemetry/tracing.py / trace_report.py / recorder.py).
+
+What these tests pin:
+
+- **span mechanics**: nesting/parent ids, the decorator form, the error
+  attribute, and total inertness when the tracer or the bus is off;
+- **the acceptance pin**: tracing-on ``(w, alpha)`` and the sched leaf
+  are bit-identical to tracing-off — spans are host-side bookkeeping
+  and may not perturb the run, exactly like the PR-4 telemetry bridge;
+- **trace_report**: merged multi-worker streams yield a schema-valid
+  Chrome/Perfetto trace, a nonempty per-round critical path over LEAF
+  spans (no parent/child double counting), and a straggler table whose
+  top row names the deliberately-skewed worker × phase;
+- **flight recorder**: the ring is bounded, a ``divergence`` event dumps
+  it, SIGTERM dumps it (real subprocess), and the supervisor-side
+  ``dump_victim`` tail-reads a dead worker's stream — each dump
+  validating as the schema checker's ``flightrec`` dialect;
+- the satellites: ``--events`` size-capped rotation with the typed
+  ``events_rotate`` record, the metrics write debounce (at most one
+  rewrite per interval, trailing flush, terminal events bypass), the
+  ``cocoa_phase_seconds`` gauge, and the new CLI flag validation;
+- **slow, real processes**: a 2-process toy gang under the elastic
+  supervisor leaves per-process span streams that trace_report merges
+  into one timeline with cross-worker straggler attribution.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cocoa_tpu import checkpoint as ckpt_lib
+from cocoa_tpu import elastic
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.solvers import run_cocoa
+from cocoa_tpu.telemetry import events as tele_events
+from cocoa_tpu.telemetry import recorder as tele_recorder
+from cocoa_tpu.telemetry import schema as tele_schema
+from cocoa_tpu.telemetry import trace_report, tracing
+from cocoa_tpu.telemetry.metrics import MetricsWriter
+from test_divergence import _coherent_dataset
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.dirname(os.path.abspath(__file__))
+
+K, LAM = 4, 1e-4
+
+
+@pytest.fixture(autouse=True)
+def clean_bus_and_tracer():
+    tele_events.get_bus().reset()
+    tracing.reset()
+    yield tele_events.get_bus()
+    tele_events.get_bus().reset()
+    tracing.reset()
+
+
+def _collect():
+    events = []
+    tele_events.get_bus().subscribe(events.append)
+    return events
+
+
+# --- span mechanics ----------------------------------------------------------
+
+
+def test_span_nesting_parent_ids_and_attrs():
+    events = _collect()
+    tracing.configure(enabled=True, worker=3)
+    with tracing.span("round", round=7) as outer:
+        with tracing.span("kv_get", key="a") as inner:
+            pass
+    spans = [e for e in events if e["event"] == "span"]
+    assert [s["phase"] for s in spans] == ["kv_get", "round"]  # close order
+    inner_s, outer_s = spans
+    assert inner_s["span_id"] == inner and outer_s["span_id"] == outer
+    assert inner_s["parent_id"] == outer and outer_s["parent_id"] is None
+    assert inner_s["worker"] == outer_s["worker"] == 3
+    assert outer_s["round"] == 7 and inner_s["key"] == "a"
+    assert 0.0 <= inner_s["dur_s"] <= outer_s["dur_s"]
+    assert outer_s["start_ts"] <= inner_s["start_ts"] + 1.0
+
+
+def test_traced_decorator_and_error_attribute():
+    events = _collect()
+    tracing.configure(enabled=True)
+
+    @tracing.traced("work", kind="unit")
+    def work(x):
+        return x + 1
+
+    assert work(1) == 2
+    with pytest.raises(ValueError):
+        with tracing.span("doomed"):
+            raise ValueError("boom")
+    spans = [e for e in events if e["event"] == "span"]
+    assert spans[0]["phase"] == "work" and spans[0]["kind"] == "unit"
+    assert spans[1]["phase"] == "doomed" and spans[1]["error"] == "ValueError"
+
+
+def test_disabled_tracer_and_inert_bus_emit_nothing(tmp_path):
+    events = _collect()
+    with tracing.span("x"):            # tracer disabled
+        pass
+    tele_events.get_bus().reset()      # bus inert (no subscriber/sink)
+    tracing.configure(enabled=True)
+    with tracing.span("y") as sid:
+        pass
+    assert sid is None
+    assert [e for e in events if e["event"] == "span"] == []
+
+
+# --- the acceptance pin: tracing must not perturb the run --------------------
+
+
+def _anneal_run(tmp_path, name):
+    """A short σ′-anneal device-loop run with checkpoints (the sched
+    leaf rides the checkpoint meta — the on/off comparison reads it
+    there, like the telemetry on/off pin)."""
+    ds, n = _coherent_dataset(k=K)
+    params = Params(n=n, num_rounds=150, local_iters=16, lam=LAM,
+                    sigma=1.0)
+    debug = DebugParams(debug_iter=25, seed=0, chkpt_iter=75,
+                        chkpt_dir=str(tmp_path / name))
+    return run_cocoa(ds, params, debug, plus=True, quiet=True, math="fast",
+                     device_loop=True, gap_target=1e-3, rng="jax",
+                     sigma_schedule="anneal")
+
+
+def test_tracing_on_vs_off_state_bit_identical(tmp_path):
+    """Spans are host-side bookkeeping: a traced run's (w, alpha) and
+    sched leaf are bit-identical to an untraced run."""
+    tele_events.get_bus().configure(
+        jsonl_path=str(tmp_path / "events.jsonl"))
+    tracing.configure(enabled=True, worker=0)
+    w1, a1, t1 = _anneal_run(tmp_path, "on")
+    spans = [json.loads(ln)
+             for ln in open(tmp_path / "events.jsonl")
+             if json.loads(ln)["event"] == "span"]
+    assert spans, "the traced run must actually have emitted spans"
+    assert {s["phase"] for s in spans} >= {"local_solve", "checkpoint_save"}
+
+    tele_events.get_bus().reset()
+    tracing.reset()
+    w2, a2, t2 = _anneal_run(tmp_path, "off")
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    names = sorted(os.listdir(tmp_path / "on"))
+    assert names == sorted(os.listdir(tmp_path / "off"))
+    for nm in names:
+        if nm.endswith(".npz"):
+            m1, _, _ = ckpt_lib.load(str(tmp_path / "on" / nm))
+            m2, _, _ = ckpt_lib.load(str(tmp_path / "off" / nm))
+            assert m1["sched"] == m2["sched"], nm
+
+
+def test_span_stream_schema_valid_and_round_attributed(tmp_path):
+    """The device-loop run's spans validate as events and trace_report
+    attributes the ladder's spans to rounds via their own round attrs."""
+    ev = str(tmp_path / "events.jsonl")
+    tele_events.get_bus().configure(jsonl_path=ev)
+    tracing.configure(enabled=True, worker=0)
+    _anneal_run(tmp_path, "run")
+    assert tele_schema.check_file(ev) == []
+    spans = trace_report.load_spans([ev])
+    assert spans
+    # the device-resident path's super-block spans carry their nominal
+    # end round (cadence-aligned blocks: multiples of debugIter=25), and
+    # the checkpoint spans their exact round
+    rounds = {s["_round"] for s in spans if s["phase"] == "local_solve"}
+    assert rounds and all(r % 25 == 0 for r in rounds)
+    assert {s["_round"] for s in spans
+            if s["phase"] == "checkpoint_save"} >= {75, 150}
+    path = trace_report.critical_path(spans)
+    assert path and all(p["critical_s"] > 0 for p in path)
+
+
+# --- trace_report unit -------------------------------------------------------
+
+
+def _synthetic_streams(tmp_path, skew=0.01, rounds=(1, 2)):
+    paths = []
+    for w in (0, 1):
+        tele_events.get_bus().reset()
+        tracing.reset()
+        p = str(tmp_path / f"ev{w}.jsonl")
+        paths.append(p)
+        tele_events.get_bus().configure(jsonl_path=p)
+        tracing.configure(enabled=True, worker=w)
+        for t in rounds:
+            with tracing.span("round", round=t):
+                with tracing.span("kv_allgather"):
+                    time.sleep(0.002 + (skew if w == 1 else 0.0))
+                with tracing.span("local_step"):
+                    time.sleep(0.002)
+    tele_events.get_bus().reset()
+    tracing.reset()
+    return paths
+
+
+def test_trace_report_merge_critical_path_and_stragglers(tmp_path):
+    paths = _synthetic_streams(tmp_path)
+    spans = trace_report.load_spans(paths)
+    assert len(spans) == 12 and len({s["pid"] for s in spans}) == 1
+    # leaf-only attribution: the `round` container never shows up in the
+    # critical path or the straggler table (its children carry the time)
+    cp = trace_report.critical_path(spans)
+    assert [c["round"] for c in cp] == [1, 2]
+    for c in cp:
+        phases = {e["phase"] for e in c["entries"]}
+        assert phases == {"kv_allgather", "local_step"}
+        assert all(e["workers"] == 2 for e in c["entries"])
+        assert c["critical_s"] >= 0.004
+    rows = trace_report.stragglers(spans)
+    assert rows[0]["worker"] == 1 and rows[0]["phase"] == "kv_allgather"
+    assert rows[0]["slack_s"] > 0.01
+    assert {(r["worker"], r["phase"]) for r in rows} == {
+        (0, "kv_allgather"), (0, "local_step"),
+        (1, "kv_allgather"), (1, "local_step")}
+    # the metrics rendering carries both gauges, labeled worker x phase
+    text = trace_report.metrics_text(spans)
+    assert 'cocoa_straggler_slack_seconds{worker="1",' \
+           'phase="kv_allgather"}' in text
+    assert 'cocoa_phase_seconds{worker="0",phase="local_step"}' in text
+
+
+def test_trace_report_chrome_trace_valid_and_checker_has_teeth(tmp_path):
+    paths = _synthetic_streams(tmp_path, rounds=(1,))
+    spans = trace_report.load_spans(paths)
+    trace = trace_report.chrome_trace(spans)
+    assert trace_report.check_chrome_trace(trace) == []
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}   # one track per worker
+    assert all(e["dur"] >= 0 and isinstance(e["name"], str) for e in xs)
+    # the checker rejects what Perfetto would reject
+    assert trace_report.check_chrome_trace({"traceEvents": "nope"}) != []
+    assert trace_report.check_chrome_trace(
+        {"traceEvents": [{"ph": "X", "name": "x", "pid": 0, "tid": 0,
+                          "ts": 1.0, "dur": -5.0}]}) != []
+    assert trace_report.check_chrome_trace(
+        {"traceEvents": [{"ph": "Q", "name": "x", "pid": 0, "tid": 0}]}) \
+        != []
+
+
+def test_trace_report_cli_writes_artifacts(tmp_path, capsys):
+    paths = _synthetic_streams(tmp_path, rounds=(1, 2))
+    out = str(tmp_path / "trace.json")
+    prom = str(tmp_path / "straggler.prom")
+    rc = trace_report.main([*paths, f"--trace={out}", f"--metrics={prom}"])
+    assert rc == 0
+    trace = json.load(open(out))
+    assert trace_report.check_chrome_trace(trace) == []
+    assert "cocoa_straggler_slack_seconds" in open(prom).read()
+    assert "critical path" in capsys.readouterr().out
+    # no spans -> exit 1; usage -> exit 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert trace_report.main([str(empty)]) == 1
+    assert trace_report.main([]) == 2
+    assert trace_report.main(["--bogus"]) == 2
+
+
+# --- events rotation ---------------------------------------------------------
+
+
+def test_events_rotation_size_cap_and_typed_event(tmp_path):
+    ev = str(tmp_path / "events.jsonl")
+    bus = tele_events.get_bus()
+    bus.configure(jsonl_path=ev, max_bytes=2048)
+    for i in range(60):
+        bus.emit("host_transfer", label="x" * 40)
+    assert os.path.exists(ev + ".1"), "the cap must have rotated"
+    assert os.path.getsize(ev + ".1") <= 4096
+    head = json.loads(open(ev).readline())
+    assert head["event"] == "events_rotate"       # first line of the
+    assert head["rotated_to"] == ev + ".1"        # fresh file
+    assert head["bytes"] >= 2048
+    assert tele_schema.check_file(ev) == []
+    assert tele_schema.check_file(ev + ".1") == []
+    # rotation keeps exactly one predecessor (~2x the cap on disk, total)
+    assert not os.path.exists(ev + ".2")
+
+
+# --- metrics debounce + phase gauge ------------------------------------------
+
+
+def _eval_event(t, ts):
+    return {"event": "round_eval", "seq": t, "ts": ts, "algorithm": "X",
+            "t": t, "primal": 1.0, "gap": 0.5, "test_error": None,
+            "sigma": None, "stall": None}
+
+
+def test_metrics_debounce_coalesces_and_flushes(tmp_path, monkeypatch):
+    import cocoa_tpu.telemetry.metrics as metrics_mod
+
+    writes = []
+    real_replace = os.replace
+
+    def counting_replace(a, b):
+        writes.append(b)
+        return real_replace(a, b)
+
+    monkeypatch.setattr(metrics_mod.os, "replace", counting_replace)
+    w = MetricsWriter(str(tmp_path / "m.prom"), flush_interval_s=30.0)
+    base = len(writes)                  # the __init__ write
+    for t in range(1, 21):
+        w(_eval_event(t, float(t)))
+    # one immediate write (interval elapsed since _last_write=0 epoch is
+    # false: first event within interval of init write) — all 20 events
+    # coalesce to at most one rewrite
+    assert len(writes) - base <= 1
+    w.flush()
+    text = open(tmp_path / "m.prom").read()
+    assert "cocoa_evals_total 20" in text  # the trailing flush converged
+    # terminal events bypass the debounce
+    before = len(writes)
+    w({"event": "run_end", "seq": 99, "ts": 99.0, "algorithm": "X",
+       "primal": 1.0, "stopped": "target"})
+    assert len(writes) == before + 1
+
+
+def test_metrics_default_interval_unchanged(tmp_path, monkeypatch):
+    """flush_interval_s=0 (the default) keeps the original one-rewrite-
+    per-event behavior — nothing changes for existing consumers."""
+    import cocoa_tpu.telemetry.metrics as metrics_mod
+
+    writes = []
+    real_replace = os.replace
+    monkeypatch.setattr(
+        metrics_mod.os, "replace",
+        lambda a, b: (writes.append(b), real_replace(a, b))[1])
+    w = MetricsWriter(str(tmp_path / "m.prom"))
+    base = len(writes)
+    for t in range(1, 6):
+        w(_eval_event(t, float(t)))
+    assert len(writes) - base == 5
+
+
+def test_metrics_phase_seconds_gauge(tmp_path):
+    path = str(tmp_path / "m.prom")
+    w = MetricsWriter(path)
+    for ph, d in (("eval", 0.25), ("local_solve", 1.0), ("eval", 0.25)):
+        w({"event": "span", "seq": 1, "ts": 1.0, "phase": ph,
+           "span_id": 1, "parent_id": None, "worker": 0,
+           "start_ts": 1.0, "dur_s": d})
+    text = open(path).read()
+    assert 'cocoa_phase_seconds{phase="eval"} 0.5' in text
+    assert 'cocoa_phase_seconds{phase="local_solve"} 1.0' in text
+    # the supervisor's gang-families sibling never renders phase seconds
+    # (it would duplicate the worker's family for textfile collectors)
+    g = MetricsWriter(str(tmp_path / "m.gang"), families="gang")
+    g({"event": "span", "seq": 1, "ts": 1.0, "phase": "eval",
+       "span_id": 1, "parent_id": None, "worker": None,
+       "start_ts": 1.0, "dur_s": 1.0})
+    assert "cocoa_phase_seconds" not in open(tmp_path / "m.gang").read()
+
+
+# --- flight recorder ---------------------------------------------------------
+
+
+def test_recorder_ring_bounded_and_divergence_dump(tmp_path):
+    ev = str(tmp_path / "events.jsonl")
+    bus = tele_events.get_bus()
+    bus.configure(jsonl_path=ev)
+    rec = tele_recorder.install(bus, ev, capacity=16, signals=False)
+    for i in range(50):
+        bus.emit("host_transfer", label=f"t{i}")
+    assert len(rec.ring) == 16           # bounded
+    bus.emit("divergence", algorithm="X", t=100, n_evals=12)
+    assert rec.dumps and rec.dumps[-1][0] == "divergence"
+    path = ev + ".flightrec"
+    assert tele_schema.check_file(path) == []
+    lines = [json.loads(ln) for ln in open(path)]
+    man = lines[0]["flightrec_manifest"]
+    assert man["reason"] == "divergence" and man["n_events"] == 16
+    assert lines[-1]["event"] == "divergence"   # the trigger is on the ring
+    assert lines[1]["label"] == "t35"           # oldest retained = 50-15
+
+
+def test_recorder_dump_victim_tails_stream(tmp_path):
+    # synthesize a dead worker-1 stream, as the per-process convention
+    # lays it out, then dump on its behalf like the supervisor does
+    base = str(tmp_path / "events.jsonl")
+    stream = tele_recorder.worker_stream_path(base, 1)
+    assert stream == base + ".p1"
+    with open(stream, "w") as f:
+        for t in range(1, 31):
+            f.write(json.dumps(
+                {"event": "checkpoint_write", "seq": t, "pid": 4242,
+                 "ts": float(t), "algorithm": "Toy", "round": t,
+                 "path": "x"}) + "\n")
+        f.write('{"event": "span", "seq": 31, "pid": 4242, "ts": 31.0, '
+                '"phase": "round", "span_id"')   # torn final line (kill)
+    out = tele_recorder.dump_victim(base, 1, "worker_died", exit_code=-9,
+                                    generation=2, last_n=10)
+    assert out == stream + ".flightrec"
+    assert tele_schema.check_file(out) == []
+    lines = [json.loads(ln) for ln in open(out)]
+    man = lines[0]["flightrec_manifest"]
+    assert man["reason"] == "worker_died" and man["exit_code"] == -9
+    assert man["victim_index"] == 1 and man["generation"] == 2
+    assert len(lines) == 11 and lines[-1]["round"] == 30
+    # a worker that left no stream yields no dump (and no exception)
+    assert tele_recorder.dump_victim(base, 7, "worker_died") is None
+
+
+def test_recorder_sigterm_dump_real_process(tmp_path):
+    """A real subprocess with the recorder installed dies by SIGTERM and
+    leaves a validated dump with reason 'sigterm' — and still dies with
+    the termination status its supervisor expects."""
+    ev = str(tmp_path / "events.jsonl")
+    code = f"""
+import os, signal
+from cocoa_tpu.telemetry import events, recorder
+bus = events.get_bus()
+bus.configure(jsonl_path={ev!r})
+rec = recorder.install(bus, {ev!r})
+for i in range(5):
+    bus.emit("host_transfer", label=f"t{{i}}")
+os.kill(os.getpid(), signal.SIGTERM)
+"""
+    proc = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == -signal.SIGTERM
+    path = ev + ".flightrec"
+    assert tele_schema.check_file(path) == []
+    man = json.loads(open(path).readline())["flightrec_manifest"]
+    assert man["reason"] == "sigterm" and man["n_events"] == 5
+
+
+def test_recorder_sigterm_honors_sig_ign(tmp_path):
+    """A process that deliberately ignored SIGTERM before the recorder
+    installed must still dump — and still survive the signal (the
+    handler honors the previous SIG_IGN disposition)."""
+    ev = str(tmp_path / "events.jsonl")
+    code = f"""
+import os, signal
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+from cocoa_tpu.telemetry import events, recorder
+bus = events.get_bus()
+bus.configure(jsonl_path={ev!r})
+rec = recorder.install(bus, {ev!r})
+bus.emit("host_transfer", label="x")
+os.kill(os.getpid(), signal.SIGTERM)
+print("survived")
+"""
+    proc = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0 and "survived" in proc.stdout
+    man = json.loads(open(ev + ".flightrec").readline())
+    assert man["flightrec_manifest"]["reason"] == "sigterm"
+
+
+def test_flightrec_schema_rejects_malformed(tmp_path):
+    bad = tmp_path / "bad.flightrec"
+    bad.write_text(json.dumps({"flightrec_manifest": {"reason": "x"}})
+                   + "\n" + json.dumps({"event": "nonsense", "seq": 1,
+                                        "ts": 1.0}) + "\n")
+    errs = tele_schema.check_file(str(bad))
+    assert any("n_events" in e for e in errs)
+    assert any("nonsense" in e for e in errs)
+
+
+# --- CLI flag surface --------------------------------------------------------
+
+
+def test_cli_flag_validation(tmp_path, capsys):
+    from cocoa_tpu import cli
+
+    base = [f"--trainFile={ROOT}/data/small_train.dat",
+            "--numFeatures=9947", "--numSplits=4", "--numRounds=2",
+            "--debugIter=2", "--localIterFrac=0.1", "--quiet"]
+    assert cli.main([*base, "--trace"]) == 2            # no sink
+    assert cli.main([*base, "--flightRecorder=on"]) == 2  # needs events
+    assert cli.main([*base, "--flightRecorder=maybe",
+                     f"--events={tmp_path}/e.jsonl"]) == 2
+    assert cli.main([*base, "--eventsMaxMB=0",
+                     f"--events={tmp_path}/e.jsonl"]) == 2
+    assert cli.main([*base, "--eventsMaxMB=4"]) == 2    # needs events
+    assert cli.main([*base, "--metricsInterval=1"]) == 2  # needs metrics
+    assert cli.main([*base, "--metricsInterval=-1",
+                     f"--metrics={tmp_path}/m.prom"]) == 2
+    capsys.readouterr()
+
+
+# --- real-process gang: span streams merge + straggler attribution -----------
+
+
+def _gang_env(monkeypatch):
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        f"{ROOT}{os.pathsep}{TESTS}{os.pathsep}"
+        f"{os.environ.get('PYTHONPATH', '')}")
+    monkeypatch.setenv("XLA_FLAGS", " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f))
+
+
+@pytest.mark.slow
+def test_gang_trace_report_merges_and_names_the_straggler(tmp_path,
+                                                          monkeypatch):
+    """THE tracing acceptance pin: a REAL 2-process gang (toy worker:
+    real rendezvous, per-round KV allgather, checkpoints) run with
+    --trace leaves one span stream per process; trace_report merges them
+    into a schema-valid Perfetto trace with a nonempty per-round
+    critical path, and the straggler table's top row names the
+    deliberately-skewed worker 1 × local_step."""
+    _gang_env(monkeypatch)
+    ck = tmp_path / "ck"
+    ev = str(tmp_path / "events.jsonl")
+    rc = elastic.supervise(
+        [f"--chkptDir={ck}", "--numSplits=4", "--numRounds=8",
+         "--chkptIter=4", "--stepSeconds=0.02", "--stepSkew=0.05",
+         f"--events={ev}", "--trace"],
+        2, module="_gang_worker", max_restarts=0, poll_s=0.05,
+        backoff_base_s=0.0)
+    assert rc == 0
+    streams = [ev, ev + ".p1"]
+    for s in streams:
+        assert os.path.exists(s), s
+        assert tele_schema.check_file(s) == []
+    spans = trace_report.load_spans(streams)
+    workers = {trace_report.worker_of(s) for s in spans}
+    assert workers == {0, 1}
+
+    trace = trace_report.chrome_trace(spans)
+    assert trace_report.check_chrome_trace(trace) == []
+
+    path = trace_report.critical_path(spans)
+    assert [p["round"] for p in path] == list(range(1, 9))
+    assert all(p["critical_s"] > 0 for p in path)
+    # both workers reported the per-round phases the path is built from
+    for p in path:
+        by_phase = {e["phase"]: e for e in p["entries"]}
+        assert by_phase["local_step"]["workers"] == 2
+        assert by_phase["kv_get"]["workers"] == 2
+
+    rows = trace_report.stragglers(spans)
+    assert rows, "straggler table must be nonempty"
+    top = rows[0]
+    # worker 1 sleeps 50ms longer per round — 8 rounds of ~50ms slack
+    assert top["worker"] == 1 and top["phase"] == "local_step"
+    assert top["slack_s"] > 0.2
+
+
+@pytest.mark.slow
+def test_gang_metrics_ownership_worker0_vs_supervisor_gang_file(
+        tmp_path, monkeypatch):
+    """The PR-9 sibling-file contract under a REAL gang, now pinned:
+    worker 0 owns `<metrics>` (worker families only — no gang series),
+    the supervisor owns `<metrics>.gang` (gang families only), so a
+    textfile collector globbing the directory never sees a duplicated
+    family."""
+    _gang_env(monkeypatch)
+    ck = tmp_path / "ck"
+    metrics = str(tmp_path / "metrics.prom")
+    bus = tele_events.get_bus()
+    bus.configure(jsonl_path=str(tmp_path / "events.jsonl"))
+    bus.subscribe(MetricsWriter(metrics + ".gang", families="gang"))
+    rc = elastic.supervise(
+        [f"--chkptDir={ck}", "--numSplits=4", "--numRounds=6",
+         "--chkptIter=3", "--stepSeconds=0.02",
+         f"--events={tmp_path / 'events.jsonl'}",
+         f"--metrics={metrics}"],
+        2, module="_gang_worker", max_restarts=0, poll_s=0.05,
+        backoff_base_s=0.0)
+    assert rc == 0
+    worker_text = open(metrics).read()
+    gang_text = open(metrics + ".gang").read()
+
+    def families(text):
+        return {line.split(" ", 1)[0].split("{", 1)[0]
+                for line in text.splitlines()
+                if line and not line.startswith("#")}
+
+    wf, gf = families(worker_text), families(gang_text)
+    # worker 0 saw its own checkpoint_write events (chkptIter=3)
+    assert "cocoa_rounds_total" in wf and "cocoa_evals_total" in wf
+    # strictly disjoint families across the sibling files
+    assert wf & gf == set(), (wf, gf)
+    assert gf == {"cocoa_gang_generations_total"}  # healthy run: no
+    #                                              # resize/backoff gauges
+    for name in ("cocoa_gang_size", "cocoa_gang_generations_total",
+                 "cocoa_restart_backoff_seconds"):
+        assert name not in wf
