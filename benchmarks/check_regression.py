@@ -77,7 +77,47 @@ GATES = (
             "--rng=permuted", "--justCoCoA=true", "--quiet",
         ],
     },
+    # The round-barrier levers (ISSUE 12, docs/DESIGN.md §15): a REAL
+    # 2-process host-exchange CoCoA+ gang (tests/_gang_worker.py
+    # --real=cocoa), synchronous control vs --overlapComm=on
+    # --staleRounds=1.  Round counts are fully deterministic here —
+    # round-keyed sampling AND round-indexed join windows — so the
+    # committed baselines are exact; the tolerance only absorbs future
+    # deliberate solver changes.  sleeps are zero: the gate guards the
+    # comm-ROUND axis, wall-clock belongs to the slow A/B test.
+    {
+        "config": "gang-cocoa+sync",
+        "algorithm": "GangCoCoA+",
+        "gap_target": 1e-4,
+        "rounds_tol": 0.15,
+        "runner": "gang",
+        "flags": [
+            "--real=cocoa", "--numSplits=2", "--numRounds=400",
+            "--debugIter=5", "--gapTarget=1e-4", "--lambda=0.01",
+            "--rowsPerShard=64", "--numFeatures=32", "--localIters=16",
+            "--overlapComm=off", "--staleRounds=0",
+        ],
+    },
+    {
+        "config": "gang-cocoa+overlap-stale1",
+        "algorithm": "GangCoCoA+",
+        "gap_target": 1e-4,
+        "rounds_tol": 0.15,
+        "runner": "gang",
+        "flags": [
+            "--real=cocoa", "--numSplits=2", "--numRounds=400",
+            "--debugIter=5", "--gapTarget=1e-4", "--lambda=0.01",
+            "--rowsPerShard=64", "--numFeatures=32", "--localIters=16",
+            "--overlapComm=on", "--staleRounds=1",
+        ],
+    },
 )
+
+# bounded-staleness round overhead vs the synchronous control (the
+# ISSUE-12 acceptance bar): the stale gang config may use at most this
+# multiple of the sync gang config's fresh rounds
+STALE_ROUNDS_RATIO = 1.25
+_GANG_PAIR = ("gang-cocoa+sync", "gang-cocoa+overlap-stale1")
 
 
 def committed_baselines(path: str = RESULTS) -> dict:
@@ -141,6 +181,79 @@ def run_fresh(gate: dict, workdir: str) -> dict:
             TypeError) as e:
         return {"config": gate["config"], "error":
                 f"{type(e).__name__}: {e}"}
+
+
+def run_fresh_gang(gate: dict, workdir: str) -> dict:
+    """One fresh 2-process host-exchange gang run (tests/_gang_worker.py
+    --real=cocoa) under the in-process elastic supervisor; the fresh
+    rounds/gap come from the worker-0 events stream.  Same never-raises
+    contract as :func:`run_fresh`."""
+    # the gang workers need the repo + tests/ importable, and must not
+    # inherit a virtual-device XLA flag (they use no devices).  The
+    # supervisor spawns them with the AMBIENT environment, so the tweaks
+    # go through os.environ — saved and restored, so later gates (and
+    # the caller) see the environment they started with.
+    saved = {k: os.environ.get(k)
+             for k in ("PYTHONPATH", "XLA_FLAGS", "JAX_PLATFORMS")}
+    try:
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            p for p in (ROOT, os.path.join(ROOT, "tests"),
+                        os.environ.get("PYTHONPATH", "")) if p)
+        os.environ["XLA_FLAGS"] = " ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        tests_dir = os.path.join(ROOT, "tests")
+        if tests_dir not in sys.path:
+            sys.path.insert(0, tests_dir)
+        from _gang_worker import supervise_gang  # the shared launch contract
+
+        ev = os.path.join(workdir,
+                          gate["config"].replace("/", "_") + ".jsonl")
+        rc, records = supervise_gang(gate["flags"], events=ev)
+        if rc != 0:
+            return {"config": gate["config"],
+                    "error": f"gang exited {rc}"}
+        evals = [r for r in records if r.get("event") == "round_eval"]
+        end = next((r for r in reversed(records)
+                    if r.get("event") == "run_end"), None)
+        if not evals or end is None:
+            return {"config": gate["config"],
+                    "error": f"events stream {ev} carries no run"}
+        return {
+            "config": gate["config"],
+            "rounds": int(evals[-1]["t"]),
+            "gap": float(evals[-1]["gap"]),
+            "stopped": end.get("stopped"),
+            "gap_target": gate["gap_target"],
+            "type": "bench-regression-fresh",
+        }
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return {"config": gate["config"],
+                "error": f"{type(e).__name__}: {e}"}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def gang_ratio_failures(rows: list) -> list:
+    """The cross-config staleness bound: overlap+stale rounds <=
+    STALE_ROUNDS_RATIO x sync rounds (evaluated only when both gang
+    rows ran cleanly — a per-config error already failed the gate)."""
+    by_cfg = {r.get("config"): r for r in rows if "error" not in r}
+    sync, stale = (by_cfg.get(c) for c in _GANG_PAIR)
+    if not sync or not stale:
+        return []
+    bound = int(sync["rounds"] * STALE_ROUNDS_RATIO)
+    if stale["rounds"] > bound:
+        return [f"{_GANG_PAIR[1]}: STALENESS OVERHEAD — "
+                f"{stale['rounds']} rounds vs the synchronous control's "
+                f"{sync['rounds']} (bound {STALE_ROUNDS_RATIO}x = "
+                f"{bound}); the bounded-staleness trajectory regressed"]
+    return []
 
 
 def evaluate(gate: dict, fresh: dict, committed: dict) -> list:
@@ -212,6 +325,10 @@ def main(argv=None) -> int:
                      "stopped": row.get("stopped", "target")}
             rows.append({**fresh, "type": "bench-regression-fresh"})
             failures += evaluate(gate, fresh, committed)
+        # the cross-row staleness bound applies to artifact-checked rows
+        # exactly like fresh runs — an overhead regression must not ride
+        # in through --fresh mode
+        failures += gang_ratio_failures(rows)
     else:
         workdir = tempfile.mkdtemp(prefix="bench-regress-")
         for gate in gates:
@@ -219,9 +336,12 @@ def main(argv=None) -> int:
                   f"(committed baseline "
                   f"{committed.get(gate['config'], {}).get('rounds')} "
                   f"rounds)", flush=True)
-            fresh = run_fresh(gate, workdir)
+            runner = (run_fresh_gang if gate.get("runner") == "gang"
+                      else run_fresh)
+            fresh = runner(gate, workdir)
             rows.append(fresh)
             failures += evaluate(gate, fresh, committed)
+        failures += gang_ratio_failures(rows)
 
     if report_path:
         with open(report_path, "w") as f:

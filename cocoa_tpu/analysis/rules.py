@@ -40,6 +40,17 @@ Rules (ids are what ``jaxlint: allow=<rule>`` and the baseline key on):
   must never read traced values (emitting one materializes the array on
   the host: a silent device sync).  Rides the host-sync rule's
   traced-context machinery.
+- ``overlap-hygiene`` — the overlapped-exchange contract
+  (parallel/distributed.py, docs/DESIGN.md §15): launching an async
+  exchange (``async_host_allgather_bytes`` / ``async_kv_get``) inside
+  traced code is an error (a traced value escaping into the collector
+  thread races the dispatch that produces it — the runtime twin is
+  ``_require_host_bytes``), and an exchange handle that is never
+  ``.join()``ed — and never escapes the function (returned, stored, or
+  passed on, e.g. into a ``StaleJoinWindow``) — is an error: its
+  payload is unsynchronized with every dispatch it crosses, and its
+  bounded-KV budget leaks onto a daemon thread nobody will ever
+  account.  Rides the host-sync rule's traced-context machinery.
 """
 
 from __future__ import annotations
@@ -842,10 +853,114 @@ def check_span_hygiene(src: SourceFile, index: ModuleIndex) -> list:
     return findings
 
 
+# --- rule: overlap-hygiene ---------------------------------------------------
+
+# the async-exchange surface (parallel/distributed.py)
+_EXCHANGE_CALLEES = {"async_host_allgather_bytes", "async_kv_get"}
+
+
+def check_overlap_hygiene(src: SourceFile, index: ModuleIndex) -> list:
+    """The overlapped-exchange contract (see the module docstring):
+
+    1. launching an async exchange inside traced code is an error —
+       traced values must not escape into the collector thread (the
+       runtime twin is ``distributed._require_host_bytes``, which only
+       accepts host bytes; this catches the shape statically, before a
+       run ever reaches it);
+    2. a handle bound to a local name that is never ``.join()``ed and
+       never escapes (returned/yielded, passed to a call — e.g. a
+       ``StaleJoinWindow.admit`` — stored into a container/attribute/
+       subscript, or re-exported) is an error: the exchange's payload
+       is then read by nobody and synchronized with nothing, so any
+       super-block dispatch it crosses runs against an un-joined
+       exchange."""
+    findings = []
+    traced = index.traced_defs()
+    parents = _build_parents(src.tree)
+
+    def flag(node, msg):
+        findings.append(Finding(
+            rule="overlap-hygiene", severity="error", path=src.path,
+            line=node.lineno, col=node.col_offset, message=msg))
+
+    # (1) async launch inside traced code
+    for d in index.defs:
+        if id(d) not in traced:
+            continue
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if _nearest_def(node, parents) is not d:
+                    continue
+                if isinstance(node, ast.Call) and \
+                        _callee_tail(node) in _EXCHANGE_CALLEES:
+                    flag(node,
+                         f"`{_callee_tail(node)}` inside traced code — "
+                         f"traced values must not escape into the "
+                         f"exchange thread (the collector would race the "
+                         f"dispatch producing them); launch the exchange "
+                         f"at the host boundary and pass host bytes "
+                         f"(np.asarray(x).tobytes())")
+
+    # (2) handles that are never joined and never escape, per scope
+    scopes = [src.tree] + list(index.defs)
+    for scope in scopes:
+        if scope is not src.tree and id(scope) in traced:
+            continue  # already flagged wholesale by (1)
+        body = scope.body if isinstance(getattr(scope, "body", None), list) \
+            else [scope.body] if hasattr(scope, "body") else []
+        handles: dict = {}   # name -> the Assign node that bound it
+        uses: dict = {}      # name -> [non-binding Name mentions]
+        joined: set = set()
+        for stmt in body:
+            for node in ast.walk(stmt):
+                nd = _nearest_def(node, parents)
+                at_scope = (nd is scope or (scope is src.tree
+                                            and nd is None))
+                if not at_scope:
+                    continue
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call) \
+                        and _callee_tail(node.value) in _EXCHANGE_CALLEES:
+                    handles[node.targets[0].id] = node
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "join" \
+                        and isinstance(node.func.value, ast.Name):
+                    joined.add(node.func.value.id)
+        if not handles:
+            continue
+        # any OTHER mention of the name (beyond its binding target and
+        # the .join receiver) counts as an escape — conservatively: a
+        # handle handed to anyone else is their responsibility to join
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Name) or \
+                        not isinstance(node.ctx, ast.Load):
+                    continue
+                if node.id not in handles:
+                    continue
+                p = parents.get(node)
+                if isinstance(p, ast.Attribute) and p.attr == "join":
+                    continue
+                uses.setdefault(node.id, []).append(node)
+        for name, assign in handles.items():
+            if name in joined or uses.get(name):
+                continue
+            flag(assign,
+                 f"exchange handle `{name}` is never joined and never "
+                 f"escapes this scope — its payload is read by nobody "
+                 f"and any super-block dispatch it crosses runs against "
+                 f"an un-joined exchange; call `{name}.join()` at the "
+                 f"barrier (or hand it to a StaleJoinWindow)")
+    return findings
+
+
 # --- registry ---------------------------------------------------------------
 
 RULES = ("donation", "host-sync", "f64", "mesh-api", "pallas-budget",
-         "span-hygiene")
+         "span-hygiene", "overlap-hygiene")
 
 
 def run_static_rules(sources: dict) -> list:
@@ -859,4 +974,5 @@ def run_static_rules(sources: dict) -> list:
         findings += check_mesh_api(src, index)
         findings += check_pallas_budget_ast(src, index, sources)
         findings += check_span_hygiene(src, index)
+        findings += check_overlap_hygiene(src, index)
     return findings

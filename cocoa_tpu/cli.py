@@ -135,7 +135,8 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "elastic", "stallTimeout", "evalDense", "hotCols",
                 "ingest", "metrics", "events", "quiet",
                 "trace", "flightRecorder", "eventsMaxMB",
-                "metricsInterval")  # run-level
+                "metricsInterval", "overlapComm",
+                "staleRounds")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -280,6 +281,49 @@ def main(argv=None) -> int:
         if not extras["metrics"]:
             print("error: --metricsInterval debounces the --metrics "
                   "textfile and needs --metrics", file=sys.stderr)
+            return 2
+
+    # --overlapComm: the round-barrier levers (docs/DESIGN.md §15).  On
+    # this compiled-collective CLI path the in-round Δw aggregation is a
+    # fused psum — already as overlapped as XLA schedules it — so the
+    # flag's CLI consumer is the host-side IO at super-block boundaries:
+    # checkpoint writes ride a writer thread concurrent with the next
+    # dispatch (solvers/base.drive_device_full).  auto = on for
+    # single-process runs (a multi-process save allgathers alpha — a
+    # collective that must not race a training dispatch); off (default)
+    # is bit-identical to pre-flag behavior by construction.
+    overlap_flag = (extras["overlapComm"] or "off").lower()
+    if overlap_flag == "true":
+        overlap_flag = "on"   # bare --overlapComm
+    if overlap_flag not in ("auto", "on", "off"):
+        print(f"error: --overlapComm must be auto|on|off, got "
+              f"{extras['overlapComm']!r}", file=sys.stderr)
+        return 2
+    # --staleRounds=S: bounded-staleness CoCoA+ aggregation — a round-r
+    # contribution may join up to S rounds late under the safe-γ rule
+    # (solvers/cocoa.StaleJoinWindow, docs/DESIGN.md §15).  S=0 (the
+    # default) is today's synchronous barrier.  S>0 needs the HOST-side
+    # exchange aggregation path (the gang harness, tests/_gang_worker.py
+    # --real=cocoa); this CLI path aggregates inside the compiled
+    # collective, where a round cannot be split — reject loudly instead
+    # of accepting a flag that silently does nothing.
+    stale_rounds = 0
+    if extras["staleRounds"] is not None:
+        try:
+            stale_rounds = int(extras["staleRounds"])
+        except ValueError:
+            stale_rounds = -1
+        if stale_rounds < 0:
+            print(f"error: --staleRounds takes an integer >= 0, got "
+                  f"{extras['staleRounds']!r}", file=sys.stderr)
+            return 2
+        if stale_rounds > 0:
+            print("error: --staleRounds > 0 rides the host-exchange "
+                  "aggregation path (the chaos gang harness, "
+                  "tests/_gang_worker.py --real=cocoa); this CLI path "
+                  "aggregates Δw inside the compiled collective, which "
+                  "is synchronous by construction — drop the flag "
+                  "(docs/DESIGN.md §15)", file=sys.stderr)
             return 2
 
     # --profile=DIR traces the whole run; --profile=DIR,START,STOP traces
@@ -1209,12 +1253,34 @@ def main(argv=None) -> int:
     common = dict(mesh=mesh, test_ds=test_ds, rng=cfg.rng,
                   sampling=cfg.sampling, quiet=quiet)
 
+    # resolve --overlapComm for this process: the checkpoint-write
+    # overlap engages only where it is race-free — single process (the
+    # multi-process save's alpha allgather is a collective that must not
+    # run concurrently with a training dispatch)
+    overlap_io = False
+    if overlap_flag in ("on", "auto"):
+        overlap_io = jax.process_count() == 1 and cfg.device_loop
+        if overlap_flag == "on" and not overlap_io:
+            # the flag must never pass silently inert (the same
+            # loud-behavior principle as the --staleRounds rejection):
+            # say exactly which precondition is missing
+            if jax.process_count() != 1:
+                print("overlapComm: checkpoint-write overlap disabled on "
+                      "the multi-process path (the save's alpha allgather "
+                      "is a collective); exchanges overlap via the gang "
+                      "host-aggregation path instead", file=sys.stderr)
+            else:
+                print("note: --overlapComm's CLI consumer is the "
+                      "device-resident driver's checkpoint-write overlap "
+                      "— pass --deviceLoop (the host-stepped path has no "
+                      "effect to enable)", file=sys.stderr)
+
     cocoa_kw = dict(gap_target=gap_target, scan_chunk=cfg.scan_chunk,
                     math=cfg.math, device_loop=cfg.device_loop,
                     block_size=block_size, block_pipeline=block_pipeline,
                     divergence_guard=guard, sigma_schedule=sigma_schedule,
                     warm_start=warm_start, accel=accel_flag,
-                    theta=theta_flag)
+                    theta=theta_flag, overlap_io=overlap_io)
 
     def run_all():
         w, alpha, traj = run_cocoa(ds, params, debug, plus=True,

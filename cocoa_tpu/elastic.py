@@ -38,6 +38,20 @@ seeded jitter (capped, reset on progress) instead of spinning on the
 relaunch: a crash-looping gang must not hammer a shared coordinator or
 filesystem at poll speed.
 
+**Overlapped/stale exchanges across a resize** (docs/DESIGN.md §15):
+workers running with ``--overlapComm``/``--staleRounds`` may hold
+in-flight exchange handles and a window of pending stale joins when the
+gang dies.  Nothing here needs to unwind them: the collector threads
+are daemons bounded by the KV budget (parallel/distributed.py), so the
+SIGKILL teardown above cannot deadlock on them, and the pending joins
+die with the generation's processes (StaleJoinWindow.abort is the
+in-process spelling of the same rule).  Soundness across the resize
+comes from the checkpoint discipline — the gang path only checkpoints
+at DRAINED boundaries, where every contribution has been applied and
+w = w(α) holds exactly — so the reformed gang resumes from a state
+that embeds no half-joined round (pinned: tests/test_overlap.py
+``test_gang_resize_with_staleness_drops_pending_joins``).
+
 Activated by ``--elastic=N`` (or ``--elastic=N,shrink`` /
 ``--elastic=shrink``) on the CLI: the invoking process becomes the
 supervisor and re-executes its own command line N times with

@@ -18,6 +18,7 @@ the coordinator address.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Optional
 
@@ -171,6 +172,14 @@ def _blocking_kv_get(client, key: str, *, timeout_s: float,
             pause = min(_KV_BACKOFF_CAP_S,
                         _KV_BACKOFF_BASE_S * (2.0 ** (fast_failures - 1)))
             time.sleep(min(pause, remaining))
+        else:
+            # a full-length attempt proves the coordinator is reachable
+            # and listening — the exponential state accumulated from
+            # earlier fast failures (startup noise) must not stretch the
+            # budget by re-applying the CAPPED pause to the next
+            # transient error (the PR-9 pin's slow-attempt corollary,
+            # extended by tests/test_chaos.py)
+            fast_failures = 0
     raise RuntimeError(
         f"KV-store key {key!r}"
         + (f" ({what})" if what else "")
@@ -207,6 +216,14 @@ def host_allgather_bytes(tag: str, payload: bytes,
 
 
 def _host_allgather(client, tag, payload, timeout_s, attempt_s) -> list:
+    _post_chunks(client, tag, payload)
+    return _collect_allgather(client, tag, payload, timeout_s, attempt_s)
+
+
+def _post_chunks(client, tag, payload) -> None:
+    """Publish THIS process's payload under ``tag`` (chunked, base64).
+    Pure non-blocking sets — peers unblock the moment this returns, even
+    if this process never collects the gather itself."""
     import base64
 
     import jax
@@ -218,6 +235,18 @@ def _host_allgather(client, tag, payload, timeout_s, attempt_s) -> list:
         client.key_value_set(f"cocoa/{tag}/{me}/{i}",
                              base64.b64encode(chunk).decode())
     client.key_value_set(f"cocoa/{tag}/{me}/n", str(nchunk))
+
+
+def _collect_allgather(client, tag, payload, timeout_s, attempt_s) -> list:
+    """The blocking half of :func:`_host_allgather`: fetch every PEER's
+    chunks (own payload slots in from the argument).  Runs on the
+    caller's thread for the synchronous path and on the collector daemon
+    for :func:`async_host_allgather_bytes`."""
+    import base64
+
+    import jax
+
+    me = jax.process_index()
     out = []
     for p in range(jax.process_count()):
         if p == me:
@@ -236,3 +265,163 @@ def _host_allgather(client, tag, payload, timeout_s, attempt_s) -> list:
         ]
         out.append(b"".join(parts))
     return out
+
+
+# --- overlapped (asynchronous) exchanges ------------------------------------
+#
+# The synchronous exchanges above serialize against whatever the caller
+# does next: a gang round pays (local solve) + (exchange wait) even
+# though the wait is mostly "listening for the slowest peer".  The async
+# front end below splits one exchange into
+#
+#   post   — this worker's payload is published IMMEDIATELY, on the
+#            caller's thread (cheap non-blocking sets; peers unblock the
+#            moment local work finishes, not when we get around to
+#            collecting), and
+#   collect — the peer gets run on a daemon collector thread, so the
+#            exchange span runs CONCURRENTLY with the caller's next
+#            compute instead of after it,
+#
+# joined by an :class:`ExchangeHandle` at the caller's barrier of
+# choice (solvers/cocoa.StaleJoinWindow picks the round it must land
+# by).  Payloads are HOST BYTES by contract — a jax array (worse, a
+# tracer) crossing into the collector thread would race the dispatch
+# that produced it, so :func:`_require_host_bytes` rejects anything
+# that is not already plain bytes (the runtime half of the jaxlint
+# ``overlap-hygiene`` rule).  Collector threads are daemons and every
+# underlying get is the bounded :func:`blocking_kv_get`, so an
+# abandoned handle (gang teardown, elastic resize) can neither hang
+# process exit nor wait past the KV budget.
+
+
+def _require_host_bytes(payload) -> bytes:
+    """The exchange-thread safety contract: payloads must already be
+    host bytes when the exchange launches.  Device arrays (or traced
+    values) must be materialized on the CALLER's thread —
+    ``np.asarray(x).tobytes()`` — never inside the collector, where the
+    fetch would race the dispatch that produced them."""
+    if isinstance(payload, (bytes, bytearray)):
+        return bytes(payload)
+    raise TypeError(
+        f"exchange payloads must be host bytes, got "
+        f"{type(payload).__name__}: device/traced values must not escape "
+        f"into the exchange thread (jaxlint overlap-hygiene) — convert "
+        f"with np.asarray(x).tobytes() on the caller's thread first"
+    )
+
+
+class ExchangeHandle:
+    """One in-flight asynchronous host-side exchange.
+
+    ``join()`` blocks until the collector finishes (re-raising its
+    error), returns its result, and emits one typed ``comm_overlap``
+    event accounting the overlap:
+
+    - ``hidden_s`` — exchange wall-clock that ran CONCURRENTLY with the
+      caller's own work (launch → min(collector done, join called)):
+      the seconds the overlap actually took off the critical path;
+    - ``wait_s``  — the residual blocking wait inside ``join()``.
+
+    ``done()`` is a non-blocking poll.  Handles are single-join (a
+    second ``join()`` returns the cached result without re-emitting).
+    """
+
+    def __init__(self, tag: str, collect=None, result=None, attrs=None):
+        self.tag = tag
+        self._attrs = dict(attrs or {})
+        self._result = result
+        self._err = None
+        self._joined = False
+        self._t0 = time.monotonic()
+        self._t_done = self._t0 if collect is None else None
+        self._thread = None
+        if collect is not None:
+            self._thread = threading.Thread(
+                target=self._run, args=(collect,), daemon=True,
+                name=f"cocoa-exchange-{tag}")
+            self._thread.start()
+
+    def _run(self, collect):
+        try:
+            self._result = collect()
+        except BaseException as e:  # re-raised at join()
+            self._err = e
+        finally:
+            self._t_done = time.monotonic()
+
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+    def join(self):
+        """Barrier: the collected payloads (or the collector's error)."""
+        if self._joined:
+            if self._err is not None:
+                raise self._err
+            return self._result
+        from cocoa_tpu.telemetry import events as _events
+        from cocoa_tpu.telemetry import tracing as _tracing
+
+        t_join = time.monotonic()
+        with _tracing.span("exchange_join", tag=self.tag, **self._attrs):
+            if self._thread is not None:
+                self._thread.join()
+        self._joined = True
+        t_done = self._t_done if self._t_done is not None else t_join
+        hidden = max(0.0, min(t_done, t_join) - self._t0)
+        wait = max(0.0, t_done - t_join)
+        _events.get_bus().emit("comm_overlap", tag=self.tag,
+                               hidden_s=hidden, wait_s=wait, **self._attrs)
+        if self._err is not None:
+            raise self._err
+        return self._result
+
+
+def async_host_allgather_bytes(tag: str, payload: bytes,
+                               timeout_s: float = KV_TIMEOUT_S,
+                               attempt_s: float = KV_ATTEMPT_S,
+                               trace_attrs: Optional[dict] = None
+                               ) -> ExchangeHandle:
+    """Overlapped :func:`host_allgather_bytes`: post now, collect on a
+    background thread, join at the barrier of the caller's choice.
+
+    This worker's payload is published on the CALLER's thread before
+    this returns (peers can complete their gathers even if this handle
+    is never joined); the peer gets then run concurrently with whatever
+    the caller does next.  ``trace_attrs`` (e.g. ``{"round": t}``) tag
+    the collector's spans so trace_report can attribute the overlapped
+    exchange to its round despite running off the round span's thread.
+    Single-process: an already-done handle carrying ``[payload]``.
+    """
+    from cocoa_tpu.telemetry import tracing as _tracing
+
+    payload = _require_host_bytes(payload)
+    attrs = dict(trace_attrs or {})
+    client = kv_client()
+    if client is None:
+        return ExchangeHandle(tag, result=[payload], attrs=attrs)
+    with _tracing.span("kv_post", tag=tag, bytes=len(payload), **attrs):
+        _post_chunks(client, tag, payload)
+
+    def collect():
+        with _tracing.span("kv_allgather", tag=tag, bytes=len(payload),
+                           overlapped=True, **attrs):
+            return _collect_allgather(client, tag, payload, timeout_s,
+                                      attempt_s)
+
+    return ExchangeHandle(tag, collect=collect, attrs=attrs)
+
+
+def async_kv_get(client, key: str, *, timeout_s: float = KV_TIMEOUT_S,
+                 attempt_s: float = KV_ATTEMPT_S,
+                 what: Optional[str] = None,
+                 trace_attrs: Optional[dict] = None) -> ExchangeHandle:
+    """Overlapped :func:`blocking_kv_get`: the bounded retrying get runs
+    on a collector daemon; ``join()`` returns the value (or raises the
+    bounded, peer-naming error)."""
+    attrs = dict(trace_attrs or {})
+
+    def collect():
+        return blocking_kv_get(client, key, timeout_s=timeout_s,
+                               attempt_s=attempt_s, what=what)
+
+    return ExchangeHandle(f"get:{key}", collect=collect, attrs=attrs)

@@ -1146,6 +1146,7 @@ def drive_device_full(
     divergence_guard: bool = True,
     sigma_levels: Optional[tuple] = None,
     accel: Optional["AccelConfig"] = None,
+    overlap_io: bool = False,
 ):
     """Cadence-aligned wrapper around :func:`drive_on_device`, usable by any
     solver whose round has the (state, idxs, shards) shape: host-steps the
@@ -1179,16 +1180,55 @@ def drive_device_full(
     # least every ceil(chkptIter / debugIter) chunks.
     ckpt_on = bool(debug.chkpt_dir) and debug.chkpt_iter > 0
     last_saved = start_round - 1
+    # --overlapComm on the device-resident path: the checkpoint WRITE —
+    # the one host-side exchange this driver performs at super-block
+    # boundaries — rides a daemon thread so its serialization + disk IO
+    # overlaps the NEXT super-block's dispatch (and the index-table
+    # prefetch already running alongside it) instead of extending the
+    # boundary.  The state snapshot happens synchronously on THIS thread
+    # as an OWNED host copy (a zero-copy view would alias the device
+    # buffer the next dispatch donates — the same
+    # nothing-shared-crosses-the-thread contract as
+    # distributed._require_host_bytes), so the written bytes are
+    # bit-identical to a synchronous save; only the write's timing
+    # moves.  One write in flight at a time; the final join below makes
+    # the function's completion imply every checkpoint landed.  Gated to
+    # single-process runs by the callers: ckpt_lib.save's alpha
+    # allgather is a collective that must not race a training dispatch.
+    pending_io: list = []
+
+    def _join_io():
+        while pending_io:
+            pending_io.pop().result()
 
     def maybe_ckpt(done_round):
         nonlocal last_saved
         if ckpt_on and done_round - last_saved >= debug.chkpt_iter:
-            ckpt_lib.save(
-                debug.chkpt_dir, name, done_round, state[0],
-                state[1] if len(state) > 1 else None, seed=debug.seed,
+            args = (debug.chkpt_dir, name, done_round, state[0],
+                    state[1] if len(state) > 1 else None)
+            kwargs = dict(
+                seed=debug.seed,
                 sched=state[-1] if len(state) > 2 else None,
                 hist=state[2] if len(state) > 3 else None,
             )
+            if overlap_io:
+                _join_io()
+                # copy=True is load-bearing: np.asarray of a CPU jax
+                # array is a zero-copy VIEW of the device buffer, and
+                # the very next dispatch DONATES that buffer — the
+                # writer thread must serialize an owned snapshot, not a
+                # view of memory the run is about to reuse
+                args = tuple(np.array(a, copy=True) if a is not None
+                             and not isinstance(a, (str, int)) else a
+                             for a in args)
+                kwargs = {k2: (np.array(v, copy=True)
+                               if k2 in ("sched", "hist")
+                               and v is not None else v)
+                          for k2, v in kwargs.items()}
+                pending_io.append(_Prefetch(
+                    lambda a, kw: ckpt_lib.save(*a, **kw), args, kwargs))
+            else:
+                ckpt_lib.save(*args, **kwargs)
             last_saved = done_round
 
     def hit_target():
@@ -1365,6 +1405,9 @@ def drive_device_full(
                            round=params.num_rounds, t0=t, rounds=rem):
             state = chunk_fn(t, rem, state)
         maybe_ckpt(params.num_rounds)
+    # every overlapped checkpoint write must have LANDED before this
+    # driver reports done (a caller may read/validate the files next)
+    _join_io()
     return state, traj
 
 
@@ -1584,6 +1627,7 @@ def drive_device_paths(
     divergence_guard: bool = True,
     sigma_levels: Optional[tuple] = None,
     accel: Optional["AccelConfig"] = None,
+    overlap_io: bool = False,
 ):
     """The scan_chunk / device_loop dispatch shared by every solver: builds
     the fused eval kernel (dual state iff ``alpha_in_state``; overridable
@@ -1613,6 +1657,7 @@ def drive_device_paths(
             else (*cache_key, test_n, divergence_guard),
             mesh=mesh, divergence_guard=divergence_guard,
             sigma_levels=sigma_levels, accel=accel,
+            overlap_io=overlap_io,
         )
     return drive_chunked(
         name, params, debug, state, chunk_fn, eval_fn, quiet=quiet,

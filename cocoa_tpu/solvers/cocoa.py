@@ -379,6 +379,7 @@ def run_sdca_family(
     accel: bool = False,
     theta: str = "fixed",
     hist_init=None,
+    overlap_io: bool = False,
 ):
     """Shared driver for the SDCA-family algorithms (CoCoA, CoCoA+,
     mini-batch CD — they differ only in their ``alg`` scaling triple, see
@@ -452,6 +453,13 @@ def run_sdca_family(
     the kernel.  Bit-identical to the serial schedule
     (local_sdca_block_batched; parity pinned by tests/test_block.py);
     ``False`` is the A/B control benchmarks/kernels.py measures against.
+
+    ``overlap_io=True`` (flag ``--overlapComm``, single-process runs
+    only — resolved by the CLI): checkpoint WRITES on the device-loop
+    path ride a daemon writer thread so their serialization + disk IO
+    overlaps the next super-block's dispatch (base.drive_device_full);
+    the state snapshot stays synchronous, so the written bytes are
+    bit-identical to a synchronous save.
 
     ``divergence_guard`` ("auto" | "on" | "off", flag --divergenceGuard)
     controls the gap-target stall watch: auto arms it only when σ′ is
@@ -946,6 +954,7 @@ def run_sdca_family(
             device_loop=device_loop, cache_key=cache_key,
             eval_kernel=eval_kernel, divergence_guard=guard_on,
             sigma_levels=levels, accel=accel_cfg,
+            overlap_io=overlap_io,
         )
         return state[0], state[1], traj
 
@@ -1186,6 +1195,185 @@ def run_cocoa(
         ds, params, debug, "CoCoA+" if plus else "CoCoA", alg,
         warm_start=warm_start, accel=accel_on, theta=theta, **kw
     )
+
+
+# --- bounded-staleness CoCoA+ aggregation (--staleRounds, round 17) ---------
+#
+# The bulk-synchronous round pays the slowest worker's wall-clock at
+# every barrier.  Bounded staleness relaxes the barrier, not the math:
+# a worker may start round t+1 with peer contributions for rounds
+# (t-S, t] still outstanding, as long as every round-r contribution is
+# APPLIED before round r+S+1's local solve begins (the join window).
+#
+# Safety (the adding-vs-averaging analysis, Ma et al. arXiv:1502.03508):
+# every local subproblem is solved against σ′ = K·γ — the bound that
+# makes SIMULTANEOUS additive aggregation of all K contributions safe.
+# Applying a SUBSET of m ≤ K contributions with the same γ is strictly
+# inside that safety region (the subset's mutual interference is
+# bounded by m/K of what σ′ already covers), and a late contribution
+# joining alone later is the m = 1 case.  The scale must be the SAME γ
+# for every contribution regardless of when it joins: the owner already
+# advanced its α by γ·Δα at solve time, so any other Δw scale would
+# break the primal-dual correspondence w = (1/λn)·Σ y·α·x that the
+# exact duality-gap certificate rests on (:func:`partial_gamma` is
+# where that argument lives).  The trajectory changes — a late joiner's
+# peers ran a few rounds on a w missing its Δw — but the certificate
+# does not: the gap is evaluated on the ACTUAL (w, α) at a drained
+# boundary, where every contribution has landed and w = w(α) holds
+# exactly again (the general-CoCoA inexactness argument,
+# arXiv:1611.02189 — the certificate never assumed a particular
+# trajectory).
+#
+# Determinism: the join window is ROUND-indexed, never arrival-indexed.
+# Which contribution joins at which round is a pure function of round
+# numbers (round r joins at round r+S), so the trajectory is
+# bit-reproducible run to run and the asynchrony moves the WAITING off
+# the critical path, not the data.  Whoever arrives early is simply
+# already in the collector's buffer when its join round comes due.
+#
+# Docs: docs/DESIGN.md §15 "Asynchrony model".
+
+
+def partial_gamma(gamma: float, k: int, m: int) -> float:
+    """The safe aggregation scale for applying ``m`` of ``k`` CoCoA+
+    contributions whose local subproblems were solved against
+    σ′ = K·γ.
+
+    Returns γ unchanged — deliberately.  σ′ ≥ γ·m holds for every
+    m ≤ K, so the subset application is safe at γ (the adding analysis
+    bounds the interference of ν simultaneous updates by σ′ ≥ γ·ν, and
+    a subset has less interference than the full gang σ′ was sized
+    for).  An UP-scaled subset (γ·K/m — also admissible by the bound)
+    is rejected by design: the owner applied α += γ·Δα at solve time
+    without knowing which peers would make the same on-time subset, so
+    any size-dependent Δw scale would need a gang-wide agreement
+    protocol to keep w = w(α) — and a disagreement breaks the exact
+    certificate, the one thing this mode must never do."""
+    if not 1 <= m <= k:
+        raise ValueError(f"partial aggregation needs 1 <= m <= K, got "
+                         f"m={m}, K={k}")
+    return float(gamma)
+
+
+class StaleJoinWindow:
+    """Bounded-staleness join-window bookkeeping for a host-exchange
+    gang round (the policy half of ``--staleRounds``; the transport is
+    parallel/distributed.py's :class:`ExchangeHandle`).
+
+    Per round ``t`` the caller posts its contribution, wraps the
+    exchange in a handle, and calls :meth:`admit` followed by
+    :meth:`join_due` — which joins exactly the rounds whose window
+    expires at ``t`` (round r at t = r + S) and returns their payloads
+    for application.  :meth:`drain` force-joins everything pending (the
+    eval/checkpoint boundaries — the points where w = w(α) must hold
+    exactly for the certificate and for a resumable checkpoint).
+    ``stale_rounds=0`` degenerates to today's synchronous barrier:
+    round t joins at round t.
+
+    **Gap-rise collapse** (:meth:`on_eval`): a gap rise at an eval
+    boundary collapses the window to synchronous (S = 0) until a later
+    eval improves again — the ``momentum_restart`` pattern: damage from
+    staleness-hurt progress is bounded to one eval cadence, and the
+    collapse discards the permission for further stale joins rather
+    than any applied contribution (an applied Δw can never be unwound
+    without breaking w = w(α)).
+
+    **Elastic interaction** (:meth:`abort`): a gang teardown or resize
+    drops pending handles without joining them — the collector daemons
+    die with the process, the bounded KV budget caps any straggling
+    get, and the next generation resumes from a DRAINED checkpoint, so
+    no half-joined round can ever leak across generations.
+
+    Emits one typed ``stale_join`` event per late-joined round
+    (``rounds_late >= 1``); synchronous joins are not events.
+    """
+
+    def __init__(self, stale_rounds: int, algorithm: str = "CoCoA+"):
+        s = int(stale_rounds)
+        if s < 0:
+            raise ValueError(f"staleRounds must be >= 0, got {stale_rounds}")
+        self.stale_rounds = s
+        self.algorithm = algorithm
+        self.collapsed = False   # gap-rise: window forced to 0
+        self._last_gap = None
+        self._pending: dict = {}   # round -> ExchangeHandle | payload list
+
+    def effective_window(self) -> int:
+        return 0 if self.collapsed else self.stale_rounds
+
+    def pending_rounds(self) -> list:
+        return sorted(self._pending)
+
+    def admit(self, t: int, handle) -> None:
+        """Register round ``t``'s in-flight exchange (an ExchangeHandle,
+        or an already-collected payload list on the synchronous path)."""
+        if t in self._pending:
+            raise ValueError(f"round {t} already has a pending exchange")
+        self._pending[t] = handle
+
+    def join_due(self, t: int) -> list:
+        """Join every round whose window expires by round ``t`` (rounds
+        r <= t - S).  Returns ``[(round, payloads, rounds_late), ...]``
+        in round order; ``rounds_late = t - r`` is bounded by the
+        CONFIGURED window (never admits later than S — pinned)."""
+        cut = t - self.effective_window()
+        return self._join([r for r in sorted(self._pending) if r <= cut], t)
+
+    def drain(self, t: int) -> list:
+        """Force-join everything pending (eval/checkpoint boundary): the
+        returned contributions must be applied before the gap is
+        evaluated, restoring exact w = w(α)."""
+        return self._join(sorted(self._pending), t)
+
+    def abort(self) -> None:
+        """Drop pending handles without joining (teardown/resize): the
+        daemon collectors die with the process; nothing is applied."""
+        self._pending.clear()
+
+    def _join(self, rounds: list, t: int) -> list:
+        from cocoa_tpu.telemetry import events as _tele
+
+        out = []
+        for r in rounds:
+            h = self._pending.pop(r)
+            payloads = h.join() if hasattr(h, "join") else h
+            late = max(0, t - r)
+            if late > self.stale_rounds:
+                # the user-facing bound (and what keeps the
+                # rounds_late metrics label set finite) — a caller that
+                # skipped join_due for some round must fail loudly, not
+                # silently apply an arbitrarily stale contribution
+                raise RuntimeError(
+                    f"round {r} would join {late} rounds late — past "
+                    f"the --staleRounds={self.stale_rounds} window; a "
+                    f"caller skipped join_due for it")
+            if late >= 1:
+                _tele.get_bus().emit(
+                    "stale_join", algorithm=self.algorithm, t=int(t),
+                    round=int(r), rounds_late=int(late),
+                    workers=len(payloads) if payloads is not None else None)
+            out.append((r, payloads, late))
+        return out
+
+    def on_eval(self, gap) -> bool:
+        """The gap-rise rule at an eval boundary (call AFTER
+        :meth:`drain` + gap evaluation): a rise collapses the window to
+        synchronous until an improving eval restores it.  Returns True
+        when this eval changed the collapse state."""
+        if gap is None:
+            return False
+        g = float(gap)
+        prev = self._last_gap
+        self._last_gap = g
+        if prev is None:
+            return False
+        if g > prev and not self.collapsed:
+            self.collapsed = True
+            return True
+        if g <= prev and self.collapsed:
+            self.collapsed = False
+            return True
+        return False
 
 
 def _run_cocoa_anneal(ds, params, debug, plus, levels, warm_start,

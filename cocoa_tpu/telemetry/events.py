@@ -65,6 +65,15 @@ EVENT_TYPES = (
                         # full file to `<path>.1` (first event of the
                         # fresh file, so the rotation itself is in the
                         # machine-readable record)
+    "comm_overlap",     # one joined overlapped exchange (--overlapComm,
+                        # parallel/distributed.ExchangeHandle): hidden_s
+                        # = exchange wall-clock that ran concurrently
+                        # with the caller's compute, wait_s = the
+                        # residual blocking wait at the join barrier
+    "stale_join",       # a bounded-staleness contribution joined late
+                        # (--staleRounds, solvers/cocoa.StaleJoinWindow):
+                        # round r's Δw applied at round t = r +
+                        # rounds_late, rounds_late <= S by construction
 )
 
 

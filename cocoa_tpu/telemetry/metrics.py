@@ -48,6 +48,16 @@ otherwise one opaque device dispatch:
   cross-worker straggler gauges (``cocoa_straggler_slack_seconds``)
   come from telemetry/trace_report.py, which merges every process's
   stream
+- ``cocoa_overlap_hidden_seconds`` gauge — cumulative exchange
+  wall-clock hidden behind the caller's compute by ``--overlapComm``
+  (the ``comm_overlap`` events; present only once an overlapped
+  exchange has joined).  ``cocoa_overlap_wait_seconds`` alongside it is
+  the residual blocking wait the overlap did NOT hide — the pair is
+  the overlap's measured win
+- ``cocoa_stale_joins_total{rounds_late=...}`` counter — bounded-
+  staleness contributions joined late, labeled by how many rounds late
+  (``--staleRounds``; the ``stale_join`` events — never exceeds S by
+  construction, which makes the label set finite)
 - ``cocoa_last_gap``            gauge   — most recent duality gap
 - ``cocoa_round_seconds``       histogram — observed per-round wall time
   (host-clock deltas between consecutive evals divided by the rounds
@@ -123,6 +133,10 @@ class MetricsWriter:
         self.ingest_seconds = 0.0
         self.ingest_bytes = 0
         self.phase_seconds: dict = {}   # span phase -> cumulative seconds
+        self.overlap_hidden_seconds = 0.0
+        self.overlap_wait_seconds = 0.0
+        self.overlap_joins_total = 0
+        self.stale_joins: dict = {}     # rounds_late -> count
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -215,6 +229,17 @@ class MetricsWriter:
                 self.phase_seconds[str(phase)] = (
                     self.phase_seconds.get(str(phase), 0.0)
                     + float(rec["dur_s"]))
+        elif ev == "comm_overlap":
+            self.overlap_joins_total += 1
+            if rec.get("hidden_s") is not None:
+                self.overlap_hidden_seconds += float(rec["hidden_s"])
+            if rec.get("wait_s") is not None:
+                self.overlap_wait_seconds += float(rec["wait_s"])
+        elif ev == "stale_join":
+            late = rec.get("rounds_late")
+            if late is not None:
+                self.stale_joins[int(late)] = (
+                    self.stale_joins.get(int(late), 0) + 1)
 
     def _maybe_write(self, ev):
         """The write debounce (caller holds the lock): flush-now events
@@ -293,6 +318,18 @@ class MetricsWriter:
             lines += [f'cocoa_phase_seconds{{phase="{p}"}} '
                       f"{self.phase_seconds[p]!r}"
                       for p in sorted(self.phase_seconds)]
+        if self.overlap_joins_total:
+            lines += ["# TYPE cocoa_overlap_hidden_seconds gauge",
+                      f"cocoa_overlap_hidden_seconds "
+                      f"{self.overlap_hidden_seconds!r}",
+                      "# TYPE cocoa_overlap_wait_seconds gauge",
+                      f"cocoa_overlap_wait_seconds "
+                      f"{self.overlap_wait_seconds!r}"]
+        if self.stale_joins:
+            lines.append("# TYPE cocoa_stale_joins_total counter")
+            lines += [f'cocoa_stale_joins_total{{rounds_late="{late}"}} '
+                      f"{self.stale_joins[late]}"
+                      for late in sorted(self.stale_joins)]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]

@@ -116,6 +116,16 @@ EVENT_FIELDS = {
     # (events.EventBus._rotate) — always the first event of a fresh file
     "events_rotate": {"path": (str,), "rotated_to": (str,),
                       "bytes": (int,)},
+    # one joined overlapped exchange (--overlapComm,
+    # parallel/distributed.ExchangeHandle): what feeds the
+    # cocoa_overlap_hidden_seconds gauge
+    "comm_overlap": {"tag": (str,), "hidden_s": _NUM, "wait_s": _NUM},
+    # a bounded-staleness contribution joined rounds_late rounds after
+    # its own round (--staleRounds, solvers/cocoa.StaleJoinWindow):
+    # what feeds cocoa_stale_joins_total{rounds_late=}
+    "stale_join": {"algorithm": (str,), "t": (int,), "round": (int,),
+                   "rounds_late": (int,),
+                   "workers": (int, type(None))},
 }
 
 TRAJ_RECORD_FIELDS = {

@@ -83,28 +83,35 @@ def worker_of(span: dict):
 
 
 def attribute_rounds(spans) -> None:
-    """Set ``_round`` on every span: its own ``round`` attribute, else
+    """Set ``_round`` on every span — its own ``round`` attribute, else
     the nearest ancestor's (the KV gets inside an allgather inside a
-    ``round`` span all belong to that round).  Parent chains are
+    ``round`` span all belong to that round) — and ``_bg``, whether the
+    span ran on an overlapped BACKGROUND collector (its own or an
+    ancestor's ``overlapped`` attribute — what
+    ``async_host_allgather_bytes`` tags its collector spans with; the
+    charged-seconds accounting makes background spans yield the
+    wall-clock to concurrent foreground work).  Parent chains are
     per-process (span ids restart per process/generation), so the walk
     keys on (pid, span_id)."""
     by_id = {(s.get("pid"), s.get("span_id")): s for s in spans}
     for s in spans:
-        node, r, hops = s, None, 0
+        node, r, bg, hops = s, None, False, 0
         while node is not None and hops < 64:
-            if node.get("round") is not None:
+            if r is None and node.get("round") is not None:
                 r = int(node["round"])
-                break
+            if node.get("overlapped") or node.get("background"):
+                bg = True
             node = by_id.get((node.get("pid"), node.get("parent_id")))
             hops += 1
         s["_round"] = r
+        s["_bg"] = bg
 
 
 # --- Perfetto / Chrome trace export ----------------------------------------
 
 _RESERVED = frozenset((
     "event", "seq", "pid", "ts", "phase", "span_id", "parent_id",
-    "worker", "start_ts", "dur_s", "_round",
+    "worker", "start_ts", "dur_s", "_round", "_bg",
 ))
 
 
@@ -173,26 +180,89 @@ def check_chrome_trace(obj) -> list:
 # --- critical path + stragglers --------------------------------------------
 
 
+def _charge_spans(intervals, background) -> list:
+    """Partition one worker's wall-clock among its (possibly
+    overlapping) leaf spans.  Each second of the intervals' union is
+    charged to exactly one covering span: FOREGROUND spans (the
+    worker's main thread) beat BACKGROUND ones (an ``--overlapComm``
+    collector daemon, ``_bg``), and within a class the latest-started
+    covering span owns the second (ties broken by list order).
+    Returns per-interval charged seconds.
+
+    Rationale (docs/DESIGN.md §15): a collector's KV gets run
+    CONCURRENTLY with the main thread's next local-solve — a worker
+    owns at most wall-clock seconds of wall-clock, so summing
+    overlapped leaves would double-count hidden exchange time straight
+    into the critical path and the slack table.  Foreground-beats-
+    background charges the compute (or the ``exchange_join`` wait, once
+    the thread actually blocks) and shadows the hidden exchange to ~0 —
+    which is exactly what "hidden" means; latest-started-owns within a
+    class makes same-phase re-entries charge their union.  Disjoint
+    spans (every pre-overlap run) are charged their full durations —
+    bit-identical to the old per-phase sums."""
+    n = len(intervals)
+    events = []
+    for i, (s0, s1) in enumerate(intervals):
+        events.append((s0, 0, i))
+        events.append((max(s0, s1), 1, i))
+    events.sort(key=lambda e: (e[0], e[1]))
+    charged = [0.0] * n
+    active: dict = {}
+    prev = None
+    for t, kind, i in events:
+        if prev is not None and active and t > prev:
+            fg = [j for j in active if not background[j]]
+            pool = fg if fg else list(active)
+            owner = max(pool, key=lambda j: (intervals[j][0], j))
+            charged[owner] += t - prev
+        prev = t
+        if kind == 0:
+            active[i] = True
+        else:
+            active.pop(i, None)
+    return charged
+
+
 def _per_round_phase_durs(spans) -> dict:
-    """{round: {phase: {worker: summed seconds}}} over round-attributed
-    LEAF spans (a phase may run several times per round — KV gets — so
-    durations sum).  Container spans — those with recorded children,
-    like the ``round`` wrapper or an allgather whose gets were traced —
-    are excluded: counting both a parent and its children would double
+    """{round: {phase: {worker: seconds}}} over round-attributed LEAF
+    spans.  Container spans — those with recorded children, like the
+    ``round`` wrapper or an allgather whose gets were traced — are
+    excluded: counting both a parent and its children would double
     every nested second in the critical path and the slack totals.  The
-    Perfetto export keeps the full hierarchy."""
+    Perfetto export keeps the full hierarchy.
+
+    Per worker, concurrent leaf spans (a phase re-entered several times
+    per round is fine; ``--overlapComm`` collector gets riding
+    alongside the main thread are the interesting case) share the
+    wall-clock via :func:`_charge_spans` — each second charged to the
+    latest-started covering span — and the charged seconds then
+    aggregate into (round, phase) cells.  Spans missing a ``start_ts``
+    (torn streams) fall back to their full duration."""
     containers = {(s.get("pid"), s.get("parent_id"))
                   for s in spans if s.get("parent_id") is not None}
-    table: dict = {}
+    leaves: dict = {}   # worker -> [span, ...]
     for s in spans:
         if (s.get("pid"), s.get("span_id")) in containers:
             continue
         r, w = s.get("_round"), worker_of(s)
         if r is None or w is None or s.get("dur_s") is None:
             continue
-        ph = str(s.get("phase"))
-        d = table.setdefault(r, {}).setdefault(ph, {})
-        d[w] = d.get(w, 0.0) + float(s["dur_s"])
+        leaves.setdefault(w, []).append(s)
+    table: dict = {}
+    for w, ss in leaves.items():
+        timed = [s for s in ss if s.get("start_ts") is not None]
+        charged = _charge_spans(
+            [(float(s["start_ts"]),
+              float(s["start_ts"]) + max(0.0, float(s["dur_s"])))
+             for s in timed],
+            [bool(s.get("_bg")) for s in timed])
+        pairs = list(zip(timed, charged)) + [
+            (s, max(0.0, float(s["dur_s"])))
+            for s in ss if s.get("start_ts") is None]
+        for s, d in pairs:
+            ph = str(s.get("phase"))
+            cell = table.setdefault(s["_round"], {}).setdefault(ph, {})
+            cell[w] = cell.get(w, 0.0) + d
     return table
 
 

@@ -164,15 +164,88 @@ def main(argv=None) -> int:
                   f"{top['phase']} (slack {top['slack_s']:.4f}s)",
                   flush=True)
 
+    failures += overlap_smoke(outdir, workdir)
+
     if failures:
         for f in failures:
             print(f"chaos-smoke FAIL: {f}", file=sys.stderr)
         return 1
     print("chaos-smoke: OK — kill survived, gang shrunk 2->1, final "
           "state bit-identical to the control, events schema-valid, "
-          "gang gauges present, flightrec dumped, trace assembled",
-          flush=True)
+          "gang gauges present, flightrec dumped, trace assembled, "
+          "overlap+staleness cut the exchange slack", flush=True)
     return 0
+
+
+def overlap_smoke(outdir: str, workdir: str) -> list:
+    """The ISSUE-12 chaos-step variant: the deterministic rotating
+    `--stepSkew` REAL-math gang (tests/_gang_worker.py --real=cocoa),
+    synchronous control vs `--overlapComm=on --staleRounds=1`.  Both
+    must certify the 1e-4 gap; the treatment's exchange-phase
+    straggler slack (telemetry/trace_report.py) must drop >= 40%; the
+    treatment stream must schema-validate and carry the typed
+    comm_overlap/stale_join events; the slack gauges land in
+    `overlap-straggler.prom` for the CI grep."""
+    from _gang_worker import EXCHANGE_PHASES, supervise_gang
+    from cocoa_tpu.telemetry import schema as _schema
+    from cocoa_tpu.telemetry import trace_report
+
+    failures = []
+    exchange_phases = EXCHANGE_PHASES
+    base = ["--real=cocoa", "--numSplits=2", "--numRounds=400",
+            "--debugIter=10", "--gapTarget=1e-4", "--lambda=0.01",
+            "--rowsPerShard=64", "--numFeatures=32", "--localIters=16",
+            "--trace", "--stepSeconds=0.008", "--stepSkew=0.03",
+            "--skewEvery=2"]
+
+    def run(name, levers):
+        ev = os.path.join(workdir, f"overlap-{name}.jsonl")
+        rc, recs = supervise_gang(base + list(levers), events=ev)
+        if rc != 0:
+            failures.append(f"overlap {name} gang exited {rc}")
+            return None, None
+        ends = [r for r in recs if r["event"] == "run_end"]
+        if not ends or ends[-1].get("stopped") != "target":
+            failures.append(f"overlap {name} run did not certify")
+        spans = trace_report.load_spans([ev, ev + ".p1"])
+        rows = trace_report.stragglers(spans)
+        slack = sum(r["slack_s"] for r in rows
+                    if r["phase"] in exchange_phases)
+        return slack, (ev, recs, spans)
+
+    print("chaos-smoke: skewed real-math gang, synchronous control",
+          flush=True)
+    ctl_slack, _ = run("control", ["--overlapComm=off",
+                                   "--staleRounds=0"])
+    print("chaos-smoke: skewed real-math gang, overlap + staleness",
+          flush=True)
+    trt_slack, trt = run("treatment", ["--overlapComm=on",
+                                       "--staleRounds=1"])
+    if ctl_slack is None or trt_slack is None:
+        return failures
+    if ctl_slack <= 0.5:
+        failures.append(f"control exchange slack too small to A/B "
+                        f"({ctl_slack:.3f}s)")
+    elif trt_slack > 0.6 * ctl_slack:
+        failures.append(
+            f"overlap+staleness only cut exchange slack "
+            f"{1 - trt_slack / ctl_slack:.0%} "
+            f"({ctl_slack:.3f}s -> {trt_slack:.3f}s; bar is >= 40%)")
+    else:
+        print(f"chaos-smoke: exchange slack {ctl_slack:.3f}s -> "
+              f"{trt_slack:.3f}s "
+              f"({1 - trt_slack / ctl_slack:.0%} hidden)", flush=True)
+    ev, recs, spans = trt
+    errs = _schema.check_file(ev)
+    if errs:
+        failures.append(f"overlap events schema violations: {errs[:5]}")
+    for needle in ("comm_overlap", "stale_join"):
+        if not any(r.get("event") == needle for r in recs):
+            failures.append(f"no typed {needle} event in the treatment "
+                            f"stream")
+    with open(os.path.join(outdir, "overlap-straggler.prom"), "w") as f:
+        f.write(trace_report.metrics_text(spans))
+    return failures
 
 
 if __name__ == "__main__":

@@ -492,6 +492,76 @@ def test_span_hygiene_ignores_unrelated_span_methods(tmp_path):
                 rule="span-hygiene") == []
 
 
+# --- overlap-hygiene rule ---------------------------------------------------
+
+ASYNC_IN_JIT = """
+import jax
+from cocoa_tpu.parallel.distributed import async_host_allgather_bytes
+
+@jax.jit
+def step(w):
+    h = async_host_allgather_bytes("dw", w)   # traced value escapes
+    return w
+"""
+
+ASYNC_IN_LAX_BODY = """
+from jax import lax
+from cocoa_tpu.parallel import distributed
+
+def run(w):
+    def body(i, w):
+        distributed.async_kv_get(None, "k")
+        return w
+    return lax.fori_loop(0, 3, body, w)
+"""
+
+HANDLE_NEVER_JOINED = """
+from cocoa_tpu.parallel.distributed import async_host_allgather_bytes
+
+def round_exchange(payload, dispatch):
+    h = async_host_allgather_bytes("dw", payload)
+    dispatch()          # the super-block crosses an un-joined exchange
+    return None
+"""
+
+HANDLE_JOINED = """
+from cocoa_tpu.parallel.distributed import async_host_allgather_bytes
+
+def round_exchange(payload, dispatch):
+    h = async_host_allgather_bytes("dw", payload)
+    dispatch()
+    return h.join()     # joined at the barrier: clean
+"""
+
+HANDLE_ESCAPES = """
+from cocoa_tpu.parallel.distributed import async_host_allgather_bytes
+
+def round_exchange(payload, window, t):
+    h = async_host_allgather_bytes(f"dw{t}", payload)
+    window.admit(t, h)  # handed to the join window: its job to join
+"""
+
+
+def test_overlap_hygiene_async_launch_in_jit_caught(tmp_path):
+    found = lint(tmp_path, ASYNC_IN_JIT, rule="overlap-hygiene")
+    assert len(found) == 1 and "exchange thread" in found[0].message
+
+
+def test_overlap_hygiene_async_launch_in_lax_body_caught(tmp_path):
+    found = lint(tmp_path, ASYNC_IN_LAX_BODY, rule="overlap-hygiene")
+    assert len(found) == 1
+
+
+def test_overlap_hygiene_unjoined_handle_caught(tmp_path):
+    found = lint(tmp_path, HANDLE_NEVER_JOINED, rule="overlap-hygiene")
+    assert len(found) == 1 and "never joined" in found[0].message
+
+
+def test_overlap_hygiene_joined_or_escaping_clean(tmp_path):
+    assert lint(tmp_path, HANDLE_JOINED, rule="overlap-hygiene") == []
+    assert lint(tmp_path, HANDLE_ESCAPES, rule="overlap-hygiene") == []
+
+
 # --- fingerprints / baseline / report --------------------------------------
 
 

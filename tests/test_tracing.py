@@ -230,6 +230,74 @@ def test_trace_report_merge_critical_path_and_stragglers(tmp_path):
     assert 'cocoa_phase_seconds{worker="0",phase="local_step"}' in text
 
 
+def _leaf(worker, phase, start, dur, round_=1, sid=[0], **attrs):
+    sid[0] += 1
+    return {"event": "span", "phase": phase, "span_id": sid[0],
+            "parent_id": None, "worker": worker, "pid": 100 + worker,
+            "start_ts": float(start), "dur_s": float(dur),
+            "_round": round_, "round": round_, **attrs}
+
+
+def test_critical_path_charges_overlapped_same_worker_leaves():
+    """The ISSUE-12 satellite pin: leaf spans on ONE worker are no
+    longer assumed disjoint — an `--overlapComm` collector's kv_get
+    runs concurrently with the main thread.  Per worker each wall-clock
+    second is charged to exactly one covering span (foreground beats
+    the `overlapped` background collector; latest-started owns within a
+    class), so hidden exchange time cannot double-count into the
+    critical path or the slack table; disjoint spans keep the old
+    summed values exactly."""
+    # worker 0: a 1.0s local_solve [10, 11) fully hiding a 0.8s
+    # background kv_get [10.1, 10.9); worker 1: sequential (sync mode)
+    spans = [
+        _leaf(0, "local_solve", 10.0, 1.0),
+        _leaf(0, "kv_get", 10.1, 0.8, overlapped=True),   # hidden
+        _leaf(1, "local_solve", 10.0, 1.0),
+        _leaf(1, "kv_get", 11.0, 0.8),       # sequential: fully charged
+    ]
+    trace_report.attribute_rounds(spans)
+    table = trace_report._per_round_phase_durs(spans)
+    assert table[1]["local_solve"][0] == pytest.approx(1.0)
+    assert table[1]["kv_get"][0] == pytest.approx(0.0)    # fully hidden
+    assert table[1]["local_solve"][1] == pytest.approx(1.0)
+    assert table[1]["kv_get"][1] == pytest.approx(0.8)
+    # the critical path no longer credits worker 0 with 1.8s of a 1.0s
+    # wall-clock window: kv_get's slowest worker is now worker 1
+    cp = trace_report.critical_path(spans)
+    by_phase = {e["phase"]: e for e in cp[0]["entries"]}
+    assert by_phase["kv_get"]["worker"] == 1
+    assert cp[0]["critical_s"] == pytest.approx(1.8)
+    # and the slack table attributes the exchange wait to the worker
+    # that actually paid it on its main thread
+    rows = trace_report.stragglers(spans)
+    kv = {r["worker"]: r["slack_s"] for r in rows
+          if r["phase"] == "kv_get"}
+    assert kv[1] == pytest.approx(0.8)
+    assert kv[0] == pytest.approx(0.0)
+
+
+def test_charged_same_phase_overlap_unions_not_sums():
+    """Two overlapping same-phase leaves on one worker charge their
+    UNION (the pre-fix sum double-counted the overlap); a third
+    disjoint leaf still adds fully."""
+    spans = [
+        _leaf(0, "kv_get", 0.0, 1.0),
+        _leaf(0, "kv_get", 0.5, 1.0),        # overlaps [0.5, 1.0)
+        _leaf(0, "kv_get", 3.0, 0.25),       # disjoint
+        _leaf(1, "kv_get", 0.0, 0.1),
+    ]
+    trace_report.attribute_rounds(spans)
+    table = trace_report._per_round_phase_durs(spans)
+    assert table[1]["kv_get"][0] == pytest.approx(1.75)   # union, not 2.25
+    assert table[1]["kv_get"][1] == pytest.approx(0.1)
+    # torn stream (no start_ts): falls back to the span's own duration
+    torn = [_leaf(0, "kv_get", 0.0, 0.5)]
+    torn[0].pop("start_ts")
+    trace_report.attribute_rounds(torn)
+    assert trace_report._per_round_phase_durs(torn)[1]["kv_get"][0] \
+        == pytest.approx(0.5)
+
+
 def test_trace_report_chrome_trace_valid_and_checker_has_teeth(tmp_path):
     paths = _synthetic_streams(tmp_path, rounds=(1,))
     spans = trace_report.load_spans(paths)
