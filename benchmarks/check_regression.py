@@ -111,6 +111,21 @@ GATES = (
             "--overlapComm=on", "--staleRounds=1",
         ],
     },
+    # The fleet row (ISSUE 13): 256 synthetic tenants (a log-spaced λ
+    # path over 256 distinct planted-separator problems) through the ONE
+    # compiled vmapped round (benchmarks/fleet_bench.py).  The gate
+    # re-runs the fleet side only — rounds-to-certify-every-tenant and
+    # full certification are the backend-independent axes; the
+    # models-per-second and the 173x-vs-serial speedup live in the
+    # committed row (CPU-measured, re-measured by fleet_bench --row).
+    {
+        "config": "fleet-256-synth",
+        "algorithm": "CoCoA+ fleet",
+        "gap_target": 1e-2,
+        "rounds_tol": 0.25,
+        "runner": "fleet",
+        "flags": ["--fleet-only", "--tenants=256"],
+    },
 )
 
 # bounded-staleness round overhead vs the synchronous control (the
@@ -239,6 +254,42 @@ def run_fresh_gang(gate: dict, workdir: str) -> dict:
                 os.environ[k] = v
 
 
+def run_fresh_fleet(gate: dict, workdir: str) -> dict:
+    """One fresh CPU fleet run (benchmarks/fleet_bench.py --fleet-only):
+    the row comes from the bench driver's own --row artifact, so the
+    gate and the benchmark can never disagree about what a fleet row
+    means.  Same never-raises contract as :func:`run_fresh`."""
+    row_path = os.path.join(workdir,
+                            gate["config"].replace("/", "_") + ".jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "benchmarks",
+                                          "fleet_bench.py"),
+             *gate["flags"], f"--row={row_path}"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=900)
+        if proc.returncode != 0:
+            return {"config": gate["config"], "error":
+                    f"fleet bench exited {proc.returncode}: "
+                    f"{proc.stderr[-500:]}"}
+        with open(row_path) as f:
+            row = json.loads(f.readline())
+        return {
+            "config": gate["config"],
+            "rounds": int(row["rounds"]),
+            "gap": float(row["gap"]),
+            # "target" iff EVERY tenant certified (fleet_bench sets it)
+            "stopped": row.get("stopped"),
+            "gap_target": gate["gap_target"],
+            "type": "bench-regression-fresh",
+        }
+    except (subprocess.TimeoutExpired, OSError, ValueError, KeyError,
+            TypeError) as e:
+        return {"config": gate["config"], "error":
+                f"{type(e).__name__}: {e}"}
+
+
 def gang_ratio_failures(rows: list) -> list:
     """The cross-config staleness bound: overlap+stale rounds <=
     STALE_ROUNDS_RATIO x sync rounds (evaluated only when both gang
@@ -336,8 +387,9 @@ def main(argv=None) -> int:
                   f"(committed baseline "
                   f"{committed.get(gate['config'], {}).get('rounds')} "
                   f"rounds)", flush=True)
-            runner = (run_fresh_gang if gate.get("runner") == "gang"
-                      else run_fresh)
+            runner = {"gang": run_fresh_gang,
+                      "fleet": run_fresh_fleet}.get(
+                          gate.get("runner"), run_fresh)
             fresh = runner(gate, workdir)
             rows.append(fresh)
             failures += evaluate(gate, fresh, committed)

@@ -40,6 +40,14 @@ Rules (ids are what ``jaxlint: allow=<rule>`` and the baseline key on):
   must never read traced values (emitting one materializes the array on
   the host: a silent device sync).  Rides the host-sync rule's
   traced-context machinery.
+- ``fleet-hygiene`` — the fleet execution contract (solvers/fleet.py):
+  a Python-level loop over tenants inside a jit/lax body is an error
+  (it unrolls T kernel copies — one compiled round per tenant is
+  exactly what the fleet path exists to avoid; the tenant axis rides
+  vmap/lax.map), and a per-tenant device fetch inside a host-side
+  tenant loop is an error (T round-trips through the device tunnel is
+  the serial-path cost the fleet amortizes; fetch the stacked result
+  once).  Rides the host-sync rule's traced-context machinery.
 - ``overlap-hygiene`` — the overlapped-exchange contract
   (parallel/distributed.py, docs/DESIGN.md §15): launching an async
   exchange (``async_host_allgather_bytes`` / ``async_kv_get``) inside
@@ -957,10 +965,111 @@ def check_overlap_hygiene(src: SourceFile, index: ModuleIndex) -> list:
     return findings
 
 
+# --- rule: fleet-hygiene -----------------------------------------------------
+
+# names that identify tenant/fleet iteration (the --fleet surface,
+# solvers/fleet.py): matched against a for-loop's target and iterable
+_FLEET_NAME_RE = re.compile(r"(^|_)(tenants?|fleet|lanes?)(_|$|\d)",
+                            re.IGNORECASE)
+
+# host-side device-fetch callees: each one synchronizes (or stages) a
+# device value — paid PER TENANT when it sits inside a tenant loop,
+# which is exactly the per-model round-trip cost the fleet path exists
+# to amortize away
+_FLEET_FETCH_CALLEES = {"asarray", "array", "device_get",
+                        "block_until_ready", "item", "tolist"}
+
+
+def _fleet_named(node: ast.For) -> bool:
+    """Whether a for-loop iterates over tenants/the fleet — its target
+    or iterable names say so."""
+    names = []
+    for sub in ast.walk(node.target):
+        if isinstance(sub, ast.Name):
+            names.append(sub.id)
+    for sub in ast.walk(node.iter):
+        if isinstance(sub, ast.Name):
+            names.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            names.append(sub.attr)
+    return any(_FLEET_NAME_RE.search(n) for n in names)
+
+
+def check_fleet_hygiene(src: SourceFile, index: ModuleIndex) -> list:
+    """The fleet execution contract (solvers/fleet.py, docs/DESIGN.md
+    §16): the whole point of the fleet path is ONE dispatch for T
+    tenants, so
+
+    1. a Python-level ``for`` loop over tenants inside a jit/lax body is
+       an error — it unrolls T copies of the kernel into the graph
+       (compile time and code size scale with T, and a manifest change
+       retraces everything); the tenant axis rides ``vmap``/``lax.map``
+       (parallel/fanout.lane_fanout);
+    2. a per-tenant device fetch (``np.asarray`` / ``jax.device_get`` /
+       ``.block_until_ready()`` / ``.item()`` / ``.tolist()``) inside a
+       HOST-side tenant loop is an error — T host round-trips through
+       the device tunnel is the serial-path cost the fleet amortizes;
+       fetch the stacked result ONCE before the loop (the
+       run_cocoa_fleet pattern).
+
+    Rides the host-sync rule's traced-context machinery."""
+    findings = []
+    traced = index.traced_defs()
+    parents = _build_parents(src.tree)
+
+    def flag(node, msg):
+        findings.append(Finding(
+            rule="fleet-hygiene", severity="error", path=src.path,
+            line=node.lineno, col=node.col_offset, message=msg))
+
+    # (1) tenant loops inside traced code
+    for d in index.defs:
+        if id(d) not in traced:
+            continue
+        body = d.body if isinstance(d.body, list) else [d.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if _nearest_def(node, parents) is not d:
+                    continue
+                if isinstance(node, ast.For) and _fleet_named(node):
+                    flag(node,
+                         "Python loop over tenants inside traced code — "
+                         "this unrolls T kernel copies into the graph "
+                         "(one compiled round per tenant is exactly what "
+                         "the fleet path exists to avoid); batch the "
+                         "tenant axis with vmap/lax.map "
+                         "(parallel/fanout.lane_fanout)")
+
+    # (2) per-tenant fetches inside host-side tenant loops
+    scopes = [src.tree] + [d for d in index.defs if id(d) not in traced]
+    for scope in scopes:
+        body = scope.body if isinstance(getattr(scope, "body", None), list) \
+            else [scope.body] if hasattr(scope, "body") else []
+        for stmt in body:
+            for node in ast.walk(stmt):
+                nd = _nearest_def(node, parents)
+                at_scope = (nd is scope or (scope is src.tree
+                                            and nd is None))
+                if not at_scope or not isinstance(node, ast.For) \
+                        or not _fleet_named(node):
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            _callee_tail(sub) in _FLEET_FETCH_CALLEES:
+                        flag(sub,
+                             f"per-tenant `{_callee_tail(sub)}` inside a "
+                             f"fleet/tenant loop — T device round-trips "
+                             f"is the serial-path cost the fleet "
+                             f"amortizes away; fetch the stacked result "
+                             f"ONCE before the loop (the run_cocoa_fleet "
+                             f"pattern)")
+    return findings
+
+
 # --- registry ---------------------------------------------------------------
 
 RULES = ("donation", "host-sync", "f64", "mesh-api", "pallas-budget",
-         "span-hygiene", "overlap-hygiene")
+         "span-hygiene", "overlap-hygiene", "fleet-hygiene")
 
 
 def run_static_rules(sources: dict) -> list:
@@ -975,4 +1084,5 @@ def run_static_rules(sources: dict) -> list:
         findings += check_pallas_budget_ast(src, index, sources)
         findings += check_span_hygiene(src, index)
         findings += check_overlap_hygiene(src, index)
+        findings += check_fleet_hygiene(src, index)
     return findings

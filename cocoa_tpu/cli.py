@@ -92,6 +92,21 @@ are bit-identical either way (the whole-file build stays the A/B
 control); fp meshes and ``--objective=lasso`` are whole-file only and
 reject ``--ingest=stream`` loudly.
 
+``--fleet=manifest.jsonl`` (round 18, docs/DESIGN.md §16) trains a
+FLEET: one tenant model per manifest line (dataset ref / λ / gap
+target — a schema-validated JSONL dialect, data/fleet.py), all of them
+through ONE compiled vmapped round (solvers/fleet.py): per-tenant λ·n
+rides the unchanged SDCA kernels as a traced scalar, each tenant's σ′
+schedule / secant bank / gap watch is an independent lane, certified
+tenants mask out bitwise-frozen, and the whole fleet costs one compile,
+one dispatch and one fetch (256 tenants measured at 173× the serial
+solo path's models/s on CPU).  ``--fleetLanes=vmap|map`` picks batched
+lanes (throughput) vs sequential lanes in the same jit (bit-parity with
+the solo path at any T).  The fleet surface is deliberately narrow:
+every flag that cannot mean anything on the one-dispatch path
+(--elastic, --staleRounds>0, --hotCols, --warmStart, checkpointing,
+--testFile, ...) is rejected loudly with a pointer.
+
 ``--objective=lasso`` switches to the ProxCoCoA+ L1 family
 (solvers/prox_cocoa.py): labels become the regression target b,
 ``--lambda`` the L1 weight, ``--l2`` the optional elastic-net weight;
@@ -136,7 +151,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "ingest", "metrics", "events", "quiet",
                 "trace", "flightRecorder", "eventsMaxMB",
                 "metricsInterval", "overlapComm",
-                "staleRounds")  # run-level
+                "staleRounds", "fleet", "fleetLanes")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -200,6 +215,12 @@ def parse_args(argv: list[str]):
                 setattr(cfg, field, float(val))
         else:
             setattr(cfg, field, val)
+    # which flags the USER actually passed (vs dataclass defaults) — what
+    # lets the fleet path reject explicitly-given-but-meaningless
+    # reference flags (--lambda, --numFeatures) instead of silently
+    # training on different values.  A non-field attribute: asdict() and
+    # the config hash never see it.
+    cfg._explicit = frozenset(options)
     return cfg, extras
 
 
@@ -326,6 +347,83 @@ def main(argv=None) -> int:
                   "(docs/DESIGN.md §15)", file=sys.stderr)
             return 2
 
+    # --fleet=manifest.jsonl: thousands of tenant models through ONE
+    # compiled vmapped round (solvers/fleet.py, docs/DESIGN.md §16).
+    # The fleet surface is deliberately narrow — every flag that cannot
+    # mean anything on the one-dispatch tenant-vmapped path is rejected
+    # LOUDLY here with a pointer, never accepted as a silent no-op.
+    fleet_path = extras["fleet"]
+    fleet_lanes = (extras["fleetLanes"] or "vmap").lower()
+    if extras["fleetLanes"] and not fleet_path:
+        print("error: --fleetLanes picks the fleet's lane execution and "
+              "needs --fleet", file=sys.stderr)
+        return 2
+    if fleet_lanes not in ("vmap", "map"):
+        print(f"error: --fleetLanes must be vmap|map, got "
+              f"{extras['fleetLanes']!r}", file=sys.stderr)
+        return 2
+    if fleet_path:
+        rejected = {
+            "elastic": "the elastic supervisor gang-restarts one model's "
+                       "training; a fleet is thousands of independent "
+                       "models in one dispatch — shrinking a gang "
+                       "mid-fleet has no defined tenant semantics "
+                       "(docs/DESIGN.md §16)",
+            "resume": "fleet checkpoint/resume is not in the v1 surface",
+            "warmStart": "the warm-start loss handoff is a solo-path "
+                         "schedule; fleets share one loss phase "
+                         "(docs/DESIGN.md §16)",
+            "hotCols": "fleet v1 is dense-layout only",
+            "evalDense": "fleet v1 is dense-layout only",
+            "blockSize": "the block/Pallas kernels own their shard axes "
+                         "and cannot ride the tenant vmap",
+            "blockPipeline": "the block/Pallas kernels own their shard "
+                             "axes and cannot ride the tenant vmap",
+        }
+        if cfg.test_file:
+            print("error: --testFile does not combine with --fleet: "
+                  "per-tenant test sets are not in the fleet v1 surface",
+                  file=sys.stderr)
+            return 2
+        if cfg.chkpt_dir:
+            print("error: --chkptDir does not combine with --fleet: fleet "
+                  "checkpoint/resume is not in the v1 surface (the run is "
+                  "one dispatch; rerun the fleet instead)", file=sys.stderr)
+            return 2
+        for flag, why in rejected.items():
+            if extras[flag]:
+                print(f"error: --{flag} does not combine with --fleet: "
+                      f"{why}", file=sys.stderr)
+                return 2
+        if cfg.train_file:
+            print("error: --fleet names per-tenant datasets in the "
+                  "manifest; drop --trainFile", file=sys.stderr)
+            return 2
+        explicit = getattr(cfg, "_explicit", frozenset())
+        if "lambda" in explicit:
+            print("error: --lambda does not combine with --fleet: λ is "
+                  "per-tenant and comes from the manifest — a global "
+                  "--lambda would silently train different models than "
+                  "asked for", file=sys.stderr)
+            return 2
+        if "numFeatures" in explicit:
+            print("error: --numFeatures does not combine with --fleet: "
+                  "the feature dimension comes from each tenant's "
+                  "dataset ref (manifest num_features for file-backed "
+                  "tenants)", file=sys.stderr)
+            return 2
+        if (extras["objective"] or "svm").lower() != "svm":
+            print("error: --fleet runs the SVM dual family only "
+                  "(--objective=lasso has no fleet path yet)",
+                  file=sys.stderr)
+            return 2
+        if extras["overlapComm"] and overlap_flag != "off":
+            print("error: --overlapComm does not combine with --fleet: "
+                  "the whole fleet is ONE dispatch and one fetch — there "
+                  "is no per-round exchange or checkpoint write to "
+                  "overlap (docs/DESIGN.md §16)", file=sys.stderr)
+            return 2
+
     # --profile=DIR traces the whole run; --profile=DIR,START,STOP traces
     # the round window [START, STOP) by riding the telemetry event stream
     # (telemetry/profiling.py) — validated here so a typo fails before the
@@ -343,10 +441,10 @@ def main(argv=None) -> int:
         if p_start is not None:
             profile_window = (p_start, p_stop)
 
-    if not cfg.train_file:
+    if not cfg.train_file and not fleet_path:
         print("error: --trainFile is required", file=sys.stderr)
         return 2
-    if cfg.num_features <= 0:
+    if cfg.num_features <= 0 and not fleet_path:
         print("error: --numFeatures must be positive", file=sys.stderr)
         return 2
     from cocoa_tpu.ops import losses as losses_mod
@@ -367,9 +465,11 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    if cfg.sigma == "auto" and not extras["gapTarget"]:
+    if cfg.sigma == "auto" and not extras["gapTarget"] and not fleet_path:
         # fail at the CLI boundary with the standard message/exit-code —
-        # run_cocoa would raise the same requirement later as a traceback
+        # run_cocoa would raise the same requirement later as a traceback.
+        # (--fleet runs accept manifest-supplied per-tenant targets
+        # instead; the fleet runner validates per-tenant coverage.)
         print("error: --sigma=auto requires --gapTarget (the σ′ fallback "
               "triggers on the divergence guard, which runs on the "
               "gap-target path)", file=sys.stderr)
@@ -389,7 +489,7 @@ def main(argv=None) -> int:
                       or (isinstance(cfg.sigma, float)
                           and 0 < cfg.sigma < cfg.num_splits * cfg.gamma))
     if (sigma_schedule == "anneal" and anneal_engages
-            and not extras["gapTarget"]):
+            and not extras["gapTarget"] and not fleet_path):
         # the anneal backoff rides the stall watch, which only runs on the
         # gap-target path (with no sub-safe σ′ the schedule is inert and
         # the flag is accepted as a no-op)
@@ -408,10 +508,12 @@ def main(argv=None) -> int:
         print(f"error: --theta must be fixed|adaptive, got "
               f"{extras['theta']!r}", file=sys.stderr)
         return 2
-    if accel_flag == "on" and not extras["gapTarget"]:
+    if accel_flag == "on" and not extras["gapTarget"] and not fleet_path:
         # momentum's restart rule monitors the eval-cadence gap; without
         # a target the run is a fixed-round benchmark path that must stay
-        # bit-comparable — require the gap-target regime explicitly
+        # bit-comparable — require the gap-target regime explicitly.
+        # (--fleet accel accepts manifest-supplied per-tenant targets;
+        # the fleet runner validates every tenant carries one.)
         print("error: --accel=on requires --gapTarget (the momentum "
               "restart rule monitors the gap trajectory; fixed-round "
               "benchmark runs stay unaccelerated)", file=sys.stderr)
@@ -741,6 +843,11 @@ def main(argv=None) -> int:
                     **{k: v for k, v in extras.items() if v is not None}}
     run_meta = {"dataset": cfg.train_file, "seed": cfg.seed,
                 "config_hash": telemetry.events.config_hash(cfg_manifest)}
+
+    if fleet_path:
+        return _run_fleet_cli(cfg, extras, quiet, bus, cfg_manifest,
+                              fleet_lanes, sigma_schedule, accel_flag,
+                              theta_flag)
 
     k = cfg.num_splits
 
@@ -1351,6 +1458,164 @@ def main(argv=None) -> int:
     else:
         run_all()
 
+    return 0
+
+
+def _run_fleet_cli(cfg, extras, quiet, bus, cfg_manifest, fleet_lanes,
+                   sigma_schedule, accel_flag, theta_flag):
+    """The ``--fleet`` execution path: load + validate the manifest,
+    stack the tenants, run the one compiled vmapped round
+    (solvers/fleet.py), and report per-tenant certification + the
+    models-per-second headline.  Reached from :func:`main` after the
+    flag surface is validated; every remaining fleet-specific
+    incompatibility is rejected here with a pointer."""
+    import numpy as np
+
+    from cocoa_tpu import telemetry
+    from cocoa_tpu.data import build_fleet, load_fleet_manifest
+    from cocoa_tpu.solvers import run_cocoa_fleet
+
+    if extras["mesh"] and str(extras["mesh"]) != "1":
+        print("error: --mesh does not combine with --fleet in v1: fleet "
+              "lanes ride the tenant vmap on one chip; the multi-chip "
+              "direction is the tenant mesh axis "
+              "(parallel/mesh.make_fleet_mesh, docs/DESIGN.md §16)",
+              file=sys.stderr)
+        return 2
+    if extras["fp"] and str(extras["fp"]) != "1":
+        print("error: --fp does not combine with --fleet (feature "
+              "sharding splits one model's columns; fleet lanes are "
+              "whole independent models)", file=sys.stderr)
+        return 2
+    if cfg.sampling == "device":
+        print("error: --sampling=device does not combine with --fleet "
+              "(the fleet loop host-samples its stacked index tables "
+              "once per run — solvers/fleet.py); use --sampling=auto",
+              file=sys.stderr)
+        return 2
+    if theta_flag == "adaptive":
+        print("error: --theta=adaptive does not combine with --fleet "
+              "(the Θ ladder slices static index-table widths; fleet "
+              "lanes share one table shape — docs/DESIGN.md §16)",
+              file=sys.stderr)
+        return 2
+    if cfg.sigma == "auto" and sigma_schedule == "trial":
+        print("error: --sigmaSchedule=trial does not combine with "
+              "--fleet (the trial's restart is a solo-path control; "
+              "fleets anneal in place — --sigmaSchedule=anneal)",
+              file=sys.stderr)
+        return 2
+
+    gap_target = None
+    if extras["gapTarget"]:
+        try:
+            gap_target = float(extras["gapTarget"])
+        except ValueError:
+            print(f"error: --gapTarget must be a float, got "
+                  f"{extras['gapTarget']!r}", file=sys.stderr)
+            return 2
+    accel_on = accel_flag == "on"   # auto resolves OFF for fleets: the
+    # plain certified path is the fleet default; opt in explicitly
+    anneal_on = (cfg.sigma == "auto"
+                 or (sigma_schedule == "anneal"
+                     and isinstance(cfg.sigma, float)
+                     and 0 < cfg.sigma < cfg.num_splits * cfg.gamma))
+    if accel_on and anneal_on:
+        print("error: --accel does not combine with --sigma=auto/"
+              "--sigmaSchedule=anneal on --fleet (fleet accel rides the "
+              "fixed safe σ′; drop one of the two)", file=sys.stderr)
+        return 2
+    drive_mode = ("accel" if accel_on
+                  else "anneal" if anneal_on else "plain")
+
+    try:
+        specs = load_fleet_manifest(extras["fleet"])
+        fleet = build_fleet(specs, k=cfg.num_splits,
+                            dtype=jnp.dtype(cfg.dtype),
+                            local_iter_frac=cfg.local_iter_frac,
+                            default_gap_target=gap_target)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if fleet.loss not in ("hinge", "smooth_hinge"):
+        print(f"error: fleet v1 runs the hinge family only (manifest "
+              f"loss {fleet.loss!r}); the logistic dual rule divides by "
+              f"λn in a way the traced-λ lane cannot mirror bit-exactly "
+              f"(docs/DESIGN.md §16)", file=sys.stderr)
+        return 2
+    if cfg.loss != "hinge" and cfg.loss != fleet.loss:
+        print(f"error: the fleet's loss comes from the manifest "
+              f"({fleet.loss!r}); drop --loss={cfg.loss} or make them "
+              f"agree", file=sys.stderr)
+        return 2
+
+    if bus.active():
+        manifest = telemetry.events.run_manifest(cfg_manifest,
+                                                 dataset=extras["fleet"])
+        manifest["fleet"] = {"tenants": fleet.t, "k": fleet.k,
+                             "n_shard": fleet.n_shard,
+                             "d": fleet.num_features,
+                             "h": fleet.local_iters,
+                             "drive_mode": drive_mode,
+                             "lane_exec": fleet_lanes}
+        bus.emit("run_start", manifest=manifest)
+
+    params = dataclasses.replace(
+        cfg.to_params(0, fleet.k), local_iters=fleet.local_iters,
+        loss=fleet.loss, smoothing=fleet.smoothing)
+    debug = cfg.to_debug()
+    try:
+        result = run_cocoa_fleet(
+            fleet, params, debug, plus=True, drive_mode=drive_mode,
+            rng=cfg.rng, math=cfg.math, lane_exec=fleet_lanes,
+            quiet=quiet)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    certified = int(result.certified.sum())
+    if bus.active():
+        bus.emit("run_end", algorithm=result.algorithm,
+                 stopped=("target" if certified == fleet.t else None))
+    if not quiet:
+        # one host array fetch BEFORE the loop (the fleet-hygiene rule:
+        # never a per-tenant device fetch inside a tenant loop)
+        gaps = np.asarray(result.final_gap)
+        rounds = np.asarray(result.cert_round)
+        for ti, tenant in enumerate(result.tenants):
+            status = (f"certified @ round {int(rounds[ti])}"
+                      if result.certified[ti]
+                      else "DIVERGED (stall watch)" if result.stalled[ti]
+                      else "not certified")
+            print(f"  {tenant}: lambda={fleet.lams[ti]:g} "
+                  f"gap={gaps[ti]:.3e} {status}")
+        print(f"fleet: {certified}/{fleet.t} tenants certified, "
+              f"{result.rounds_run} rounds, {result.wall_s:.2f}s, "
+              f"{result.models_per_second:.1f} models/s "
+              f"(drive_mode={drive_mode}, lanes={fleet_lanes})")
+    if extras["trajOut"]:
+        import json as _json
+
+        path = f"{extras['trajOut']}.fleet.jsonl"
+        with open(path, "w") as f:
+            f.write(_json.dumps({
+                "config": "fleet", "type": "fleet",
+                "tenants": fleet.t, "certified": certified,
+                "rounds": int(result.rounds_run),
+                "models_per_second": result.models_per_second,
+                "stopped": ("target" if certified == fleet.t else None),
+            }) + "\n")
+            gaps = np.asarray(result.final_gap)
+            rounds = np.asarray(result.cert_round)
+            for ti, tenant in enumerate(result.tenants):
+                f.write(_json.dumps({
+                    "config": f"fleet/{tenant}", "type": "fleet-tenant",
+                    "lam": float(fleet.lams[ti]),
+                    "gap": float(gaps[ti]),
+                    "rounds": int(rounds[ti]) or int(result.rounds_run),
+                    "stopped": ("target" if result.certified[ti]
+                                else None),
+                }) + "\n")
     return 0
 
 

@@ -18,6 +18,14 @@ from cocoa_tpu.data.ingest import (  # noqa: F401
     stream_shard_dataset,
 )
 from cocoa_tpu.data.columns import shard_columns  # noqa: F401
+from cocoa_tpu.data.fleet import (  # noqa: F401
+    FleetDataset,
+    TenantSpec,
+    build_fleet,
+    load_fleet_manifest,
+    synth_fleet_specs,
+    write_fleet_manifest,
+)
 from cocoa_tpu.data.synth import (  # noqa: F401
     synth_dense,
     synth_dense_sharded,

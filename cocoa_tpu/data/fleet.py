@@ -1,0 +1,384 @@
+"""Fleet manifests and stacked multi-tenant datasets.
+
+"Millions of users" also means millions of *models*: per-tenant SVMs,
+one-vs-rest heads, regularization-path sweeps — thousands of independent,
+statically-shaped problems that each paid a full compile + round loop
+through the solo path.  The fleet path batches them: a ``--fleet``
+manifest (one tenant per JSONL line, validated by telemetry/schema.py as
+its own dialect) is loaded into a :class:`FleetDataset` whose arrays
+carry a leading tenant axis — ``(T, K, n_shard, …)`` slabs built by the
+SAME :func:`cocoa_tpu.data.sharding._build_shard_slabs` every other
+ingest path uses, so a tenant's slab is bit-identical to the shards a
+solo run of that tenant would build.
+
+Static-shape contract: XLA needs ONE shape for the whole fleet, so every
+tenant pads to the common ``n_shard`` (the fleet max, rows masked — exact
+by the standing padding convention: masked rows are never sampled and
+contribute exactly 0 to every masked reduction) and must agree on d,
+layout, and H (the per-round local-step count is the index-table width).
+Tenants that cannot pad to a common static shape are REJECTED with the
+numbers, not silently truncated.
+
+What may vary per tenant: the dataset itself, λ (the regularization-path
+axis), and the duality-gap target.  What must be uniform: d, layout
+(dense in v1 — the padded-CSR stream kernels own their shard axis and
+cannot ride the tenant vmap), H, loss/smoothing (a per-tenant loss would
+need per-lane branch selection, which a vmapped ``lax.switch`` pays for
+by executing every branch on every lane — docs/DESIGN.md §16).
+
+Dataset refs: ``synth:dense:n=<rows>,d=<features>[,seed=S][,flip=F]``
+generates a planted-separator tenant (data/synth.py), or a LIBSVM file
+path (the manifest line then needs ``num_features``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+import numpy as np
+
+from cocoa_tpu.data.sharding import (
+    ShardedDataset, _build_shard_slabs, pad_rows, segment_sq_norms,
+    split_sizes,
+)
+
+
+@dataclasses.dataclass
+class TenantSpec:
+    """One manifest line: a tenant's problem definition."""
+
+    tenant: str                       # unique tenant id
+    dataset: str                      # synth:... spec or a LIBSVM path
+    lam: float                        # λ — the per-tenant regularization
+    gap_target: Optional[float] = None  # duality-gap certificate target
+    num_features: int = 0             # required for file-backed datasets
+    loss: str = "hinge"               # must be uniform across the fleet
+    smoothing: float = 1.0            # must be uniform across the fleet
+
+
+def parse_dataset_ref(ref: str, num_features: int = 0):
+    """A manifest ``dataset`` ref -> :class:`LibsvmData`.
+
+    ``synth:dense:n=128,d=64[,seed=S][,flip=F]`` generates a planted-
+    separator dense tenant; anything else is a LIBSVM path (loaded with
+    the line's ``num_features``, which is then required)."""
+    if ref.startswith("synth:"):
+        parts = ref.split(":")
+        if len(parts) != 3 or parts[1] != "dense":
+            raise ValueError(
+                f"fleet dataset ref {ref!r}: synth refs are "
+                f"'synth:dense:n=<rows>,d=<features>[,seed=S][,flip=F]' "
+                f"(sparse tenants are not in the fleet v1 surface — "
+                f"docs/DESIGN.md §16)")
+        kv = {}
+        for item in parts[2].split(","):
+            if "=" not in item:
+                raise ValueError(
+                    f"fleet dataset ref {ref!r}: bad key=value {item!r}")
+            key, val = item.split("=", 1)
+            kv[key] = val
+        try:
+            n = int(kv.pop("n"))
+            d = int(kv.pop("d"))
+            seed = int(kv.pop("seed", 0))
+            flip = float(kv.pop("flip", 0.02))
+        except (KeyError, ValueError) as e:
+            raise ValueError(
+                f"fleet dataset ref {ref!r}: needs integer n= and d= "
+                f"(optional seed=, flip=): {e}") from None
+        if kv:
+            raise ValueError(
+                f"fleet dataset ref {ref!r}: unknown keys {sorted(kv)}")
+        from cocoa_tpu.data.synth import synth_dense
+
+        return synth_dense(n, d, seed=seed, flip=flip)
+    if num_features <= 0:
+        raise ValueError(
+            f"fleet dataset ref {ref!r} is a LIBSVM path; the manifest "
+            f"line must carry a positive num_features")
+    from cocoa_tpu.data.libsvm import load_libsvm
+
+    return load_libsvm(ref, num_features)
+
+
+def load_fleet_manifest(path: str) -> list:
+    """Parse + validate a ``--fleet`` manifest into TenantSpecs.
+
+    The file is first schema-validated as the ``fleet`` JSONL dialect
+    (telemetry/schema.py — a ``fleet_manifest`` header line, then one
+    tenant object per line); any violation — including a duplicate
+    tenant id, which the checker owns — is raised with the checker's
+    line-accurate messages."""
+    from cocoa_tpu.telemetry import schema as tele_schema
+
+    errs = tele_schema.check_file(path, kind="fleet")
+    if errs:
+        raise ValueError(
+            f"fleet manifest {path} failed schema validation "
+            f"({len(errs)} violation(s)): " + "; ".join(errs[:5]))
+    specs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if "fleet_manifest" in obj:
+                continue
+            specs.append(TenantSpec(
+                tenant=str(obj["tenant"]),
+                dataset=str(obj["dataset"]),
+                lam=float(obj["lam"]),
+                gap_target=(None if obj.get("gap_target") is None
+                            else float(obj["gap_target"])),
+                num_features=int(obj.get("num_features", 0)),
+                loss=str(obj.get("loss", "hinge")),
+                smoothing=float(obj.get("smoothing", 1.0)),
+            ))
+    if not specs:
+        raise ValueError(f"fleet manifest {path} names no tenants")
+    return specs
+
+
+def write_fleet_manifest(path: str, specs: list) -> None:
+    """Write TenantSpecs as a schema-valid fleet manifest (the header +
+    one tenant line each) — the producer the synth benchmark, the CLI
+    quickstart, and the tests share."""
+    with open(path, "w") as f:
+        f.write(json.dumps(
+            {"fleet_manifest": {"version": 1, "tenants": len(specs)}})
+            + "\n")
+        for s in specs:
+            row = {"tenant": s.tenant, "dataset": s.dataset, "lam": s.lam,
+                   "gap_target": s.gap_target}
+            if s.num_features:
+                row["num_features"] = s.num_features
+            if s.loss != "hinge":
+                row["loss"] = s.loss
+                row["smoothing"] = s.smoothing
+            f.write(json.dumps(row) + "\n")
+
+
+def synth_fleet_specs(tenants: int, *, n: int = 128, d: int = 64,
+                      lam_lo: float = 1e-3, lam_hi: float = 1e-1,
+                      gap_target: float = 1e-3, seed0: int = 100) -> list:
+    """T synthetic tenants spanning a log-spaced λ regularization path —
+    the canonical fleet workload (each tenant a distinct problem AND a
+    distinct λ, so the solo control pays a fresh compile per tenant)."""
+    lams = np.logspace(np.log10(lam_lo), np.log10(lam_hi), max(tenants, 1))
+    return [
+        TenantSpec(
+            tenant=f"tenant-{i:04d}",
+            dataset=f"synth:dense:n={n},d={d},seed={seed0 + i}",
+            lam=float(lams[i]),
+            gap_target=float(gap_target),
+        )
+        for i in range(tenants)
+    ]
+
+
+@dataclasses.dataclass
+class FleetDataset:
+    """T tenants' shards stacked on a leading tenant axis.
+
+    Every array leaf is the solo :class:`ShardedDataset` layout with a
+    leading T dim; ``counts[t, k]`` is tenant t's real rows in shard k
+    (rows ≥ counts are padding, masked everywhere).  ``lams`` /
+    ``gap_targets`` (NaN = no target) are the per-tenant problem scalars
+    the vmapped drive ladder consumes as traced inputs."""
+
+    tenants: list                     # T tenant id strings
+    n: np.ndarray                     # (T,) real example counts
+    num_features: int                 # d, common
+    counts: np.ndarray                # (T, K) int64, host-side
+    lams: np.ndarray                  # (T,) float64 (host-exact λ)
+    gap_targets: np.ndarray           # (T,) float64, NaN = none
+    local_iters: int                  # H, common (the index-table width)
+    loss: str
+    smoothing: float
+    labels: "jax.Array"               # (T, K, n_shard)
+    mask: "jax.Array"                 # (T, K, n_shard)
+    sq_norms: "jax.Array"             # (T, K, n_shard)
+    X: "jax.Array"                    # (T, K, n_shard, d)
+    layout: str = "dense"
+
+    @property
+    def t(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def k(self) -> int:
+        return self.labels.shape[1]
+
+    @property
+    def n_shard(self) -> int:
+        return self.labels.shape[2]
+
+    @property
+    def dtype(self):
+        return self.labels.dtype
+
+    def shard_arrays(self) -> dict:
+        """The (T, K, …) pytree the vmapped kernels consume."""
+        return {"labels": self.labels, "mask": self.mask,
+                "sq_norms": self.sq_norms, "X": self.X}
+
+    def tenant_ds(self, t: int) -> ShardedDataset:
+        """Tenant t's slab as a solo :class:`ShardedDataset` — the SAME
+        arrays (one slice, no rebuild), so the solo control path of the
+        fleet benchmark and the bit-identity tests train on bitwise the
+        data the fleet lane holds."""
+        return ShardedDataset(
+            layout="dense", n=int(self.n[t]),
+            num_features=self.num_features,
+            counts=np.asarray(self.counts[t], dtype=np.int64),
+            labels=self.labels[t], mask=self.mask[t],
+            sq_norms=self.sq_norms[t], X=self.X[t],
+        )
+
+
+def fleet_from_datasets(datasets: list, lams, gap_targets=None,
+                        tenants=None, local_iters: int = 1,
+                        loss: str = "hinge",
+                        smoothing: float = 1.0) -> FleetDataset:
+    """Stack already-built solo :class:`ShardedDataset`\\ s into a fleet —
+    the programmatic entry (one-vs-rest heads over a shared dataset, test
+    harnesses, λ-path sweeps over one corpus).  All datasets must share
+    the dense layout and one (K, n_shard, d) static shape; ``lams`` is
+    the per-tenant λ, ``gap_targets`` per-tenant or None, ``local_iters``
+    the common H the caller's Params will run."""
+    import jax.numpy as jnp
+
+    if not datasets:
+        raise ValueError("fleet_from_datasets needs at least one dataset")
+    shapes = sorted({(d.layout, d.k, d.n_shard, d.num_features)
+                     for d in datasets})
+    if len(shapes) > 1 or shapes[0][0] != "dense":
+        raise ValueError(
+            f"fleet datasets must share one dense (K, n_shard, d) static "
+            f"shape; got {shapes} — pad to a common shape or split the "
+            f"fleet (sparse tenants are not in the fleet v1 surface)")
+    t_count = len(datasets)
+    # jaxlint: allow=f64 -- host-exact per-tenant λ staging: the traced
+    # f32 λ·n is derived from this (solvers/fleet.py bit-parity contract)
+    lams = np.asarray(lams, dtype=np.float64)
+    if lams.shape != (t_count,):
+        raise ValueError(f"lams must be one λ per tenant "
+                         f"({t_count}), got shape {lams.shape}")
+    gaps = (np.full(t_count, np.nan) if gap_targets is None
+            else np.asarray([np.nan if g is None else float(g)
+                             # jaxlint: allow=f64 -- host-side target list
+                             for g in gap_targets], dtype=np.float64))
+    return FleetDataset(
+        tenants=(list(tenants) if tenants is not None
+                 else [f"tenant-{i:04d}" for i in range(t_count)]),
+        n=np.array([d.n for d in datasets], dtype=np.int64),
+        num_features=datasets[0].num_features,
+        counts=np.stack([np.asarray(d.counts) for d in datasets]
+                        ).astype(np.int64),
+        lams=lams, gap_targets=gaps, local_iters=int(local_iters),
+        loss=loss, smoothing=float(smoothing),
+        labels=jnp.stack([d.labels for d in datasets]),
+        mask=jnp.stack([d.mask for d in datasets]),
+        sq_norms=jnp.stack([d.sq_norms for d in datasets]),
+        X=jnp.stack([d.X for d in datasets]),
+    )
+
+
+def build_fleet(specs: list, k: int, *, dtype=None,
+                local_iter_frac: float = 1.0,
+                default_gap_target: Optional[float] = None) -> FleetDataset:
+    """Stack the tenants of ``specs`` into one :class:`FleetDataset`.
+
+    Enforces the fleet's static-shape contract LOUDLY (with the numbers):
+    every tenant must resolve to the dense layout at a common d and a
+    common H = max(1, localIterFrac·n/K); differing loss phases are
+    rejected (uniformity — see the module docstring).  n may vary: shards
+    pad to the fleet-max ``n_shard`` (masked rows, exact)."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+    if not specs:
+        raise ValueError("build_fleet needs at least one tenant")
+    np_dtype = np.dtype(dtype)
+
+    losses_seen = sorted({(s.loss, float(s.smoothing)) for s in specs})
+    if len(losses_seen) > 1:
+        raise ValueError(
+            f"fleet tenants must share one loss phase (a per-tenant loss "
+            f"would make every vmapped lane pay every branch); manifest "
+            f"mixes {losses_seen} — split the fleet by loss")
+
+    parsed = [parse_dataset_ref(s.dataset, s.num_features) for s in specs]
+    ds_d = sorted({p.num_features for p in parsed})
+    if len(ds_d) > 1:
+        raise ValueError(
+            f"fleet tenants must share one feature dimension d (the "
+            f"stacked (T, K, n_shard, d) slab is one static shape); "
+            f"manifest mixes d={ds_d}")
+    d = ds_d[0]
+
+    hs = {}
+    for s, p in zip(specs, parsed):
+        hs.setdefault(max(1, int(local_iter_frac * p.n / k)),
+                      []).append(s.tenant)
+    if len(hs) > 1:
+        raise ValueError(
+            f"fleet tenants must share one H = max(1, localIterFrac·n/K) "
+            f"(the index-table width is one static shape); manifest "
+            f"yields H={ {h: v[:3] for h, v in sorted(hs.items())} } — "
+            f"pad tenant datasets to a common n or split the fleet")
+    h = next(iter(hs))
+
+    t_count = len(specs)
+    sizes = [split_sizes(p.n, k) for p in parsed]
+    for s, p, sz in zip(specs, parsed, sizes):
+        if np.any(sz <= 0):
+            raise ValueError(
+                f"fleet tenant {s.tenant!r}: every shard needs at least "
+                f"one example; n={p.n} over K={k} shards gives sizes "
+                f"{sz.tolist()} — lower numSplits")
+    n_shard = pad_rows(int(max(int(sz.max()) for sz in sizes)))
+
+    labels = np.zeros((t_count, k, n_shard), np_dtype)
+    mask = np.zeros((t_count, k, n_shard), np_dtype)
+    sq = np.zeros((t_count, k, n_shard), np_dtype)
+    x = np.zeros((t_count, k, n_shard, d), np_dtype)
+    for ti, p in enumerate(parsed):
+        offsets = np.concatenate([[0], np.cumsum(sizes[ti])])
+        row_nnz = np.diff(p.indptr)
+        row_sq = segment_sq_norms(p.values, p.indptr)
+        for s in range(k):
+            slab = _build_shard_slabs(
+                p, int(offsets[s]), int(offsets[s + 1]), n_shard, "dense",
+                np_dtype, d, 0, row_nnz, row_sq)
+            labels[ti, s] = slab["labels"]
+            mask[ti, s] = slab["mask"]
+            sq[ti, s] = slab["sq_norms"]
+            x[ti, s] = slab["X"]
+
+    gaps = np.array(
+        [np.nan if (s.gap_target is None and default_gap_target is None)
+         else (s.gap_target if s.gap_target is not None
+               else default_gap_target)
+         # jaxlint: allow=f64 -- host-side target staging (cast at use)
+         for s in specs], dtype=np.float64)
+    return FleetDataset(
+        tenants=[s.tenant for s in specs],
+        n=np.array([p.n for p in parsed], dtype=np.int64),
+        num_features=d,
+        counts=np.stack(sizes).astype(np.int64),
+        # jaxlint: allow=f64 -- host-exact λ staging (see fleet_from_datasets)
+        lams=np.array([s.lam for s in specs], dtype=np.float64),
+        gap_targets=gaps,
+        local_iters=h,
+        loss=specs[0].loss,
+        smoothing=float(specs[0].smoothing),
+        labels=jnp.asarray(labels),
+        mask=jnp.asarray(mask),
+        sq_norms=jnp.asarray(sq),
+        X=jnp.asarray(x),
+    )

@@ -77,6 +77,7 @@ def eval_metrics(
     w, alpha, shard_arrays, lam, n, mesh=None,
     test_shard_arrays=None, test_n: int = 0,
     loss: str = "hinge", smoothing: float = 1.0,
+    inv_n=None,
 ):
     """Jit-traceable fused evaluation: (primal, gap, test_error) as one
     stacked device array — a single fan-out over the training data (plus one
@@ -88,7 +89,17 @@ def eval_metrics(
 
     ``test_error`` is NaN when no test set is given; ``gap`` is NaN for
     primal-only solvers (``alpha=None`` — SGD / DistGD have no dual state).
+
+    ``inv_n`` (the fleet path, solvers/fleet.py): a precomputed — possibly
+    TRACED, per-tenant — 1/n scalar replacing the ``/ n`` division.  The
+    static path's jit folds division by the constant n into one f32
+    reciprocal multiply; a traced n cannot be folded, so the fleet passes
+    the same f32 reciprocal explicitly — which is what keeps a T=1 fleet
+    eval bit-identical to the solo certificate (tests/test_fleet.py).
     """
+    def over_n(x):
+        return x / n if inv_n is None else x * inv_n
+
     w_norm_sq = w @ w
     if alpha is not None:
 
@@ -102,8 +113,8 @@ def eval_metrics(
                                jnp.sum(dual_vals * mask)]),)
 
         (sums,) = fanout(per_shard, mesh, w, alpha, shard_arrays)
-        primal = sums[0] / n + 0.5 * lam * w_norm_sq
-        dual = -0.5 * lam * w_norm_sq + sums[1] / n
+        primal = over_n(sums[0]) + 0.5 * lam * w_norm_sq
+        dual = -0.5 * lam * w_norm_sq + over_n(sums[1])
         gap = primal - dual
     else:
 
@@ -114,7 +125,7 @@ def eval_metrics(
             return (jnp.sum(vals * shard["mask"]),)
 
         (loss_sum,) = fanout(per_shard, mesh, w, shard_arrays)
-        primal = loss_sum / n + 0.5 * lam * w_norm_sq
+        primal = over_n(loss_sum) + 0.5 * lam * w_norm_sq
         gap = jnp.asarray(jnp.nan, primal.dtype)
 
     if test_shard_arrays is not None:

@@ -52,6 +52,36 @@ def coef_divisor(mode: str, lam_n: float) -> float:
     return 1.0 if mode == "prox" else lam_n
 
 
+def _coef_staging(mode: str, lam, n, lam_n, dtype):
+    """The one λn/coefficient staging shared by :func:`local_sdca` and
+    :func:`local_sdca_fast` (bit-parity-critical — a fix to one path
+    must never miss the other).  Returns ``(lam_n, coef_of)``:
+
+    - static path (``lam_n is None``): λn and the divisor are baked-in
+      constants from ``lam * n`` — the original arithmetic, untouched;
+    - traced path: ``lam_n`` arrives precomputed (possibly per-tenant,
+      solvers/fleet.py) and ``coef_of`` MIRRORS XLA's
+      divide-by-constant rewrite — the static path's jit folds /λn into
+      ·(1/λn) (one f32 reciprocal), so the traced twin multiplies by
+      the same f32 reciprocal, computed once at the kernel head, which
+      is what keeps a traced-λn fleet lane bit-identical to the solo
+      executable (tests/test_fleet.py)."""
+    if lam_n is None:
+        lam_n = jnp.asarray(lam * n, dtype)
+        coef_div = jnp.asarray(coef_divisor(mode, lam * n), dtype)
+
+        def coef_of(y, delta):
+            return y * delta / coef_div
+    else:
+        lam_n = jnp.asarray(lam_n, dtype)
+        inv = (jnp.asarray(1.0, dtype) if mode == "prox"
+               else jnp.asarray(1.0, dtype) / lam_n)
+
+        def coef_of(y, delta):
+            return y * delta * inv
+    return lam_n, coef_of
+
+
 def local_sdca(
     w_init: jax.Array,     # (d,) shared primal vector (replicated)
     alpha: jax.Array,      # (n_shard,) local dual variables
@@ -63,12 +93,21 @@ def local_sdca(
     sigma: float = 1.0,    # sigma' = K * gamma, used by mode=="plus"
     loss: str = "hinge",
     smoothing: float = 1.0,
+    lam_n=None,
 ):
     """Run H sequential SDCA steps.  Returns (delta_alpha, delta_w).
 
     With ``loss="hinge"`` matches the reference bit-for-bit in x64 given the
     same index sequence (validated against tests/oracle.py); the dual-ascent
     coordinate update for other losses comes from ops/losses.py.
+
+    ``lam_n`` (the fleet path, solvers/fleet.py): a precomputed —
+    possibly TRACED — λ·n scalar overriding the ``lam * n`` computed
+    here, so ONE compiled kernel can serve every tenant of a vmapped
+    fleet; ``sigma`` may then be traced too.  The host computes the
+    override as float32(float64(λ)·n) — exactly the value the static
+    path's cast produces — which is what keeps a T=1 fleet run
+    bit-identical to the solo path.
     """
     if mode not in MODES:
         raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
@@ -76,8 +115,7 @@ def local_sdca(
     labels = shard["labels"]
     sq_norms = shard["sq_norms"]
     dtype = w_init.dtype
-    lam_n = jnp.asarray(lam * n, dtype)
-    coef_div = jnp.asarray(coef_divisor(mode, lam * n), dtype)
+    lam_n, coef_of = _coef_staging(mode, lam, n, lam_n, dtype)
     sigma_c = jnp.asarray(sigma, dtype)
     one = jnp.asarray(1.0, dtype)
 
@@ -97,7 +135,7 @@ def local_sdca(
         new_a = losses.alpha_step(loss, a, y * margin, qii, lam_n,
                                   smoothing=smoothing)
 
-        coef = y * (new_a - a) / coef_div
+        coef = coef_of(y, new_a - a)
         dw = row_axpy(row, coef, dw)
         if mode == "cocoa":
             w = row_axpy(row, coef, w)  # local view advances (CoCoA.scala:182-184)
@@ -148,6 +186,7 @@ def local_sdca_fast(
     sigma: float = 1.0,
     loss: str = "hinge",
     smoothing: float = 1.0,
+    lam_n=None,
 ):
     """Fast-math variant of :func:`local_sdca`: the per-step w dot is
     replaced by the precomputed round margin plus an incremental Δw dot
@@ -156,15 +195,17 @@ def local_sdca_fast(
     to ~1e-6 rather than bit-exactly.  Returns (delta_alpha, delta_w).
 
     The frozen mode skips the Δw dot entirely — its only sequential state is
-    alpha itself.
+    alpha itself.  ``lam_n``: the fleet path's traced λ·n override — same
+    contract as on :func:`local_sdca` (``sigma`` may then be traced too;
+    ``mode_factors`` passes a traced σ′ through untouched for the plus
+    mode the fleet runs).
     """
     losses.validate(loss, smoothing)
     sig_eff, qii_factor = mode_factors(mode, sigma)
     labels = shard["labels"]
     sq_norms = shard["sq_norms"]
     dtype = margins0.dtype
-    lam_n = jnp.asarray(lam * n, dtype)
-    coef_div = jnp.asarray(coef_divisor(mode, lam * n), dtype)
+    lam_n, coef_of = _coef_staging(mode, lam, n, lam_n, dtype)
     sig_c = jnp.asarray(sig_eff, dtype)
     qf = jnp.asarray(qii_factor, dtype)
 
@@ -182,7 +223,7 @@ def local_sdca_fast(
         new_a = losses.alpha_step(loss, a, y * margin, qii, lam_n,
                                   smoothing=smoothing)
 
-        coef = y * (new_a - a) / coef_div
+        coef = coef_of(y, new_a - a)
         dw = row_axpy(row, coef, dw)
         a_vec = a_vec.at[idx].set(new_a)
         return dw, a_vec

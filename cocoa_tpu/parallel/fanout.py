@@ -231,6 +231,39 @@ def chunk_fanout(
     return w, carry
 
 
+def lane_fanout(per_lane: Callable, lane_exec: str = "vmap",
+                idx_axis: Optional[int] = None) -> Callable:
+    """Batch a per-tenant traceable over the fleet's leading tenant axis
+    (solvers/base.py ``_build_fleet_run``).
+
+    ``per_lane(state_t, chunk, data_t, scal_t) -> state_t`` sees ONE
+    tenant; the returned callable takes the stacked (T, ...) pytrees.
+    ``idx_axis`` names the chunk table's tenant axis (None = one table
+    shared by every lane).  ``lane_exec``:
+
+    - ``"vmap"`` — lanes batch into one vectorized body (the throughput
+      mode; batched reductions may round ~1 ulp away from the solo
+      executable at T > 1);
+    - ``"map"`` — lanes run sequentially via ``lax.scan`` inside the
+      same jit (``lax.map``): each lane's body is the solo HLO exactly —
+      the bit-parity mode (same one-compile/one-dispatch amortization).
+    """
+    if lane_exec not in ("vmap", "map"):
+        raise ValueError(f"lane_exec must be vmap|map, got {lane_exec!r}")
+    if lane_exec == "vmap":
+        return jax.vmap(per_lane, in_axes=(0, idx_axis, 0, 0))
+    import jax.numpy as jnp
+
+    def mapped(state, chunk, data, scal):
+        if idx_axis is not None:
+            ch = jnp.moveaxis(chunk, idx_axis, 0)
+            return lax.map(lambda a: per_lane(*a), (state, ch, data, scal))
+        return lax.map(lambda a: per_lane(a[0], chunk, a[1], a[2]),
+                       (state, data, scal))
+
+    return mapped
+
+
 def mesh_of(*arrays) -> Optional[Mesh]:
     """Infer the dp mesh from array placement (None ⇒ local/vmap path).
 

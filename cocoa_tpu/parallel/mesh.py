@@ -44,6 +44,8 @@ except ImportError:  # older jax: dp-only meshes work; fp needs AxisType
 
 DP_AXIS = "dp"
 FP_AXIS = "fp"
+TENANT_AXIS = "tenant"   # the fleet's spare axis: independent models,
+                         # not shards — no collective ever crosses it
 
 
 def make_mesh(
@@ -152,6 +154,87 @@ def x_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(
         mesh, P(DP_AXIS, None, FP_AXIS if has_fp(mesh) else None)
     )
+
+
+# --- fleet: regex-rule partition specs over the tenant axis -----------------
+#
+# The fleet path (solvers/fleet.py) stacks T independent tenants on a
+# leading axis of every state and data leaf.  Placement is described the
+# way large-model codebases describe theirs (SNIPPETS.md [2]
+# ``match_partition_rules``): an ordered list of (regex, PartitionSpec)
+# rules matched against each leaf's '/'-joined tree path, first match
+# wins.  Because tenants are INDEPENDENT (no collective crosses the
+# tenant axis), the whole rule set is one idea — "shard the leading T
+# axis, replicate the rest" — and the regex form exists so future
+# composite meshes (tenant × dp) can grow per-leaf exceptions without
+# touching the solver.
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """Map every leaf of ``tree`` to the PartitionSpec of the first rule
+    whose regex searches its '/'-joined path (the SNIPPETS.md [2] idiom).
+    Raises on an unmatched leaf — a silent default is how a new state
+    leaf ends up replicated across a thousand tenants."""
+    import re
+
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def assign(path, leaf):
+        name = _path_str(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                return spec
+        raise ValueError(
+            f"no partition rule matches tree path {name!r}; add a rule "
+            f"(the catch-all '.*' usually belongs at the end)")
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def fleet_partition_rules(tree) -> tuple:
+    """The fleet rule set: every leaf with a leading tenant axis shards
+    that axis; per-tenant scalars ((T,) leaves) likewise; anything else
+    would be a bug — tenants share nothing."""
+    del tree  # one rule covers the whole fleet state/data surface today
+    return ((r".*", P(TENANT_AXIS)),)
+
+
+def make_fleet_mesh(t_devices: Optional[int] = None,
+                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-axis ``tenant`` mesh over ``t_devices`` chips: the fleet's
+    (T, ...) slabs split T-major across it via
+    :func:`fleet_shardings`, each chip running its T/D lanes of the one
+    compiled round.  ``t_devices=1`` is the degenerate single-chip
+    control — the pure-vmap path, bit-identical by construction."""
+    devices = list(devices if devices is not None else jax.devices())
+    t_devices = len(devices) if t_devices is None else int(t_devices)
+    if t_devices > len(devices):
+        raise ValueError(f"fleet mesh needs {t_devices} devices, have "
+                         f"{len(devices)}")
+    return jax.make_mesh((t_devices,), (TENANT_AXIS,),
+                         devices=devices[:t_devices])
+
+
+def fleet_shardings(mesh: Mesh, tree):
+    """NamedShardings for a fleet pytree from the regex rules — the
+    device_put map for state, shard slabs, and per-tenant scalars."""
+    specs = match_partition_rules(fleet_partition_rules(tree), tree)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def pad_features(d: int, mesh: Optional[Mesh]) -> int:

@@ -3,3 +3,4 @@ from cocoa_tpu.solvers.minibatch_cd import run_minibatch_cd  # noqa: F401
 from cocoa_tpu.solvers.sgd import run_sgd  # noqa: F401
 from cocoa_tpu.solvers.dist_gd import run_dist_gd  # noqa: F401
 from cocoa_tpu.solvers.prox_cocoa import run_prox_cocoa  # noqa: F401
+from cocoa_tpu.solvers.fleet import FleetResult, run_cocoa_fleet  # noqa: F401

@@ -1667,6 +1667,353 @@ def drive_device_paths(
     )
 
 
+# --- fleet: the vmapped drive* ladder (--fleet, round 18) -------------------
+#
+# T independent tenants (per-tenant λ / dataset / gap target) run as ONE
+# compiled round loop: every solver-state leaf, the sched vector, the
+# accel hist bank, and the gap watch grow a leading T axis, the
+# per-tenant chunk/eval kernels ride a jax.vmap over that axis, and the
+# whole fleet anneals, extrapolates, and certifies inside one
+# lax.while_loop — one dispatch, one compile, one fetch for the entire
+# fleet.  Certified tenants MASK OUT of the update: the chunk still
+# computes their lane (a masked lane, not a dispatch), but a lane-wise
+# jnp.where discards its result so a finished tenant's (w, α, hist,
+# sched) is bitwise-frozen from the eval that certified it, and the
+# loop's stop predicate is the conjunction of per-tenant done flags.
+#
+# Independence argument: the adding-vs-averaging machinery
+# (arXiv:1502.03508) makes every tenant's σ′/γ scaling self-contained —
+# no cross-tenant term exists anywhere in the round — and the general
+# CoCoA framework (arXiv:1611.02189) is local-solver/objective agnostic,
+# so the per-tenant duality-gap certificate is exactly the solo
+# certificate evaluated on that lane's (w, α).  A T=1 fleet run is
+# bit-identical to the solo path (pinned by tests/test_fleet.py): the
+# per-tenant kernels receive λ·n and σ′ as TRACED scalars carrying
+# exactly the float32 values the solo path bakes in as constants, and
+# IEEE arithmetic does not distinguish the two.
+#
+# The σ′ anneal ladder lowers from branch selection to data here: the
+# solo path statically specializes one chunk kernel per σ′ stage and
+# lax.switches between them, but a vmapped switch with a batched index
+# executes EVERY branch for EVERY lane — so the fleet kernel instead
+# reads σ′ = levels[stage_t] from the (L,) ladder array (same f32
+# values, same update arithmetic) and one kernel serves every stage of
+# every tenant.  Docs: docs/DESIGN.md §16 "Fleet execution model".
+
+FLEET_N_COLS = 7   # the solo traj row layout, per tenant
+
+
+def _build_fleet_run(chunk_kernel, eval_kernel, n_state,
+                     per_tenant_idxs=False, stall_evals=STALL_EVALS,
+                     divergence_guard=True, n_stages=0, accel=False,
+                     jump_kernel=None, lane_exec="vmap"):
+    """The fleet twin of :func:`_build_device_run`: one jitted
+    while_loop advancing every tenant lane per chunk.
+
+    ``chunk_kernel(state_t, idxs_ckh, data_t, scal_t) -> state_t`` and
+    ``eval_kernel(state_t, data_t, scal_t) -> (3,)`` are PER-TENANT
+    traceables (solvers/fleet.py builds them with traced λ·n/σ′ from the
+    ``scal_t`` leaves); the batching over T happens here.
+
+    ``lane_exec`` picks how tenant lanes execute inside the loop:
+
+    - ``"vmap"`` (the throughput default): the hot chunk path batches
+      across lanes — on CPU the per-step row ops vectorize across the
+      whole fleet.  Batched reductions may round differently from the
+      solo executable by ~1 ulp at T > 1 (a batched dot's accumulation
+      order is the backend's choice), so per-lane trajectories match
+      solo to ulps, bit-exactly at T=1.
+    - ``"map"`` — lanes run sequentially via ``lax.map`` inside the SAME
+      single compiled while_loop: each lane's body is the solo HLO
+      exactly, so every lane is bit-identical to its solo run at ANY T
+      (the parity/debug mode; pinned by tests/test_fleet.py).  The
+      compile/dispatch amortization — the fleet's headline win — is
+      identical in both modes.
+
+    The EVAL (and the accel ``jump_kernel``, when given) always ride
+    ``lax.map``: the certificate reduction is the bit-sensitive piece,
+    and per-lane evaluation keeps it the solo computation.  The watch
+    vectors (done/stall/best/cert) are explicit donated arguments so
+    super-block chaining carries them across dispatches without a
+    recompile."""
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    check_div = divergence_guard
+    anneal = check_div and n_stages > 1
+    idx_axis = 1 if per_tenant_idxs else None
+
+    @functools.partial(jax.jit, donate_argnums=tuple(range(7 + n_state)))
+    def run(done_tgt0, done_stall0, stall0, best0, best_prev0, cert0,
+            stall_chunk0, *args):
+        state0 = args[:n_state]
+        idxs_all, shard_arrays, scal, tgts = args[n_state:]
+        n_chunks = jax.tree.leaves(idxs_all)[0].shape[0]
+        t_fleet = tgts.shape[0]
+
+        from cocoa_tpu.parallel.fanout import lane_fanout
+
+        vchunk = lane_fanout(chunk_kernel, lane_exec=lane_exec,
+                             idx_axis=idx_axis)
+
+        def veval(state, data, scal_):
+            return lax.map(lambda a: eval_kernel(*a), (state, data, scal_))
+
+        def vjump(state, data, scal_):
+            return lax.map(lambda a: jump_kernel(*a), (state, data, scal_))
+
+        def bmask(flag, like):
+            return flag.reshape(flag.shape + (1,) * (like.ndim - 1))
+
+        def cond(s):
+            i, done_tgt, done_stall = s[0], s[1], s[2]
+            return ((i < n_chunks)
+                    & jnp.logical_not(jnp.all(done_tgt | done_stall)))
+
+        def body(s):
+            (i, done_tgt, done_stall, stall, best, best_prev, cert,
+             stall_chunk, state, traj) = s
+            done0 = done_tgt | done_stall
+            if jump_kernel is not None:
+                # the accel secant jump, per lane at the chunk head —
+                # the solo accel_kernel's position and arithmetic (an
+                # unarmed or done lane's jump is the identity)
+                state = vjump(state, shard_arrays, scal)
+            chunk = jax.tree.map(lambda a: a[i], idxs_all)
+            new_state = vchunk(state, chunk, shard_arrays, scal)
+            # finished-tenant masking: a done lane's whole state is
+            # bitwise-frozen — the lane still computes, its result is
+            # discarded; live lanes see exactly the solo update
+            state = tuple(
+                jnp.where(bmask(done0, nw), o, nw)
+                for o, nw in zip(state, new_state))
+            metrics = veval(state, shard_arrays, scal)   # (T, 3)
+            gap = metrics[:, 1]
+            # the solo body's done_tgt, lane-wise (a frozen lane's gap
+            # re-evaluates identically, so done_now stays true for it)
+            done_now = (gap <= tgts) | done0
+            newly = (gap <= tgts) & jnp.logical_not(done0)
+            nans = jnp.full((t_fleet,), jnp.nan, metrics.dtype)
+            if anneal:
+                # per-tenant σ′ schedule/watch — the solo anneal branch
+                # with every scalar a (T,) column; frozen lanes keep
+                # their sched head bitwise (the watch must not keep
+                # counting a lane that stopped updating)
+                sched = state[-1]
+                gv = jnp.where(jnp.isnan(gap), jnp.inf,
+                               gap).astype(jnp.float32)
+                stg, stl = sched[:, 0], sched[:, 1]
+                bst, bpv = sched[:, 2], sched[:, 3]
+                bst, bpv, stl = _watch_update(jnp, gv, bst, bpv, stl,
+                                              jnp.float32(STALL_REL))
+                fired = stl >= jnp.float32(stall_evals)
+                bo = (fired & (stg < jnp.float32(n_stages - 1))
+                      & jnp.logical_not(done_now))
+                inf32 = jnp.float32(jnp.inf)
+                stg = jnp.where(bo, stg + 1, stg)
+                stl = jnp.where(bo, jnp.float32(0), stl)
+                bst = jnp.where(bo, inf32, bst)
+                bpv = jnp.where(bo, inf32, bpv)
+                head = jnp.stack([stg, stl, bst, bpv, sched[:, 4]],
+                                 axis=1)
+                sched_new = (jnp.concatenate(
+                    [head, sched[:, SCHED_LEN:]], axis=1)
+                    if accel else head)
+                sched_new = jnp.where(done0[:, None], sched, sched_new)
+                state = (*state[:-1], sched_new)
+                extra = jnp.stack([stg, stl], axis=1).astype(metrics.dtype)
+            elif check_div:
+                # per-tenant no-improvement watch; only gap-targeted
+                # lanes can stop diverged (the solo guard is tied to a
+                # target's existence — lane-wise here)
+                gv = jnp.where(jnp.isnan(gap),
+                               jnp.asarray(jnp.inf, best.dtype), gap)
+                bst, bpv, stl = _watch_update(jnp, gv, best, best_prev,
+                                              stall, STALL_REL)
+                best = jnp.where(done0, best, bst)
+                best_prev = jnp.where(done0, best_prev, bpv)
+                stall = jnp.where(done0, stall, stl)
+                has_tgt = tgts > -jnp.inf
+                newly_stalled = ((stall >= stall_evals) & has_tgt
+                                 & jnp.logical_not(done_now)
+                                 & jnp.logical_not(done_stall))
+                done_stall = done_stall | newly_stalled
+                # the eval a lane stalled OUT at (1-based chunk index;
+                # 0 = never) — what lets the host decode a per-eval
+                # still-training count without re-deriving the watch
+                stall_chunk = jnp.where(newly_stalled, i + jnp.int32(1),
+                                        stall_chunk)
+                extra = jnp.stack([nans, stall.astype(metrics.dtype)],
+                                  axis=1)
+            else:
+                extra = jnp.stack([nans, jnp.zeros_like(nans)], axis=1)
+            if accel:
+                # the per-tenant secant window bookkeeping — the solo
+                # accel branch with (T,) columns.  done_now gates every
+                # action exactly as the solo done_tgt does, which is
+                # also what freezes an already-done lane's tail.  The
+                # fleet runs the fixed-Θ ladder (n_theta == 1): the Θ
+                # slots ride unchanged.
+                sched = state[-1]
+                gv = jnp.where(jnp.isnan(gap), jnp.inf,
+                               gap).astype(jnp.float32)
+                hl, rst, lg = (sched[:, A_HIST], sched[:, A_RESTARTS],
+                               sched[:, A_LASTGAP])
+                restart = (gv > lg) & jnp.logical_not(done_now)
+                arm = ((hl >= jnp.float32(2)) & jnp.logical_not(restart)
+                       & jnp.logical_not(done_now))
+                rst = jnp.where(restart, rst + 1, rst)
+                hl = jnp.where(
+                    done_now, hl,
+                    jnp.where(arm, jnp.float32(0),
+                              jnp.where(restart, jnp.float32(1),
+                                        jnp.minimum(hl + 1,
+                                                    jnp.float32(2)))))
+                jmp = jnp.where(arm, jnp.float32(1), jnp.float32(0))
+                lg = jnp.where(done_now, lg, gv)
+                push = jnp.logical_not(arm) & jnp.logical_not(done_now)
+                if anneal:
+                    # a committed σ′ backoff is a round-map seam: same
+                    # bank cap as the solo device loop
+                    hl = jnp.where(bo, jnp.minimum(hl, jnp.float32(1)),
+                                   hl)
+                tail = jnp.stack(
+                    [hl, jmp, rst, lg, sched[:, A_TH_STAGE],
+                     sched[:, A_TH_STALL], sched[:, A_TH_BEST],
+                     sched[:, A_TH_BPREV]], axis=1)
+                hist_leaf = jnp.where(
+                    push[:, None, None, None],
+                    jnp.stack([state[2][:, 1], state[1]], axis=1),
+                    state[2])
+                state = (state[0], state[1], hist_leaf,
+                         jnp.concatenate([sched[:, :SCHED_LEN], tail],
+                                         axis=1))
+                extra2 = jnp.stack(
+                    [sched[:, A_TH_STAGE], rst],
+                    axis=1).astype(metrics.dtype)
+            else:
+                extra2 = jnp.stack([nans, nans], axis=1)
+            done_tgt = done_tgt | newly
+            cert = jnp.where(newly, i + jnp.int32(1), cert)
+            row = jnp.concatenate([metrics, extra, extra2], axis=1)
+            traj = lax.dynamic_update_index_in_dim(traj, row, i, 0)
+            return (i + jnp.int32(1), done_tgt, done_stall, stall, best,
+                    best_prev, cert, stall_chunk, state, traj)
+
+        traj0 = jnp.full((n_chunks, t_fleet, FLEET_N_COLS), jnp.nan,
+                         dtype=state0[0].dtype)
+        (i, done_tgt, done_stall, stall, best, best_prev, cert,
+         stall_chunk, state, traj) = lax.while_loop(
+            cond, body,
+            (jnp.int32(0), done_tgt0, done_stall0, stall0, best0,
+             best_prev0, cert0, stall_chunk0, state0, traj0))
+        return (i, done_tgt, done_stall, stall, best, best_prev, cert,
+                stall_chunk, state, traj)
+
+    return run
+
+
+class FleetCarry:
+    """The per-tenant watch vectors chained across fleet super-block
+    dispatches (all donated run arguments; fresh via :meth:`init`).
+    ``cert_chunk`` / ``stall_chunk`` record the 1-based eval a lane
+    certified / stalled out at (0 = never) — what the host decodes
+    per-eval active-lane counts and per-tenant outcomes from."""
+
+    def __init__(self, done_tgt, done_stall, stall, best, best_prev,
+                 cert_chunk, stall_chunk):
+        self.done_tgt = done_tgt
+        self.done_stall = done_stall
+        self.stall = stall
+        self.best = best
+        self.best_prev = best_prev
+        self.cert_chunk = cert_chunk
+        self.stall_chunk = stall_chunk
+
+    @classmethod
+    def init(cls, t: int, dtype):
+        import jax.numpy as jnp
+
+        return cls(
+            jnp.zeros((t,), bool), jnp.zeros((t,), bool),
+            jnp.zeros((t,), jnp.int32),
+            jnp.full((t,), jnp.inf, dtype),
+            jnp.full((t,), jnp.inf, dtype),
+            jnp.zeros((t,), jnp.int32), jnp.zeros((t,), jnp.int32))
+
+    def args(self):
+        return (self.done_tgt, self.done_stall, self.stall, self.best,
+                self.best_prev, self.cert_chunk, self.stall_chunk)
+
+
+def drive_fleet_on_device(
+    name: str,
+    state: tuple,
+    chunk_kernel: Callable,   # per-tenant: (state, idxs_ckh, data, scal)
+    eval_kernel: Callable,    # per-tenant: (state, data, scal) -> (3,)
+    idxs_all,                 # (n_chunks, C, [T,] K, H) int32 tables
+    shard_arrays,             # (T, K, ...) pytree
+    scal,                     # (T,) per-tenant scalar pytree (λ·n, ...)
+    gap_targets,              # (T,) targets in state dtype, -inf = none
+    quiet: bool = False,
+    start_round: int = 1,
+    cache_key=None,
+    stall_evals: int = STALL_EVALS,
+    divergence_guard: bool = True,
+    n_stages: int = 0,
+    accel: bool = False,
+    per_tenant_idxs: bool = False,
+    carry: Optional["FleetCarry"] = None,
+    jump_kernel: Optional[Callable] = None,
+    lane_exec: str = "vmap",
+):
+    """Dispatch one fleet super-block: every chunk, every per-tenant
+    eval, the per-tenant anneal/accel schedules, the per-tenant gap
+    watch, and the all-lanes-done stop test ride ONE ``lax.while_loop``
+    in one jit — one dispatch and one host fetch for the whole fleet.
+
+    Returns ``(state, carry, n_done, traj_host)``: ``carry`` holds the
+    per-tenant done/watch/cert vectors (chainable into the next block —
+    the executable is cached per ``cache_key``, so a multi-block fleet
+    still compiles exactly once), ``traj_host`` is the fetched
+    ``(n_done, T, FLEET_N_COLS)`` eval buffer in the solo row layout."""
+    from cocoa_tpu.analysis import sanitize as _sanitize
+
+    t_fleet = int(gap_targets.shape[0])
+    if carry is None:
+        carry = FleetCarry.init(t_fleet, state[0].dtype)
+    n_state = len(state)
+    run_key = None if cache_key is None else ("fleet", cache_key)
+    run = _DEVICE_RUNS.get(run_key) if run_key is not None else None
+    if run is None:
+        run = _build_fleet_run(
+            chunk_kernel, eval_kernel, n_state,
+            per_tenant_idxs=per_tenant_idxs, stall_evals=stall_evals,
+            divergence_guard=divergence_guard, n_stages=n_stages,
+            accel=accel, jump_kernel=jump_kernel, lane_exec=lane_exec)
+        if run_key is not None:
+            _DEVICE_RUNS[run_key] = run
+    n_chunks = int(jax.tree.leaves(idxs_all)[0].shape[0])
+    c = int(jax.tree.leaves(idxs_all)[0].shape[1])
+    with _tracing.span("local_solve", algorithm=name, t0=start_round,
+                       round=start_round - 1 + n_chunks * c,
+                       rounds=n_chunks * c, cadence=c, tenants=t_fleet), \
+            _sanitize.device_loop_guard():
+        out = run(*carry.args(), *state, idxs_all, shard_arrays, scal,
+                  gap_targets)
+        (i, done_tgt, done_stall, stall, best, best_prev, cert,
+         stall_chunk, state, traj_buf) = out
+        # the single host sync of the whole fleet block
+        with _sanitize.intended_fetch("fleet_loop_fetch"):
+            n_done = int(i)
+            traj_host = np.asarray(traj_buf[:n_done])
+    carry = FleetCarry(done_tgt, done_stall, stall, best, best_prev,
+                       cert, stall_chunk)
+    return state, carry, n_done, traj_host
+
+
 class TsSampler:
     """Sampler adapter whose chunk tables also carry the round number.
 

@@ -74,6 +74,15 @@ EVENT_TYPES = (
                         # (--staleRounds, solvers/cocoa.StaleJoinWindow):
                         # round r's Δw applied at round t = r +
                         # rounds_late, rounds_late <= S by construction
+    "fleet_progress",   # one fleet eval boundary (--fleet,
+                        # solvers/fleet.py): live tenant lanes +
+                        # cumulative certifications; the final event of a
+                        # fleet run also carries models_per_second —
+                        # what feeds cocoa_fleet_tenants_active /
+                        # cocoa_fleet_models_per_second
+    "tenant_certified", # one tenant crossed its duality-gap target
+                        # inside the fleet's vmapped loop — what feeds
+                        # cocoa_tenants_certified_total
 )
 
 

@@ -58,6 +58,14 @@ otherwise one opaque device dispatch:
   staleness contributions joined late, labeled by how many rounds late
   (``--staleRounds``; the ``stale_join`` events — never exceeds S by
   construction, which makes the label set finite)
+- ``cocoa_fleet_tenants_active`` gauge — tenant lanes still training in
+  the current ``--fleet`` run (the ``fleet_progress`` events; certified
+  tenants mask out of the update, so this is the live-lane count)
+- ``cocoa_tenants_certified_total`` counter — tenants whose duality gap
+  crossed their target (the ``tenant_certified`` events)
+- ``cocoa_fleet_models_per_second`` gauge — the fleet run's headline
+  throughput: tenants certified per wall-clock second through the ONE
+  compiled vmapped round (carried by the final ``fleet_progress``)
 - ``cocoa_last_gap``            gauge   — most recent duality gap
 - ``cocoa_round_seconds``       histogram — observed per-round wall time
   (host-clock deltas between consecutive evals divided by the rounds
@@ -137,6 +145,9 @@ class MetricsWriter:
         self.overlap_wait_seconds = 0.0
         self.overlap_joins_total = 0
         self.stale_joins: dict = {}     # rounds_late -> count
+        self.fleet_tenants_active = None
+        self.tenants_certified_total = 0
+        self.fleet_models_per_second = None
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -240,6 +251,14 @@ class MetricsWriter:
             if late is not None:
                 self.stale_joins[int(late)] = (
                     self.stale_joins.get(int(late), 0) + 1)
+        elif ev == "fleet_progress":
+            if rec.get("active") is not None:
+                self.fleet_tenants_active = int(rec["active"])
+            if rec.get("models_per_second") is not None:
+                self.fleet_models_per_second = float(
+                    rec["models_per_second"])
+        elif ev == "tenant_certified":
+            self.tenants_certified_total += 1
 
     def _maybe_write(self, ev):
         """The write debounce (caller holds the lock): flush-now events
@@ -330,6 +349,19 @@ class MetricsWriter:
             lines += [f'cocoa_stale_joins_total{{rounds_late="{late}"}} '
                       f"{self.stale_joins[late]}"
                       for late in sorted(self.stale_joins)]
+        if self.fleet_tenants_active is not None:
+            # fleet families appear only once a --fleet run has reported
+            # (solo runs must not render zero-valued fleet series)
+            lines += ["# TYPE cocoa_fleet_tenants_active gauge",
+                      f"cocoa_fleet_tenants_active "
+                      f"{self.fleet_tenants_active}",
+                      "# TYPE cocoa_tenants_certified_total counter",
+                      f"cocoa_tenants_certified_total "
+                      f"{self.tenants_certified_total}"]
+            if self.fleet_models_per_second is not None:
+                lines += ["# TYPE cocoa_fleet_models_per_second gauge",
+                          f"cocoa_fleet_models_per_second "
+                          f"{self.fleet_models_per_second!r}"]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]

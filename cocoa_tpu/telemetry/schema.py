@@ -1,7 +1,7 @@
 """JSONL schema checker for the telemetry artifacts.
 
 One dependency-free validator shared by tests/test_telemetry.py and the CI
-telemetry step, covering the five JSONL dialects this repo emits:
+telemetry step, covering the six JSONL dialects this repo emits:
 
 - **event streams** (``--events``, telemetry/events.py): every line has
   ``event``/``seq``/``ts``, per-type required fields, and ``seq`` is
@@ -19,6 +19,9 @@ telemetry step, covering the five JSONL dialects this repo emits:
   telemetry/recorder.py): a ``flightrec_manifest`` header (dump reason,
   victim pid, ring size) followed by the last-N event records the ring
   held when the dump fired.
+- **fleet manifests** (``--fleet``, data/fleet.py): a ``fleet_manifest``
+  header followed by one tenant per line (dataset ref, λ, gap target) —
+  the loader validates through this checker before building anything.
 
 Usage: ``python -m cocoa_tpu.telemetry.schema FILE...`` — the dialect is
 sniffed per file from its first line; exit code 1 on any violation.
@@ -126,6 +129,34 @@ EVENT_FIELDS = {
     "stale_join": {"algorithm": (str,), "t": (int,), "round": (int,),
                    "rounds_late": (int,),
                    "workers": (int, type(None))},
+    # one fleet eval boundary (--fleet, solvers/fleet.py): how many
+    # tenant lanes are still live and how many have certified — what
+    # feeds cocoa_fleet_tenants_active / cocoa_fleet_models_per_second
+    # (models_per_second rides only the final event, once the wall-clock
+    # denominator exists)
+    "fleet_progress": {"algorithm": (str,), "t": (int,),
+                       "active": (int,), "certified_total": (int,),
+                       "models_per_second": _OPT_NUM},
+    # one tenant crossed its duality-gap target inside the fleet loop —
+    # what feeds cocoa_tenants_certified_total
+    "tenant_certified": {"algorithm": (str,), "tenant": (str,),
+                         "t": (int,), "gap": _OPT_NUM},
+}
+
+# --fleet manifest dialect (data/fleet.py): a ``fleet_manifest`` header
+# line, then one tenant per line.  tenant/dataset/lam are required; the
+# optional columns are type-checked when present (file-backed datasets
+# carry num_features, non-hinge fleets a loss/smoothing pair)
+FLEET_TENANT_REQUIRED = {
+    "tenant": (str,),
+    "dataset": (str,),
+    "lam": _NUM,
+}
+FLEET_TENANT_OPTIONAL = {
+    "gap_target": _OPT_NUM,
+    "num_features": (int,),
+    "loss": (str,),
+    "smoothing": _NUM,
 }
 
 TRAJ_RECORD_FIELDS = {
@@ -173,6 +204,13 @@ RESULTS_FIELDS = {
     "control_rounds": (int,), "rounds_ratio": _NUM,
     "accel_floor_rounds": (int,), "stopped": (str, type(None)),
     "sigma_ladder": (str,),
+    # the fleet rows (--fleet / benchmarks/fleet_bench.py): tenants
+    # certified per second through the one compiled vmapped round, with
+    # the serial solo control and the measured speedup alongside
+    "tenants": (int,), "certified": (int,), "models_per_second": _NUM,
+    "serial_models_per_second": _NUM, "speedup": _NUM, "compiles": (int,),
+    "lam_lo": _NUM, "lam_hi": _NUM, "drive_mode": (str,),
+    "lane_exec": (str,),
     # the ingest A/B rows (benchmarks/run.py bench_ingest): per-process
     # parse wallclock / bytes / peak host RSS, stream vs whole, with the
     # perf.ingest_model predictions alongside
@@ -348,9 +386,49 @@ def check_flightrec_lines(objs) -> list:
     return errors + check_event_lines(objs[1:])
 
 
+def check_fleet_lines(objs) -> list:
+    """Validate a --fleet manifest (the 6th dialect, data/fleet.py): a
+    ``fleet_manifest`` header naming the dialect version, then one tenant
+    object per line — required tenant/dataset/lam, optional columns
+    type-checked when present, tenant ids unique (the fleet's per-tenant
+    events and metrics key on them)."""
+    errors = []
+    if not objs:
+        return ["empty fleet manifest"]
+    ln0, head = objs[0]
+    man = head.get("fleet_manifest")
+    if not isinstance(man, dict):
+        errors.append(f"line {ln0}: first line must carry the "
+                      f"fleet_manifest header")
+    elif "version" not in man:
+        errors.append(f"line {ln0}: fleet_manifest missing 'version'")
+    seen = {}
+    known = set(FLEET_TENANT_REQUIRED) | set(FLEET_TENANT_OPTIONAL)
+    for ln, obj in objs[1:]:
+        where = f"line {ln}"
+        _typecheck(obj, FLEET_TENANT_REQUIRED, where, errors)
+        _typecheck(obj, FLEET_TENANT_OPTIONAL, where, errors,
+                   required=False)
+        # manifests are USER-authored input (unlike the machine-emitted
+        # dialects): a typoed optional column ('gap_taget') must fail
+        # here, not silently train a different fleet
+        for key in sorted(set(obj) - known):
+            errors.append(f"{where}: unknown field {key!r} (known tenant "
+                          f"columns: {sorted(known)})")
+        tid = obj.get("tenant")
+        if isinstance(tid, str):
+            if tid in seen:
+                errors.append(f"{where}: tenant {tid!r} duplicates "
+                              f"line {seen[tid]}")
+            seen[tid] = ln
+    if len(objs) == 1:
+        errors.append("fleet manifest names no tenants")
+    return errors
+
+
 def sniff(objs) -> str:
     """Dialect from the first line: 'events' | 'trajectory' | 'results'
-    | 'analysis' | 'flightrec'."""
+    | 'analysis' | 'flightrec' | 'fleet'."""
     if not objs:
         return "events"
     head = objs[0][1]
@@ -360,6 +438,8 @@ def sniff(objs) -> str:
         return "analysis"
     if "flightrec_manifest" in head:
         return "flightrec"
+    if "fleet_manifest" in head:
+        return "fleet"
     if "manifest" in head:
         return "trajectory"
     return "results"
@@ -369,7 +449,8 @@ _CHECKERS = {"events": check_event_lines,
              "trajectory": check_trajectory_lines,
              "results": check_results_lines,
              "analysis": check_analysis_lines,
-             "flightrec": check_flightrec_lines}
+             "flightrec": check_flightrec_lines,
+             "fleet": check_fleet_lines}
 
 
 def check_file(path: str, kind: str = "auto") -> list:

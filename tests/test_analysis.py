@@ -562,6 +562,100 @@ def test_overlap_hygiene_joined_or_escaping_clean(tmp_path):
     assert lint(tmp_path, HANDLE_ESCAPES, rule="overlap-hygiene") == []
 
 
+# --- fleet-hygiene rule -----------------------------------------------------
+
+TENANT_LOOP_IN_JIT = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def fleet_round(states, tables, n_tenants):
+    # the anti-pattern the fleet path replaces: T kernels unrolled into
+    # one graph, one compiled round PER TENANT
+    out = []
+    for t in range(n_tenants):
+        out.append(states[t] + tables[t])
+    return jnp.stack(out)
+
+@jax.jit
+def fleet_round2(tenants, tables):
+    acc = jnp.zeros_like(tables[0])
+    for tenant in tenants:
+        acc = acc + tenant
+    return acc
+"""
+
+TENANT_LOOP_IN_LAX_BODY = """
+import jax.numpy as jnp
+from jax import lax
+
+def drive(state, tenants):
+    def body(i, s):
+        for tenant in tenants:
+            s = s + tenant
+        return s
+    return lax.fori_loop(0, 10, body, state)
+"""
+
+TENANT_FETCH_IN_HOST_LOOP = """
+import numpy as np
+
+def report(fleet_w, tenants):
+    out = []
+    for t, tenant in enumerate(tenants):
+        out.append(float(np.asarray(fleet_w[t])[0]))  # T d2h round-trips
+    return out
+"""
+
+TENANT_LOOP_CLEAN = """
+import jax
+import numpy as np
+
+def fleet_kernel(chunk_kernel, states):
+    return jax.vmap(chunk_kernel)(states)   # the tenant axis rides vmap
+
+def report(fleet_w, tenants):
+    w_host = np.asarray(fleet_w)            # ONE fetch before the loop
+    return [float(w_host[t, 0]) for t, tenant in enumerate(tenants)]
+"""
+
+
+def test_fleet_hygiene_tenant_loop_in_jit_caught(tmp_path):
+    found = lint(tmp_path, TENANT_LOOP_IN_JIT, rule="fleet-hygiene")
+    assert len(found) == 2 and all("unrolls" in f.message for f in found)
+
+
+def test_fleet_hygiene_tenant_loop_in_lax_body_caught(tmp_path):
+    found = lint(tmp_path, TENANT_LOOP_IN_LAX_BODY, rule="fleet-hygiene")
+    assert len(found) == 1
+
+
+def test_fleet_hygiene_per_tenant_fetch_caught(tmp_path):
+    found = lint(tmp_path, TENANT_FETCH_IN_HOST_LOOP, rule="fleet-hygiene")
+    assert len(found) == 1 and "ONCE before the loop" in found[0].message
+
+
+def test_fleet_hygiene_vmap_and_prefetched_loop_clean(tmp_path):
+    assert lint(tmp_path, TENANT_LOOP_CLEAN, rule="fleet-hygiene") == []
+
+
+def test_fleet_hygiene_full_tree_clean():
+    """The real tree carries ZERO fleet-hygiene findings — the rule's
+    contract is that the shipped fleet path itself is the reference
+    implementation of its own hygiene."""
+    root = core.repo_root()
+    sources = {}
+    for rel in core.iter_py_files(root):
+        src = core.load_source(root, rel)
+        if src is not None:
+            sources[src.path] = src
+    found = [f for f in rules.run_static_rules(sources)
+             if f.rule == "fleet-hygiene"]
+    core.fingerprint_findings(found, sources)
+    core.apply_suppressions(found, sources)
+    assert [f for f in found if f.actionable] == []
+
+
 # --- fingerprints / baseline / report --------------------------------------
 
 

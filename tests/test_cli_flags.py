@@ -186,6 +186,74 @@ def test_cli_ingest_flag(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_cli_fleet_flag_hardening(tmp_path, capsys):
+    """--fleet's surface is deliberately narrow: every flag that cannot
+    mean anything on the one-dispatch tenant-vmapped path is rejected
+    LOUDLY with a pointer — never accepted as a silent no-op — and
+    malformed manifests fail with the schema checker's line-accurate
+    messages."""
+    from cocoa_tpu.cli import main
+    from cocoa_tpu.data.fleet import synth_fleet_specs, write_fleet_manifest
+
+    man = str(tmp_path / "fleet.jsonl")
+    write_fleet_manifest(man, synth_fleet_specs(2, n=48, d=16,
+                                                gap_target=1e-2))
+    base = [f"--fleet={man}", "--numSplits=2", "--numRounds=20",
+            "--debugIter=10", "--localIterFrac=0.25", "--quiet"]
+
+    bad = [
+        (["--elastic=2"], "tenant semantics"),
+        (["--staleRounds=1"], "host-exchange"),
+        (["--overlapComm=on"], "ONE dispatch"),
+        (["--resume", "--chkptDir=x"], "v1 surface"),
+        (["--chkptDir=" + str(tmp_path)], "v1 surface"),
+        (["--warmStart=0.1,20", "--loss=hinge"], "loss phase"),
+        (["--hotCols=auto"], "dense-layout only"),
+        (["--blockSize=128", "--math=fast"], "shard axes"),
+        (["--testFile=x"], "test sets"),
+        (["--trainFile=x", "--numFeatures=3"], "manifest"),
+        (["--objective=lasso"], "lasso"),
+        (["--mesh=4"], "tenant mesh axis"),
+        (["--fp=2"], "independent models"),
+        (["--sampling=device"], "host-samples"),
+        (["--theta=adaptive", "--accel=on", "--gapTarget=1e-3"],
+         "table shape"),
+        (["--sigma=auto", "--sigmaSchedule=trial", "--gapTarget=1e-3"],
+         "anneal"),
+        (["--accel=on", "--sigma=auto", "--gapTarget=1e-3"], "fixed safe"),
+        (["--fleetLanes=turbo"], "vmap|map"),
+        (["--lambda=0.5"], "comes from the manifest"),
+        (["--numFeatures=7"], "dataset ref"),
+        (["--gapTarget=oops"], "must be a float"),
+    ]
+    for extra_flags, needle in bad:
+        assert main(base + extra_flags) == 2, extra_flags
+        err = capsys.readouterr().err
+        assert "error:" in err and needle in err, (extra_flags, err)
+    # --fleetLanes without --fleet is itself rejected
+    assert main(["--fleetLanes=map", "--trainFile=x",
+                 "--numFeatures=3"]) == 2
+    assert "needs --fleet" in capsys.readouterr().err
+
+    # shape rejections carry the NUMBERS: a tenant that cannot pad to
+    # the common static shape names the mismatched dimension
+    from cocoa_tpu.data.fleet import TenantSpec
+
+    bad_man = str(tmp_path / "bad.jsonl")
+    write_fleet_manifest(bad_man, [
+        TenantSpec("a", "synth:dense:n=48,d=16", 0.1, gap_target=1e-2),
+        TenantSpec("b", "synth:dense:n=48,d=8", 0.1, gap_target=1e-2),
+    ])
+    assert main([f"--fleet={bad_man}", "--numSplits=2", "--numRounds=20",
+                 "--debugIter=10", "--quiet"]) == 2
+    assert "d=[8, 16]" in capsys.readouterr().err
+
+    # and the happy path runs: per-tenant summary + the models/s line
+    assert main(base[:-1]) == 0
+    out = capsys.readouterr().out
+    assert "models/s" in out and "tenant-0000" in out
+
+
 def test_cli_ingest_stream_whole_same_result(tmp_path, capsys):
     """End-to-end CLI A/B: --ingest=stream and --ingest=whole print the
     same final summary lines (same trained model) on the same file."""

@@ -1,0 +1,354 @@
+"""Fleet training (--fleet, solvers/fleet.py): manifest loading + the
+static-shape rejections, the T=1 ≡ solo bit-identity pins across all
+three drive modes, finished-tenant masking (A bitwise-frozen, B ≡ solo),
+the one-compile contract, the partition-rule machinery, and the fleet
+telemetry's schema validity.
+
+Bit-identity contract (docs/DESIGN.md §16): the loop-carried STATE
+(w, α, hist, sched) is pinned bitwise; the LOGGED gap may differ from
+the solo log by ≤ 1 ulp at some evals — the in-loop certificate
+reduction's fusion context differs between executables (the solo
+device loop's own in-loop eval differs from its standalone eval the
+same way) — while both remain exact certificates of the same iterate.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cocoa_tpu.analysis import sanitize
+from cocoa_tpu.config import DebugParams, Params
+from cocoa_tpu.data.fleet import (
+    TenantSpec, build_fleet, fleet_from_datasets, load_fleet_manifest,
+    synth_fleet_specs, write_fleet_manifest,
+)
+from cocoa_tpu.parallel import mesh as mesh_lib
+from cocoa_tpu.solvers import run_cocoa
+from cocoa_tpu.solvers.fleet import run_cocoa_fleet
+from cocoa_tpu.telemetry import events as tele
+from cocoa_tpu.telemetry import schema as tele_schema
+
+DEBUG = DebugParams(debug_iter=10, seed=0, chkpt_iter=10**9, chkpt_dir="")
+
+
+def _params(fleet, num_rounds, **kw):
+    return Params(n=0, num_rounds=num_rounds,
+                  local_iters=fleet.local_iters, gamma=1.0, loss="hinge",
+                  **kw)
+
+
+def _solo(fleet, t, num_rounds, gap_target, debug=DEBUG, **kw):
+    ds = fleet.tenant_ds(t)
+    sp = Params(n=ds.n, num_rounds=num_rounds,
+                local_iters=fleet.local_iters, lam=float(fleet.lams[t]),
+                gamma=1.0, loss="hinge", sigma=kw.pop("sigma", None))
+    return run_cocoa(ds, sp, debug, plus=True, gap_target=gap_target,
+                     device_loop=True, quiet=True, **kw)
+
+
+def _gap_ulp_close(fleet_gaps, solo_records):
+    """The logged-gap contract: the gap is primal − dual, each sum
+    correct to ~1 ulp AT THE PRIMAL'S SCALE — so the two logs may differ
+    by a couple of primal-scale ulps per eval, never more."""
+    sg = np.array([r.gap for r in solo_records], np.float32)
+    sp = np.array([r.primal for r in solo_records], np.float32)
+    fg = np.asarray(fleet_gaps, np.float32)[:len(sg)]
+    assert len(fg) == len(sg)
+    tol = 4 * np.spacing(np.maximum(np.abs(sp), np.float32(1.0)))
+    assert np.all(np.abs(fg - sg) <= tol), (fg, sg)
+
+
+# --- manifest + loader ------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_schema(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    specs = synth_fleet_specs(3, n=64, d=16, gap_target=1e-2)
+    write_fleet_manifest(path, specs)
+    assert tele_schema.check_file(path) == []          # sniffed dialect
+    assert tele_schema.check_file(path, kind="fleet") == []
+    loaded = load_fleet_manifest(path)
+    assert [s.tenant for s in loaded] == [s.tenant for s in specs]
+    assert [s.lam for s in loaded] == pytest.approx(
+        [s.lam for s in specs])
+
+
+def test_manifest_rejects_duplicates_and_bad_lines(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"fleet_manifest": {"version": 1}}) + "\n")
+        f.write(json.dumps({"tenant": "a", "dataset": "synth:dense:n=8,d=4",
+                            "lam": 0.1}) + "\n")
+        f.write(json.dumps({"tenant": "a", "dataset": "synth:dense:n=8,d=4",
+                            "lam": 0.2}) + "\n")
+    with pytest.raises(ValueError, match="duplicates"):
+        load_fleet_manifest(path)
+    with open(path, "w") as f:
+        f.write(json.dumps({"tenant": "a", "lam": 0.1}) + "\n")
+    with pytest.raises(ValueError, match="fleet_manifest header"):
+        load_fleet_manifest(path)
+    # a typoed optional column must fail loudly, not silently change
+    # which fleet trains (manifests are user-authored input)
+    with open(path, "w") as f:
+        f.write(json.dumps({"fleet_manifest": {"version": 1}}) + "\n")
+        f.write(json.dumps({"tenant": "a", "dataset": "synth:dense:n=8,d=4",
+                            "lam": 0.1, "gap_taget": 1e-3}) + "\n")
+    with pytest.raises(ValueError, match="unknown field 'gap_taget'"):
+        load_fleet_manifest(path)
+
+
+def test_build_fleet_rejects_shape_mismatches_with_numbers():
+    # mixed d
+    with pytest.raises(ValueError, match=r"d=\[8, 16\]"):
+        build_fleet([
+            TenantSpec("a", "synth:dense:n=64,d=16", 0.1),
+            TenantSpec("b", "synth:dense:n=64,d=8", 0.1),
+        ], k=2)
+    # mixed H (different n at the same localIterFrac)
+    with pytest.raises(ValueError, match="H ="):
+        build_fleet([
+            TenantSpec("a", "synth:dense:n=64,d=16", 0.1),
+            TenantSpec("b", "synth:dense:n=256,d=16", 0.1),
+        ], k=2, local_iter_frac=0.5)
+    # mixed loss phases
+    with pytest.raises(ValueError, match="one loss phase"):
+        build_fleet([
+            TenantSpec("a", "synth:dense:n=64,d=16", 0.1),
+            TenantSpec("b", "synth:dense:n=64,d=16", 0.1,
+                       loss="smooth_hinge", smoothing=0.5),
+        ], k=2)
+    # empty shards
+    with pytest.raises(ValueError, match="lower numSplits"):
+        build_fleet([TenantSpec("a", "synth:dense:n=3,d=16", 0.1)], k=4)
+
+
+def test_build_fleet_pads_unequal_tenants_to_common_shape():
+    fleet = build_fleet([
+        TenantSpec("small", "synth:dense:n=48,d=16,seed=1", 0.1),
+        TenantSpec("big", "synth:dense:n=96,d=16,seed=2", 0.1),
+    ], k=2, local_iter_frac=0.0)   # H floors at 1 for both
+    assert fleet.local_iters == 1
+    assert fleet.n_shard == 48    # pad_rows(96/2) — the fleet max
+    assert fleet.counts.tolist() == [[24, 24], [48, 48]]
+    # the small tenant's padded rows are masked out
+    assert float(fleet.mask[0].sum()) == 48.0
+    assert float(fleet.mask[1].sum()) == 96.0
+
+
+# --- T=1 ≡ solo bit-identity across the three drive modes -------------------
+
+
+def test_t1_fleet_bitidentical_to_solo_plain():
+    fleet = build_fleet(synth_fleet_specs(1, n=96, d=32, gap_target=1e-3),
+                        k=2, local_iter_frac=0.25)
+    res = run_cocoa_fleet(fleet, _params(fleet, 100), DEBUG, plus=True,
+                          drive_mode="plain", quiet=True)
+    w, a, traj = _solo(fleet, 0, 100, 1e-3)
+    assert np.array_equal(np.asarray(res.w[0]), np.asarray(w))
+    assert np.array_equal(np.asarray(res.alpha[0]), np.asarray(a))
+    _gap_ulp_close(res.traj[:, 0, 1], traj.records)
+
+
+@pytest.mark.slow
+def test_t1_fleet_bitidentical_to_solo_anneal_and_accel():
+    fleet = build_fleet(synth_fleet_specs(1, n=96, d=32, gap_target=1e-3),
+                        k=2, local_iter_frac=0.25)
+    # anneal: sigma=auto starts at K·γ/2 and anneals toward safe
+    res = run_cocoa_fleet(fleet, _params(fleet, 200, sigma="auto"), DEBUG,
+                          plus=True, drive_mode="anneal", quiet=True)
+    w, a, traj = _solo(fleet, 0, 200, 1e-3, sigma="auto",
+                       sigma_schedule="anneal")
+    assert np.array_equal(np.asarray(res.w[0]), np.asarray(w))
+    assert np.array_equal(np.asarray(res.alpha[0]), np.asarray(a))
+    _gap_ulp_close(res.traj[:res.evals, 0, 1], traj.records)
+    # accel: the per-tenant secant ladder vs the solo --accel=on run
+    res = run_cocoa_fleet(fleet, _params(fleet, 200), DEBUG, plus=True,
+                          drive_mode="accel", quiet=True)
+    w, a, traj = _solo(fleet, 0, 200, 1e-3, accel="on")
+    assert np.array_equal(np.asarray(res.w[0]), np.asarray(w))
+    assert np.array_equal(np.asarray(res.alpha[0]), np.asarray(a))
+    _gap_ulp_close(res.traj[:res.evals, 0, 1], traj.records)
+
+
+@pytest.mark.slow
+def test_fleet_anneal_backs_off_in_lockstep_with_solo():
+    """A genuinely diverging σ′ start (the coherent-shards forced-
+    divergence config of test_sigma_anneal): the fleet lane must back
+    off at the SAME round as the solo schedule and land bit-identical."""
+    from test_divergence import _coherent_dataset
+
+    ds, n = _coherent_dataset(k=4)
+    fleet = fleet_from_datasets([ds], [1e-4], gap_targets=[1e-3],
+                                local_iters=16)
+    params = Params(n=0, num_rounds=1600, local_iters=16, sigma=1.0)
+    debug = DebugParams(debug_iter=25, seed=0, chkpt_iter=10**9,
+                        chkpt_dir="")
+    res = run_cocoa_fleet(fleet, params, debug, plus=True,
+                          drive_mode="anneal", math="fast", rng="jax",
+                          quiet=True, lane_exec="map")
+    sp = Params(n=n, num_rounds=1600, local_iters=16, lam=1e-4, sigma=1.0)
+    w, a, traj = run_cocoa(ds, sp, debug, plus=True, quiet=True,
+                           math="fast", device_loop=True, gap_target=1e-3,
+                           rng="jax", sigma_schedule="anneal")
+    assert traj.stopped == "target"
+    assert bool(res.certified[0])
+    assert int(res.cert_round[0]) == traj.records[-1].round
+    # the backoff fired (stage 0 -> 1) at the same eval as solo
+    stages = res.traj[:res.evals, 0, 3]
+    assert stages.max() >= 1.0, "the fleet schedule never backed off"
+    assert np.array_equal(np.asarray(res.w[0]), np.asarray(w))
+    assert np.array_equal(np.asarray(res.alpha[0]), np.asarray(a))
+
+
+# --- finished-tenant masking ------------------------------------------------
+
+MIXED_SPECS = [
+    TenantSpec("A", "synth:dense:n=96,d=32,seed=7", lam=0.1,
+               gap_target=1e-2),
+    TenantSpec("B", "synth:dense:n=96,d=32,seed=8", lam=0.001,
+               gap_target=1e-4),
+]
+
+
+def test_masking_frozen_tenant_and_solo_parity():
+    """The masking contract, in the bit-parity lane mode: tenant A
+    certifies early and its (w, α) is bitwise-frozen from that eval on;
+    tenant B trains to the end bit-identical to its solo run."""
+    debug = DebugParams(debug_iter=5, seed=0, chkpt_iter=10**9,
+                        chkpt_dir="")
+    fleet = build_fleet(MIXED_SPECS, k=2, local_iter_frac=0.25)
+    res = run_cocoa_fleet(fleet, _params(fleet, 150), debug, plus=True,
+                          drive_mode="plain", quiet=True, lane_exec="map")
+    assert bool(res.certified[0]) and not bool(res.certified[1])
+    r_a = int(res.cert_round[0])
+    assert 0 < r_a < 150
+    # A bitwise-frozen after r_a: a run stopped AT r_a holds the same A
+    res_short = run_cocoa_fleet(fleet, _params(fleet, r_a), debug,
+                                plus=True, drive_mode="plain", quiet=True,
+                                lane_exec="map")
+    assert np.array_equal(np.asarray(res.w[0]), np.asarray(res_short.w[0]))
+    assert np.array_equal(np.asarray(res.alpha[0]),
+                          np.asarray(res_short.alpha[0]))
+    # and A's logged certificate is frozen with it
+    j_a = r_a // 5 - 1
+    assert np.all(res.traj[j_a:, 0, 1] == res.traj[j_a, 0, 1])
+    # B ≡ solo, bitwise
+    w, a, traj = _solo(fleet, 1, 150, 1e-4, debug=debug)
+    assert np.array_equal(np.asarray(res.w[1]), np.asarray(w))
+    assert np.array_equal(np.asarray(res.alpha[1]), np.asarray(a))
+    _gap_ulp_close(res.traj[:, 1, 1], traj.records)
+
+
+@pytest.mark.slow
+def test_masking_vmap_lane_mode_certifies_and_freezes():
+    """The throughput (vmap) lane mode: same masking semantics — A
+    frozen bitwise within the fleet's own trajectory, B within ulps of
+    its solo run (batched lane reductions round independently)."""
+    debug = DebugParams(debug_iter=5, seed=0, chkpt_iter=10**9,
+                        chkpt_dir="")
+    fleet = build_fleet(MIXED_SPECS, k=2, local_iter_frac=0.25)
+    res = run_cocoa_fleet(fleet, _params(fleet, 150), debug, plus=True,
+                          drive_mode="plain", quiet=True, lane_exec="vmap")
+    assert bool(res.certified[0])
+    r_a = int(res.cert_round[0])
+    res_short = run_cocoa_fleet(fleet, _params(fleet, r_a), debug,
+                                plus=True, drive_mode="plain", quiet=True,
+                                lane_exec="vmap")
+    assert np.array_equal(np.asarray(res.w[0]), np.asarray(res_short.w[0]))
+    w, a, _ = _solo(fleet, 1, 150, 1e-4, debug=debug)
+    np.testing.assert_allclose(np.asarray(res.w[1]), np.asarray(w),
+                               rtol=1e-4, atol=1e-6)
+
+
+# --- the one-compile / one-dispatch contract --------------------------------
+
+
+def test_fleet_compiles_once_and_reuses_the_executable():
+    """THE fleet acceptance invariant: one jit(run) compile serves the
+    whole fleet — and a second fleet of the same shape reuses it (the
+    compile amortization the models/s headline rests on)."""
+    fleet = build_fleet(synth_fleet_specs(4, n=64, d=16, gap_target=1e-2),
+                        k=2, local_iter_frac=0.25)
+    params = _params(fleet, 50)
+    with sanitize.sanitizer() as s1:
+        run_cocoa_fleet(fleet, params, DEBUG, plus=True,
+                        drive_mode="plain", quiet=True)
+    assert s1.compile_count("run") == 1, [c.name for c in s1.compiles]
+    with sanitize.sanitizer() as s2:
+        run_cocoa_fleet(fleet, params, DEBUG, plus=True,
+                        drive_mode="plain", quiet=True)
+    assert s2.compile_count("run") == 0, [c.name for c in s2.compiles]
+
+
+# --- telemetry --------------------------------------------------------------
+
+
+def test_fleet_events_emitted_and_schema_valid(tmp_path):
+    """The CI smoke stream: fleet_progress per eval, tenant_certified
+    per certification, all schema-valid; the metrics textfile renders
+    the fleet gauges."""
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    bus = tele.get_bus()
+    events_path = str(tmp_path / "events.jsonl")
+    metrics_path = str(tmp_path / "metrics.prom")
+    bus.configure(jsonl_path=events_path)
+    writer = bus.subscribe(MetricsWriter(metrics_path))
+    try:
+        fleet = build_fleet(
+            synth_fleet_specs(3, n=64, d=16, gap_target=1e-2),
+            k=2, local_iter_frac=0.25)
+        res = run_cocoa_fleet(fleet, _params(fleet, 60), DEBUG, plus=True,
+                              drive_mode="plain", quiet=True)
+    finally:
+        bus.unsubscribe(writer)
+        bus.reset()
+    assert tele_schema.check_file(events_path) == []
+    recs = [json.loads(l) for l in open(events_path) if l.strip()]
+    prog = [r for r in recs if r["event"] == "fleet_progress"]
+    cert = [r for r in recs if r["event"] == "tenant_certified"]
+    assert len(prog) == res.evals
+    assert len(cert) == int(res.certified.sum())
+    # the final progress event carries the models/s headline
+    assert prog[-1]["models_per_second"] == pytest.approx(
+        res.models_per_second)
+    assert prog[-1]["certified_total"] == len(cert)
+    text = open(metrics_path).read()
+    assert "cocoa_fleet_tenants_active" in text
+    assert "cocoa_tenants_certified_total " + str(len(cert)) in text
+    assert "cocoa_fleet_models_per_second" in text
+
+
+# --- partition rules --------------------------------------------------------
+
+
+def test_match_partition_rules_first_match_wins_and_rejects_unmatched():
+    from jax.sharding import PartitionSpec as P
+
+    tree = {"w": np.zeros(2), "alpha": np.zeros(2), "sched": np.zeros(2)}
+    specs = mesh_lib.match_partition_rules(
+        ((r"alpha", P("tenant", None)), (r".*", P("tenant"))), tree)
+    assert specs["alpha"] == P("tenant", None)
+    assert specs["w"] == P("tenant") and specs["sched"] == P("tenant")
+    with pytest.raises(ValueError, match="no partition rule"):
+        mesh_lib.match_partition_rules(((r"alpha", P("tenant")),), tree)
+
+
+def test_fleet_shardings_cover_the_whole_state_and_data_surface():
+    from jax.sharding import NamedSharding
+
+    fleet = build_fleet(synth_fleet_specs(2, n=64, d=16), k=2,
+                        local_iter_frac=0.25)
+    mesh = mesh_lib.make_fleet_mesh(1)   # the degenerate single-chip mesh
+    tree = {"data": fleet.shard_arrays(),
+            "state": {"w": np.zeros((2, 16)),
+                      "alpha": np.zeros((2, 2, fleet.n_shard))}}
+    sh = mesh_lib.fleet_shardings(mesh, tree)
+    leaves = jax.tree.leaves(
+        sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+    assert len(leaves) == len(jax.tree.leaves(tree))
+    assert all(mesh_lib.TENANT_AXIS in s.spec for s in leaves)
