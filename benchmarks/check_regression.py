@@ -126,6 +126,26 @@ GATES = (
         "runner": "fleet",
         "flags": ["--fleet-only", "--tenants=256"],
     },
+    # The serving row (ISSUE 14, docs/DESIGN.md §17): queries/s at a
+    # pinned p99 SLA with a mid-bench hot-swap, measured by
+    # benchmarks/serve_bench.py on CPU.  The environment-robust axes the
+    # gate pins hard: the p99 SLA holds (the row IS "queries/s at p99 <=
+    # SLA"), the scoring path compiled exactly once per bucket, and the
+    # hot-swap happened ("stopped" == "target" requires zero failed
+    # queries + >= 1 swap).  Throughput itself is wall-clock on a shared
+    # CI runner, so only a catastrophic collapse fails: fresh qps must
+    # stay above qps_floor_frac x the committed row.
+    {
+        "config": "serve-cpu-synth",
+        "algorithm": "CoCoA+",
+        "gap_target": 1e-2,
+        "rounds_tol": 0.25,
+        "runner": "serve",
+        "kind": "serve",
+        "qps_floor_frac": 0.25,
+        "expected_compiles": 2,
+        "flags": ["--duration=3", "--threads=4"],
+    },
 )
 
 # bounded-staleness round overhead vs the synchronous control (the
@@ -290,6 +310,64 @@ def run_fresh_fleet(gate: dict, workdir: str) -> dict:
                 f"{type(e).__name__}: {e}"}
 
 
+def run_fresh_serve(gate: dict, workdir: str) -> dict:
+    """One fresh CPU serving bench (benchmarks/serve_bench.py): the row
+    comes from the bench driver's own --row artifact, like the fleet
+    gate.  Same never-raises contract as :func:`run_fresh`."""
+    row_path = os.path.join(workdir,
+                            gate["config"].replace("/", "_") + ".jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "benchmarks",
+                                          "serve_bench.py"),
+             *gate["flags"], f"--row={row_path}"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+            timeout=900)
+        if proc.returncode != 0:
+            return {"config": gate["config"], "error":
+                    f"serve bench exited {proc.returncode}: "
+                    f"{proc.stderr[-500:]}"}
+        with open(row_path) as f:
+            row = json.loads(f.readline())
+        return {**row, "type": "bench-regression-fresh"}
+    except (subprocess.TimeoutExpired, OSError, ValueError, KeyError,
+            TypeError) as e:
+        return {"config": gate["config"], "error":
+                f"{type(e).__name__}: {e}"}
+
+
+def serve_failures(gate: dict, fresh: dict, committed: dict) -> list:
+    """The serve-specific bounds (on top of :func:`evaluate`'s
+    certification + round checks): the p99 SLA holds, the compile count
+    equals the bucket count, and throughput has not collapsed below the
+    floor fraction of the committed row."""
+    cfg = gate["config"]
+    failures = []
+    p99, sla = fresh.get("p99_ms"), fresh.get("sla_ms")
+    if p99 is None or sla is None:
+        failures.append(f"{cfg}: fresh serve row carries no p99/SLA")
+    elif p99 > sla:
+        failures.append(
+            f"{cfg}: SLA VIOLATION — fresh p99 {p99}ms exceeds the "
+            f"pinned {sla}ms bound; the row is queries/s AT p99 <= SLA")
+    if fresh.get("compiles") != gate["expected_compiles"]:
+        failures.append(
+            f"{cfg}: COMPILE LEAK — {fresh.get('compiles')} scoring "
+            f"compiles for {gate['expected_compiles']} buckets; the "
+            f"one-compile-per-bucket contract broke")
+    base = committed.get(cfg)
+    if base is not None and base.get("qps") is not None:
+        floor = base["qps"] * gate["qps_floor_frac"]
+        if (fresh.get("qps") or 0) < floor:
+            failures.append(
+                f"{cfg}: THROUGHPUT COLLAPSE — fresh {fresh.get('qps')} "
+                f"qps vs committed {base['qps']} (floor "
+                f"{gate['qps_floor_frac']}x = {floor:.0f}); CI noise "
+                f"never costs 4x")
+    return failures
+
+
 def gang_ratio_failures(rows: list) -> list:
     """The cross-config staleness bound: overlap+stale rounds <=
     STALE_ROUNDS_RATIO x sync rounds (evaluated only when both gang
@@ -367,7 +445,8 @@ def main(argv=None) -> int:
                 failures.append(f"{gate['config']}: no row in "
                                 f"{fresh_path}")
                 continue
-            fresh = {"config": gate["config"],
+            fresh = {**row,
+                     "config": gate["config"],
                      "rounds": int(row["rounds"]),
                      "gap": (float(row["gap"])
                              if row.get("gap") is not None else None),
@@ -376,6 +455,8 @@ def main(argv=None) -> int:
                      "stopped": row.get("stopped", "target")}
             rows.append({**fresh, "type": "bench-regression-fresh"})
             failures += evaluate(gate, fresh, committed)
+            if gate.get("kind") == "serve":
+                failures += serve_failures(gate, fresh, committed)
         # the cross-row staleness bound applies to artifact-checked rows
         # exactly like fresh runs — an overhead regression must not ride
         # in through --fresh mode
@@ -388,11 +469,14 @@ def main(argv=None) -> int:
                   f"{committed.get(gate['config'], {}).get('rounds')} "
                   f"rounds)", flush=True)
             runner = {"gang": run_fresh_gang,
-                      "fleet": run_fresh_fleet}.get(
+                      "fleet": run_fresh_fleet,
+                      "serve": run_fresh_serve}.get(
                           gate.get("runner"), run_fresh)
             fresh = runner(gate, workdir)
             rows.append(fresh)
             failures += evaluate(gate, fresh, committed)
+            if gate.get("kind") == "serve" and "error" not in fresh:
+                failures += serve_failures(gate, fresh, committed)
         failures += gang_ratio_failures(rows)
 
     if report_path:

@@ -1,0 +1,212 @@
+"""The serving benchmark: queries/s at a pinned p99 latency bound, plus
+model freshness (gap age), on CPU.
+
+The headline claim of the ``--serve`` path (docs/DESIGN.md §17): batched
+margin queries ride a compiled scoring path with statically-shaped
+buckets — one XLA compile per bucket, ever — behind an adaptive
+micro-batcher, while the model hot-swaps under traffic without dropping
+a request.  The bench trains a small model to a certified gap, serves
+it from real checkpoint generations (one mid-run hot-swap, so the swap
+machinery is in the measured path), hammers the batcher from G client
+threads for the duration, and reports
+
+- ``qps``       — answered requests / wall-clock of the traffic window
+- ``p50/p99_ms``— per-request latency percentiles (submit → answer),
+  measured exactly (every request's own enqueue timestamp)
+- ``sla_ms``    — the pinned bound: the run FAILS (exit 1) if p99
+  exceeds it — the row is "queries/s AT p99 ≤ SLA", not queries/s alone
+- ``gap_age_s`` — the serving model's certificate age at measurement
+  end (freshness, the cocoa_model_gap_age_seconds gauge's value)
+- ``compiles``  — measured XLA compiles of the scoring executable
+  (must equal the bucket count: the one-compile-per-bucket pin)
+
+    python benchmarks/serve_bench.py                 # print the row
+    python benchmarks/serve_bench.py --row=out.jsonl # write it (CI gate)
+
+Latency/qps are CPU-measured host wall-clock (no TPU column: serving
+latency is dominated by dispatch+fetch, which the tunnel distorts —
+the needs-TPU-regen convention applies to the wallclock the day a TPU
+is attached).  benchmarks/check_regression.py gates the SLA, the
+compile count, and a catastrophic-throughput floor against the
+committed row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+CONFIG = "serve-cpu-synth"
+# the canonical serving workload: a small certified model, sparse
+# queries (nnz ~ 12 of d=256), two buckets, a 50 ms p99 SLA
+N, D, K = 2048, 256, 2
+LAM, GAP_TARGET = 1e-3, 1e-2
+BUCKETS = (64, 256)
+MAX_NNZ = 32
+SLA_MS = 50.0
+QUERY_NNZ = 12
+
+
+def train_checkpoints(ck: str):
+    """Train the model to its certified gap and leave TWO checkpoint
+    generations (the second is the mid-bench hot-swap target)."""
+    import numpy as np
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data.sharding import shard_dataset
+    from cocoa_tpu.data.synth import synth_dense
+    from cocoa_tpu.solvers import run_cocoa
+
+    data = synth_dense(N, D, seed=7)
+    ds = shard_dataset(data, k=K, layout="dense")
+    params = Params(n=N, num_rounds=300, local_iters=max(1, N // K // 10),
+                    lam=LAM, gamma=1.0, loss="hinge")
+    debug = DebugParams(debug_iter=10, seed=0, chkpt_iter=301,
+                        chkpt_dir="")
+    w, alpha, traj = run_cocoa(ds, params, debug, plus=True, quiet=True,
+                               gap_target=GAP_TARGET)
+    gap = traj.records[-1].gap if traj.records else None
+    rounds = traj.records[-1].round if traj.records else 0
+    w = np.asarray(w)
+    # generation 1: the model the server starts on; generation 2: the
+    # fresher state the watcher hot-swaps in mid-bench (a genuinely
+    # different iterate — here the final w vs a perturbed older one)
+    ckpt_lib.save(ck, "CoCoA+", max(1, rounds - 10),
+                  (w * 0.95).astype(np.float32), None, gap=gap)
+    return w.astype(np.float32), rounds, gap
+
+
+def measure(ck, w_final, rounds, gap, duration_s: float, threads: int,
+            sla_ms: float):
+    import numpy as np
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu import serving
+    from cocoa_tpu.analysis import sanitize
+
+    with sanitize.watch_compiles() as compiles:
+        w0, info = serving.load_model(ckpt_lib.latest(ck, "CoCoA+"))
+        slots = serving.ModelSlots(w0, info, dtype=np.float32)
+        scorer = serving.BatchScorer(D, dtype=np.float32,
+                                     buckets=BUCKETS, max_nnz=MAX_NNZ)
+        scorer.warmup(slots.current()[0])
+        batcher = serving.MicroBatcher(scorer, slots,
+                                       sla_s=sla_ms / 1000.0)
+        watcher = serving.SwapWatcher(slots, ck, "CoCoA+",
+                                      poll_s=0.05).start()
+        stop = threading.Event()
+        lock = threading.Lock()
+        lats = []
+        failed = [0]
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                idx = np.sort(rng.choice(D, size=QUERY_NNZ,
+                                         replace=False)).astype(np.int32)
+                val = rng.standard_normal(QUERY_NNZ)
+                t0 = time.monotonic()
+                try:
+                    batcher.score_sync(idx, val, timeout=10.0)
+                except Exception:
+                    with lock:
+                        failed[0] += 1
+                    continue
+                with lock:
+                    lats.append(time.monotonic() - t0)
+
+        workers = [threading.Thread(target=client, args=(s,),
+                                    daemon=True)
+                   for s in range(threads)]
+        t_start = time.monotonic()
+        for t in workers:
+            t.start()
+        # the mid-bench hot-swap: the trainer "catches up" halfway in
+        time.sleep(duration_s / 2)
+        ckpt_lib.save(ck, "CoCoA+", rounds, w_final, None, gap=gap)
+        time.sleep(duration_s / 2)
+        stop.set()
+        for t in workers:
+            t.join(10)
+        wall = time.monotonic() - t_start
+        watcher.stop()
+        gap_age = slots.gap_age_s()
+        swaps = watcher.swaps_total
+        batcher.stop()
+    serve_compiles = sum(1 for c in compiles
+                         if "serve_margins" in c.name)
+    lats.sort()
+
+    def pct(p):
+        return lats[min(len(lats) - 1, int(p * len(lats)))] * 1000.0
+
+    return {
+        "config": CONFIG, "type": "serve", "device": "cpu",
+        "n": N, "d": D, "k": K, "lam": LAM,
+        "gap": gap, "gap_target": GAP_TARGET, "rounds": int(rounds),
+        "queries": len(lats), "threads": threads,
+        "qps": round(len(lats) / wall, 1),
+        "p50_ms": round(pct(0.50), 3), "p99_ms": round(pct(0.99), 3),
+        "sla_ms": sla_ms,
+        "fill": round(batcher.requests_total
+                      / max(1, batcher.slots_total), 3),
+        "buckets": "/".join(str(b) for b in BUCKETS),
+        "compiles": serve_compiles, "swaps": swaps,
+        "gap_age_s": round(gap_age, 3),
+        "wallclock_s": round(wall, 3),
+        "stopped": ("target" if failed[0] == 0 and swaps >= 1
+                    else None),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--row", default=None,
+                    help="write the results row to this JSONL path")
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="traffic window seconds (default 4)")
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--sla-ms", type=float, default=SLA_MS)
+    args = ap.parse_args(argv)
+
+    ck = tempfile.mkdtemp(prefix="serve-bench-")
+    print(f"serve_bench: training the {N}x{D} model to gap "
+          f"{GAP_TARGET:g}", flush=True)
+    w_final, rounds, gap = train_checkpoints(ck)
+    print(f"serve_bench: certified at round {rounds} (gap {gap:.3e}); "
+          f"serving for {args.duration:g}s x {args.threads} clients",
+          flush=True)
+    row = measure(ck, w_final, rounds, gap, args.duration, args.threads,
+                  args.sla_ms)
+    print(json.dumps(row))
+    if args.row:
+        with open(args.row, "w") as f:
+            f.write(json.dumps(row) + "\n")
+    failures = []
+    if row["p99_ms"] > args.sla_ms:
+        failures.append(f"p99 {row['p99_ms']}ms exceeds the "
+                        f"{args.sla_ms}ms SLA — the row is queries/s AT "
+                        f"p99 <= SLA")
+    if row["compiles"] != len(BUCKETS):
+        failures.append(f"{row['compiles']} scoring compiles for "
+                        f"{len(BUCKETS)} buckets — the "
+                        f"one-compile-per-bucket contract broke")
+    if row["swaps"] < 1:
+        failures.append("the mid-bench hot-swap never happened")
+    for msg in failures:
+        print(f"serve_bench FAIL: {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,15 @@ Rules (ids are what ``jaxlint: allow=<rule>`` and the baseline key on):
   payload is unsynchronized with every dispatch it crosses, and its
   bounded-KV budget leaks onto a daemon thread nobody will ever
   account.  Rides the host-sync rule's traced-context machinery.
+- ``serve-hygiene`` — the serving hot-path contract (cocoa_tpu/serving/,
+  docs/DESIGN.md §17): a ``jax.jit`` built inside a hot-path def is an
+  error (compile-per-request — executables are built once at startup),
+  an array allocation whose shape derives from ``len(...)`` in the hot
+  path is an error (request-dependent shapes compile one executable per
+  batch size; pad UP to a static bucket), and inside the compiled
+  scoring functions a host clock read or ``.block_until_ready()`` is an
+  error (it times/syncs the trace, not the request).  Rides the
+  host-sync rule's traced-context machinery.
 """
 
 from __future__ import annotations
@@ -1066,10 +1075,124 @@ def check_fleet_hygiene(src: SourceFile, index: ModuleIndex) -> list:
     return findings
 
 
+# --- rule: serve-hygiene -----------------------------------------------------
+
+# the rule applies to the serving subsystem only (and to fixtures that
+# put themselves under a serving/ path)
+_SERVING_PATH_RE = re.compile(r"(^|/)serving/")
+
+# defs that legitimately BUILD executables / static buffers: module
+# level, construction, and explicit build/warmup helpers — everything
+# else in a serving module is the hot path
+_SERVE_BUILDER_RE = re.compile(r"^(__init__|_?build\w*|make_\w+|warmup)$")
+
+# np/jnp array constructors whose shape argument the rule inspects
+_SERVE_ALLOC_CALLEES = {"zeros", "ones", "empty", "full"}
+
+# host clock reads: inside traced code they read the TRACE's wall clock
+# once per compile, not the request's
+_SERVE_CLOCK_CHAINS = {"time.time", "time.monotonic",
+                       "time.perf_counter", "time.perf_counter_ns",
+                       "time.monotonic_ns"}
+
+
+def _contains_len_call(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Name) and sub.func.id == "len":
+            return True
+    return False
+
+
+def check_serve_hygiene(src: SourceFile, index: ModuleIndex) -> list:
+    """The serving hot-path contract (cocoa_tpu/serving/, docs/DESIGN.md
+    §17): the scoring path must compile once per static bucket and never
+    sync per request.
+
+    1. ``jax.jit`` built inside a hot-path def is an error — a jit
+       created per call builds a fresh executable per request (the
+       compile-per-request leak the one-compile-per-bucket pin exists
+       to prevent); build it once at startup (``__init__`` / ``build_*``
+       / ``warmup`` are the sanctioned builder scopes).
+    2. an array allocation whose shape derives from ``len(...)`` inside
+       a hot-path def is an error — a request-dependent shape retraces
+       and recompiles on every distinct batch size; pad UP to a static
+       bucket (serving/scorer.pick_bucket) instead.
+    3. inside TRACED defs (the compiled scoring functions): a host
+       clock read (``time.time``/``monotonic``/``perf_counter``) or a
+       ``.block_until_ready()`` is an error — it times (or syncs) the
+       TRACE, once per compile, not the request; latency accounting
+       belongs at the host boundary (the batcher's spans).  Rides the
+       host-sync rule's traced-context machinery.
+    """
+    if not _SERVING_PATH_RE.search(src.path.replace(os.sep, "/")):
+        return []
+    findings = []
+    traced = index.traced_defs()
+    parents = _build_parents(src.tree)
+
+    def flag(node, msg):
+        findings.append(Finding(
+            rule="serve-hygiene", severity="error", path=src.path,
+            line=node.lineno, col=node.col_offset, message=msg))
+
+    def hot_path(d) -> bool:
+        name = getattr(d, "name", "")
+        return not _SERVE_BUILDER_RE.match(name or "")
+
+    for d in index.defs:
+        body = d.body if isinstance(d.body, list) else [d.body]
+        is_traced = id(d) in traced
+        is_hot = hot_path(d)
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if _nearest_def(node, parents) is not d:
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if is_hot and _is_jax_jit(node.func):
+                    flag(node,
+                         "jit built in the serving hot path — every "
+                         "call builds (and compiles) a fresh "
+                         "executable; build the jit once at startup "
+                         "(__init__/build_*/warmup) and call the built "
+                         "function per batch")
+                elif is_hot and _callee_tail(node) in \
+                        _SERVE_ALLOC_CALLEES and node.args and \
+                        (_attr_chain(node.func) or "").split(".")[0] in \
+                        (_NP_MODULES | {"jnp"}) and \
+                        _contains_len_call(node.args[0]):
+                    flag(node,
+                         f"request-dependent shape in the serving hot "
+                         f"path — `{_callee_tail(node)}` sized by "
+                         f"`len(...)` compiles one executable per "
+                         f"distinct batch size; pad UP to a static "
+                         f"bucket (serving/scorer.pick_bucket)")
+                if is_traced:
+                    chain = _attr_chain(node.func) or ""
+                    if chain in _SERVE_CLOCK_CHAINS:
+                        flag(node,
+                             f"`{chain}()` inside the compiled scoring "
+                             f"path reads the clock at TRACE time, "
+                             f"once per compile — time requests at the "
+                             f"host boundary (the batcher's "
+                             f"serve_admit/serve_score spans)")
+                    elif isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "block_until_ready":
+                        flag(node,
+                             "`.block_until_ready()` inside the "
+                             "compiled scoring path is a device sync "
+                             "per call — fetch once on the host after "
+                             "the dispatch (the batcher's single "
+                             "intended_fetch)")
+    return findings
+
+
 # --- registry ---------------------------------------------------------------
 
 RULES = ("donation", "host-sync", "f64", "mesh-api", "pallas-budget",
-         "span-hygiene", "overlap-hygiene", "fleet-hygiene")
+         "span-hygiene", "overlap-hygiene", "fleet-hygiene",
+         "serve-hygiene")
 
 
 def run_static_rules(sources: dict) -> list:
@@ -1085,4 +1208,5 @@ def run_static_rules(sources: dict) -> list:
         findings += check_span_hygiene(src, index)
         findings += check_overlap_hygiene(src, index)
         findings += check_fleet_hygiene(src, index)
+        findings += check_serve_hygiene(src, index)
     return findings

@@ -49,8 +49,17 @@ def save(
     seed: int = 0,
     sched: Optional[jax.Array] = None,
     hist: Optional[jax.Array] = None,
+    gap: Optional[float] = None,
 ) -> str:
     """Write checkpoint for ``round_t``; returns the file path.
+
+    ``gap`` is the last certified duality gap the run observed before
+    this save (None outside the gap-target paths).  It rides the meta so
+    a DOWNSTREAM consumer — the serving hot-swap watcher
+    (cocoa_tpu/serving/) — can report what certificate the model it is
+    about to serve carries, and how stale it is: the paper's primal-dual
+    certificate doubles as the deployed model's freshness measure
+    (docs/DESIGN.md §17 "gap age").
 
     ``sched`` is the σ′-schedule / watch state of a ``--sigmaSchedule``
     run (solvers/base.py SCHED layout, a tiny float32 vector; ``--accel``
@@ -77,15 +86,17 @@ def save(
     with _tracing.span("checkpoint_save", algorithm=algorithm,
                        round=int(round_t)):
         return _save(directory, algorithm, round_t, w, alpha=alpha,
-                     seed=seed, sched=sched, hist=hist)
+                     seed=seed, sched=sched, hist=hist, gap=gap)
 
 
 def _save(directory, algorithm, round_t, w, alpha=None, seed=0,
-          sched=None, hist=None) -> str:
+          sched=None, hist=None, gap=None) -> str:
     os.makedirs(directory, exist_ok=True)
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
     meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
+    if gap is not None:
+        meta["gap"] = float(gap)
     # array shapes recorded in the meta give :func:`validate` a
     # self-contained integrity check: a torn or bit-rotted archive whose
     # zip structure still opens is caught by the shape (or the member
@@ -189,16 +200,60 @@ def generations(directory: str, algorithm: str) -> list:
     return [os.path.join(directory, f) for _, f in stamped]
 
 
+# PASSED validations, keyed (path) -> (mtime_ns, size).  The serving
+# hot-swap watcher polls :func:`latest` every few hundred ms; without
+# the cache every poll re-decompresses every npz member (the CRC check)
+# of every retained generation — ~ms of CPU per poll per generation for
+# a model that has not changed.  A hit costs one os.stat.  Only PASSES
+# are cached: a failed generation may legitimately be replaced in place
+# by a healthy rewrite, and the atomic-rename write protocol means a
+# path whose (mtime, size) is unchanged cannot have changed content
+# out from under a recorded pass — while a REWRITTEN-in-place file
+# (same path, new mtime/size) misses the cache and re-validates, which
+# tests/test_serving.py pins.
+_VALIDATED = {}
+_VALIDATED_CAP = 64   # a serving dir holds KEEP_GENERATIONS files per
+                      # algorithm; the cap only matters for long-lived
+                      # processes sweeping many directories (tests)
+
+
+def _stat_key(path: str):
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    # st_ino joins mtime+size: an atomic-rename rewrite always lands a
+    # fresh inode, so even a filesystem whose mtime ticks are coarser
+    # than the rewrite (1s network FS stamps) cannot alias a cached
+    # pass onto bytes the cache never saw
+    return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+
 def validate(path: str) -> Optional[str]:
     """None when the checkpoint at ``path`` is healthy, else a reason
     string.  Healthy = the npz opens, every array member decompresses
     (zip CRC — catches torn/overwritten bytes), the meta parses, and each
     array shape matches the shape the meta recorded at write time
-    (pre-``shapes`` checkpoints skip that last comparison)."""
+    (pre-``shapes`` checkpoints skip that last comparison).
+
+    Passed validations are cached on (path, mtime, size) so a poll-rate
+    reader (the serving swap watcher) pays one stat, not a full
+    decompression, for an unchanged generation."""
     from cocoa_tpu.telemetry import tracing as _tracing
 
+    key = _stat_key(path)
+    if key is not None and _VALIDATED.get(path) == key:
+        return None
     with _tracing.span("checkpoint_validate", path=path):
-        return _validate(path)
+        reason = _validate(path)
+    if reason is None and key is not None and key == _stat_key(path):
+        # only record a pass whose file is provably the one we read: an
+        # in-place rewrite DURING validation changes the stat key, and
+        # caching the pre-read key would bless bytes we never saw
+        if len(_VALIDATED) >= _VALIDATED_CAP:
+            _VALIDATED.pop(next(iter(_VALIDATED)))
+        _VALIDATED[path] = key
+    return reason
 
 
 def _validate(path: str) -> Optional[str]:

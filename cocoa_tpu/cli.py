@@ -107,6 +107,21 @@ every flag that cannot mean anything on the one-dispatch path
 (--elastic, --staleRounds>0, --hotCols, --warmStart, checkpointing,
 --testFile, ...) is rejected loudly with a pointer.
 
+``--serve=PORT`` (round 19, docs/DESIGN.md §17) turns this process into
+the production SCORING loop (cocoa_tpu/serving/): batched margin
+queries ``x·w`` answered on a TCP line protocol through a compiled
+scoring path with statically-shaped batch buckets (``--serveBatch``,
+default 64/256/1024 — one XLA compile per bucket, ever), an adaptive
+micro-batcher admitting requests under the ``--serveSlaMs`` p99 budget,
+and double-buffered model slots a watcher hot-swaps ATOMICALLY from the
+newest *validated* checkpoint generation in ``--chkptDir`` — so a
+background trainer (a separate process, e.g. an ``--elastic`` gang
+pointed at the same directory) keeps the served model fresh without
+ever dropping or blocking a query.  Freshness is exported as gap age
+(``cocoa_model_gap_age_seconds``: seconds since the serving model's
+certificate was produced).  The serve surface is a whitelist — every
+training flag passed alongside ``--serve`` is rejected loudly.
+
 ``--objective=lasso`` switches to the ProxCoCoA+ L1 family
 (solvers/prox_cocoa.py): labels become the regression target b,
 ``--lambda`` the L1 weight, ``--l2`` the optional elastic-net weight;
@@ -151,7 +166,9 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "ingest", "metrics", "events", "quiet",
                 "trace", "flightRecorder", "eventsMaxMB",
                 "metricsInterval", "overlapComm",
-                "staleRounds", "fleet", "fleetLanes")  # run-level
+                "staleRounds", "fleet", "fleetLanes",
+                "serve", "serveBatch", "serveSlaMs",
+                "serveMaxNnz")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -363,6 +380,15 @@ def main(argv=None) -> int:
               f"{extras['fleetLanes']!r}", file=sys.stderr)
         return 2
     if fleet_path:
+        if extras["serve"]:
+            # checked before the fleet's own prerequisite checks so the
+            # combination names the real conflict, not a side effect
+            # (--serve needs --chkptDir, which the fleet also rejects)
+            print("error: --serve does not combine with --fleet: the "
+                  "fleet is one training dispatch, serving is a "
+                  "long-lived query loop — run them as separate "
+                  "processes (docs/DESIGN.md §17)", file=sys.stderr)
+            return 2
         rejected = {
             "elastic": "the elastic supervisor gang-restarts one model's "
                        "training; a fleet is thousands of independent "
@@ -424,6 +450,79 @@ def main(argv=None) -> int:
                   "overlap (docs/DESIGN.md §16)", file=sys.stderr)
             return 2
 
+    # --serve=PORT (0/bare = ephemeral): the production scoring loop
+    # (cocoa_tpu/serving/, docs/DESIGN.md §17) — answer batched margin
+    # queries from the newest VALIDATED checkpoint generation in
+    # --chkptDir while a background trainer (a separate process, e.g.
+    # an --elastic supervised gang pointed at the same directory) keeps
+    # it fresh.  The serve surface is a WHITELIST: serving answers
+    # queries, it does not train, so every training flag explicitly
+    # passed alongside --serve is rejected loudly with a pointer —
+    # never accepted as a silent no-op.
+    serve_flag = extras["serve"]
+    for dep, what in (("serveBatch", "sets the static batch buckets"),
+                      ("serveSlaMs", "sets the p99 latency budget"),
+                      ("serveMaxNnz", "sets the per-query nonzero "
+                                      "budget")):
+        if extras[dep] and not serve_flag:
+            print(f"error: --{dep} {what} of the serving loop and needs "
+                  f"--serve", file=sys.stderr)
+            return 2
+    if serve_flag:
+        if fleet_path:
+            print("error: --serve does not combine with --fleet: the "
+                  "fleet is one training dispatch, serving is a "
+                  "long-lived query loop — run them as separate "
+                  "processes (docs/DESIGN.md §17)", file=sys.stderr)
+            return 2
+        pointers = {
+            "elastic": "supervise the background TRAINER with --elastic "
+                       "and point --serve's --chkptDir at its "
+                       "checkpoints — the server must stay outside the "
+                       "gang so a resize can never wedge a query "
+                       "(docs/DESIGN.md §17)",
+            "sigmaSchedule": "σ′ schedules belong to the trainer "
+                             "process (--sigmaSchedule=trial is a "
+                             "training A/B control; the server only "
+                             "reads validated checkpoints)",
+            "gapTarget": "the trainer certifies the gap; the server "
+                         "reports it as freshness "
+                         "(cocoa_model_gap_age_seconds)",
+            "resume": "the server always serves the newest validated "
+                      "generation; there is nothing to resume",
+        }
+        allowed = {
+            # the documented serve surface (README flag table): the
+            # serve flags, the model source, the query-side layout, and
+            # the observability flags every mode shares
+            "serve", "serveBatch", "serveSlaMs", "serveMaxNnz",
+            "chkptDir",
+            "numFeatures", "trainFile", "hotCols", "dtype", "quiet",
+            "metrics", "events", "trace", "flightRecorder",
+            "eventsMaxMB", "metricsInterval", "seed",
+        }
+        explicit = getattr(cfg, "_explicit", frozenset())
+        for key in sorted(explicit - allowed):
+            why = pointers.get(
+                key, "serving answers queries from the checkpoints in "
+                     "--chkptDir; training flags belong to the "
+                     "background trainer process (docs/DESIGN.md §17)")
+            print(f"error: --{key} does not combine with --serve: {why}",
+                  file=sys.stderr)
+            return 2
+        if not cfg.chkpt_dir:
+            print("error: --serve needs --chkptDir (the checkpoint "
+                  "directory the hot-swap watcher polls — point it at "
+                  "the background trainer's --chkptDir)",
+                  file=sys.stderr)
+            return 2
+        if extras["hotCols"] is not None and not cfg.train_file:
+            print("error: --serve with --hotCols needs --trainFile: the "
+                  "hot panel is the TRAINED column split, resolved from "
+                  "the training data's column histogram "
+                  "(data/hybrid.py)", file=sys.stderr)
+            return 2
+
     # --profile=DIR traces the whole run; --profile=DIR,START,STOP traces
     # the round window [START, STOP) by riding the telemetry event stream
     # (telemetry/profiling.py) — validated here so a typo fails before the
@@ -441,10 +540,12 @@ def main(argv=None) -> int:
         if p_start is not None:
             profile_window = (p_start, p_stop)
 
-    if not cfg.train_file and not fleet_path:
+    if not cfg.train_file and not fleet_path and not serve_flag:
         print("error: --trainFile is required", file=sys.stderr)
         return 2
     if cfg.num_features <= 0 and not fleet_path:
+        # serving needs it too: the query width the compiled scoring
+        # path is built for (and the width checkpoints must match)
         print("error: --numFeatures must be positive", file=sys.stderr)
         return 2
     from cocoa_tpu.ops import losses as losses_mod
@@ -848,6 +949,10 @@ def main(argv=None) -> int:
         return _run_fleet_cli(cfg, extras, quiet, bus, cfg_manifest,
                               fleet_lanes, sigma_schedule, accel_flag,
                               theta_flag)
+
+    if serve_flag:
+        return _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest,
+                              serve_flag)
 
     k = cfg.num_splits
 
@@ -1616,6 +1721,196 @@ def _run_fleet_cli(cfg, extras, quiet, bus, cfg_manifest, fleet_lanes,
                     "stopped": ("target" if result.certified[ti]
                                 else None),
                 }) + "\n")
+    return 0
+
+
+def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
+    """The ``--serve`` execution path (cocoa_tpu/serving/,
+    docs/DESIGN.md §17): wait for the first VALIDATED checkpoint
+    generation, build the compiled bucket scorer + double-buffered model
+    slots, start the hot-swap watcher and the adaptive micro-batcher,
+    and answer margin queries on a TCP line protocol until ``shutdown``
+    (protocol line) or SIGTERM/SIGINT.  Reached from :func:`main` after
+    the whitelist hardening; every remaining rejection here carries the
+    numbers."""
+    import signal
+
+    import numpy as np
+
+    from cocoa_tpu import serving, telemetry
+    from cocoa_tpu.telemetry import tracing
+
+    # --serve=PORT: 0 (or bare --serve) binds an ephemeral port and
+    # announces it — what the smoke tests parse
+    try:
+        port = 0 if str(serve_flag).lower() == "true" else int(serve_flag)
+    except ValueError:
+        port = -1
+    if port < 0 or port > 65535:
+        print(f"error: --serve takes a TCP port (0 = ephemeral), got "
+              f"{serve_flag!r}", file=sys.stderr)
+        return 2
+    buckets = serving.DEFAULT_BUCKETS
+    if extras["serveBatch"]:
+        try:
+            buckets = tuple(sorted({int(b) for b in
+                                    str(extras["serveBatch"]).split(",")}))
+            if not buckets or buckets[0] < 1 or buckets[-1] > 8192:
+                raise ValueError
+        except ValueError:
+            print(f"error: --serveBatch takes ascending bucket sizes in "
+                  f"[1, 8192] (e.g. 64,256,1024), got "
+                  f"{extras['serveBatch']!r}", file=sys.stderr)
+            return 2
+    sla_ms = 50.0
+    if extras["serveSlaMs"]:
+        try:
+            sla_ms = float(extras["serveSlaMs"])
+        except ValueError:
+            sla_ms = -1.0
+        if sla_ms <= 0:
+            print(f"error: --serveSlaMs takes a positive latency budget "
+                  f"in ms, got {extras['serveSlaMs']!r}", file=sys.stderr)
+            return 2
+
+    d = cfg.num_features
+    dtype = jnp.dtype(cfg.dtype)
+    algorithm = "CoCoA+"   # the production trainer's checkpoint key
+
+    # optional hybrid query path: resolve the TRAINED hot/cold column
+    # split from the training data's histogram, exactly like the trainer
+    # does — queries then ride the same panel+residual kernels.  The
+    # training data is parsed ONLY when --hotCols asks for the split (a
+    # --trainFile alone would pay a full LIBSVM parse for nothing).
+    hot_ids = None
+    max_nnz = min(serving.DEFAULT_MAX_NNZ, d)
+    if cfg.train_file and extras["hotCols"] is not None:
+        from cocoa_tpu.data import hybrid as hybrid_lib
+
+        try:
+            data = load_libsvm(cfg.train_file, d)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        # queries are not training rows — the data's max row nnz only
+        # ever RAISES the default budget, never tightens it
+        max_nnz = min(d, max(max_nnz, int(data.max_nnz)))
+        counts = hybrid_lib.column_counts(data)
+        try:
+            hot_n = hybrid_lib.resolve_hot_width(
+                extras["hotCols"], counts, data.n, 1, dtype)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if hot_n:
+            hot_ids = hybrid_lib.hottest_columns(counts, hot_n)
+            if not quiet:
+                print(f"serve: hot panel over {hot_n} columns — "
+                      f"queries ride panel + residual")
+    if extras["serveMaxNnz"]:
+        try:
+            max_nnz = int(extras["serveMaxNnz"])
+        except ValueError:
+            max_nnz = 0
+        if max_nnz < 1:
+            print(f"error: --serveMaxNnz takes a positive per-query "
+                  f"nonzero budget, got {extras['serveMaxNnz']!r}",
+                  file=sys.stderr)
+            return 2
+        max_nnz = min(max_nnz, d)
+
+    path = serving.wait_for_model(cfg.chkpt_dir, algorithm,
+                                  timeout_s=300.0, quiet=quiet)
+    if path is None:
+        print(f"error: no validated {algorithm} checkpoint appeared in "
+              f"{cfg.chkpt_dir} within 300s — is the background trainer "
+              f"running with --chkptDir pointed here?", file=sys.stderr)
+        return 1
+    w, info = serving.load_model(path)
+    w = np.asarray(w)
+    # the trained width may exceed --numFeatures by lane padding (the
+    # loader pads d up; the pad columns carry no data, so their w slots
+    # are inert) — queries only ever gather ids < numFeatures.  A model
+    # NARROWER than the query surface is a real mismatch.
+    if w.ndim != 1 or w.shape[0] < d:
+        print(f"error: the serving checkpoint {path} carries w of shape "
+              f"{tuple(w.shape)} but --numFeatures={d} — the query "
+              f"width must fit inside the trained width (fix the flag "
+              f"or point --chkptDir at the right model)",
+              file=sys.stderr)
+        return 2
+
+    if bus.active():
+        manifest = telemetry.events.run_manifest(cfg_manifest,
+                                                 dataset=cfg.chkpt_dir)
+        manifest["serve"] = {
+            "algorithm": algorithm, "buckets": list(buckets),
+            "sla_ms": sla_ms, "max_nnz": max_nnz, "num_features": d,
+            "hot_cols": 0 if hot_ids is None else int(len(hot_ids)),
+        }
+        bus.emit("run_start", manifest=manifest)
+
+    slots = serving.ModelSlots(w, info, dtype=dtype)
+    scorer = serving.BatchScorer(d, dtype=dtype, buckets=buckets,
+                                 max_nnz=max_nnz, hot_ids=hot_ids)
+    serving.watcher.emit_model_swap(algorithm, info)   # the initial load
+    with tracing.span("serve_warmup", buckets=len(buckets)):
+        scorer.warmup(slots.current()[0])
+    if not quiet:
+        print(f"serve: model {algorithm} r{info.round} "
+              f"(gap={info.gap if info.gap is not None else 'n/a'}) — "
+              f"{len(buckets)} bucket executables compiled, swaps are "
+              f"compile-free from here")
+
+    batcher = serving.MicroBatcher(scorer, slots, sla_s=sla_ms / 1000.0,
+                                   algorithm=algorithm)
+
+    def note_swap(inf):
+        if not quiet:
+            print(f"serve: hot-swapped to r{inf.round} "
+                  f"(gap={inf.gap if inf.gap is not None else 'n/a'}, "
+                  f"swap #{inf.seq})", flush=True)
+
+    watcher = serving.SwapWatcher(slots, cfg.chkpt_dir, algorithm,
+                                  poll_s=0.25, on_swap=note_swap).start()
+    server = serving.MarginServer(batcher, d, max_nnz, port=port)
+    host, bound = server.address[0], server.address[1]
+    # the announce line is operational plumbing (the smoke parses it),
+    # not chatter — it prints even under --quiet
+    print(f"serve: listening on {host}:{bound} "
+          f"(buckets={','.join(str(b) for b in buckets)}, "
+          f"slaMs={sla_ms:g}, maxNnz={max_nnz})", flush=True)
+
+    # gap-age heartbeat: the freshness gauge renders `now - birth` at
+    # WRITE time, and writes are otherwise event-driven — a dead trainer
+    # plus an idle server (the exact alert scenario) would freeze the
+    # textfile.  A periodic unconditional rewrite keeps it climbing.
+    writer = getattr(bus, "metrics_writer", None)
+    if writer is not None:
+        writer.start_heartbeat(5.0)
+
+    def _stop(signum, frame):
+        server.stop()
+
+    prev = [signal.signal(signal.SIGTERM, _stop),
+            signal.signal(signal.SIGINT, _stop)]
+    try:
+        server.serve_forever()
+    finally:
+        signal.signal(signal.SIGTERM, prev[0])
+        signal.signal(signal.SIGINT, prev[1])
+        if writer is not None:
+            writer.stop_heartbeat()
+        watcher.stop()
+        batcher.stop()
+        server.close()
+    if bus.active():
+        bus.emit("run_end", algorithm=algorithm, stopped="shutdown")
+    if not quiet:
+        print(f"serve: shut down after {batcher.requests_total} "
+              f"request(s) in {batcher.batches_total} batch(es), "
+              f"{watcher.swaps_total} hot-swap(s), final gap age "
+              f"{slots.gap_age_s():.1f}s")
     return 0
 
 

@@ -52,6 +52,19 @@ w = w(α) holds exactly — so the reformed gang resumes from a state
 that embeds no half-joined round (pinned: tests/test_overlap.py
 ``test_gang_resize_with_staleness_drops_pending_joins``).
 
+**Serving across failures** (docs/DESIGN.md §17): a ``--serve`` process
+pointed at this gang's ``--chkptDir`` is deliberately OUTSIDE the gang
+— it reads validated checkpoint generations, never joins a collective —
+so nothing the supervisor does (SIGKILL teardown, shrink, restart
+backoff) can wedge or drop a query.  During an outage the server keeps
+answering from the last validated generation with its gap-age gauge
+climbing; the first save of the reformed gang is picked up by the swap
+watcher like any other generation.  The checkpoint discipline this
+relies on is already the shrink contract above: generations are
+complete (full gathered α, shard-count-keyed state) and validated
+newest-first with fallback, so a kill mid-save can never publish a torn
+model to the server.
+
 Activated by ``--elastic=N`` (or ``--elastic=N,shrink`` /
 ``--elastic=shrink``) on the CLI: the invoking process becomes the
 supervisor and re-executes its own command line N times with

@@ -1,0 +1,32 @@
+"""Production margin serving: score while you train (docs/DESIGN.md §17).
+
+The last north-star scenario: a compiled batched margin-scoring path
+(static buckets, one compile per bucket, the model as a plain argument)
+behind an adaptive micro-batcher, with double-buffered model slots that
+a background watcher hot-swaps atomically from the newest VALIDATED
+checkpoint generation — so the model a query hits is always certified,
+and its freshness is exported as gap age.  ``--serve`` on the CLI wires
+the whole stack; the pieces compose independently for tests and the
+bench:
+
+- scorer.py   — BatchScorer / ModelSlots / parse_query (the hot path)
+- batcher.py  — MicroBatcher (admission under the SLA, bucket choice)
+- watcher.py  — SwapWatcher / wait_for_model (checkpoint → slot)
+- server.py   — MarginServer (the TCP line protocol)
+"""
+
+from cocoa_tpu.serving.batcher import MicroBatcher, PendingQuery
+from cocoa_tpu.serving.scorer import (DEFAULT_BUCKETS, DEFAULT_MAX_NNZ,
+                                      BatchScorer, ModelInfo, ModelSlots,
+                                      QueryError, parse_query,
+                                      pick_bucket)
+from cocoa_tpu.serving.server import MarginServer
+from cocoa_tpu.serving.watcher import (SwapWatcher, load_model,
+                                       wait_for_model)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "DEFAULT_MAX_NNZ", "BatchScorer", "ModelInfo",
+    "ModelSlots", "QueryError", "parse_query", "pick_bucket",
+    "MicroBatcher", "PendingQuery", "MarginServer", "SwapWatcher",
+    "load_model", "wait_for_model",
+]

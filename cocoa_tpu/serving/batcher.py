@@ -1,0 +1,194 @@
+"""Adaptive micro-batching: admit under a latency budget, pad to a
+static bucket, dispatch once.
+
+The policy (docs/DESIGN.md §17): a request's latency is
+``admission wait + device time``, and throughput is real rows per
+compiled dispatch.  The batcher therefore
+
+- **waits only while the SLA can afford it** — the admission window for
+  a batch closes at ``oldest.t_enq + (sla - device_est - margin)``,
+  where ``device_est`` is a per-bucket EWMA of measured dispatch+fetch
+  time.  Traffic bursts fill big buckets; a lone request ships almost
+  immediately.
+- **picks the tightest bucket** — the smallest static bucket that holds
+  the admitted requests maximizes fill ratio (real/padded rows), which
+  is the throughput maximizer under one-compile-per-bucket.
+
+Instrumentation: the admission wait and the device dispatch are
+separate spans (``serve_admit`` / ``serve_score``), so
+``trace_report`` attributes queueing vs device time per batch; every
+batch emits one typed ``serve_request`` event (n, bucket, fill ratio,
+queue/device seconds, per-request latency max/mean, the model round it
+was answered by).
+
+Swap interaction: the batcher reads ``slots.current()`` ONCE per batch
+— the whole bucket is answered by exactly one model generation, and a
+swap that lands mid-admission simply takes effect at the next batch
+boundary.  Nothing blocks, nothing drops.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from cocoa_tpu.serving.scorer import pick_bucket
+
+# fraction of the SLA reserved against estimate error + fetch jitter:
+# the admission window never spends the whole budget on waiting
+_SLA_SAFETY = 0.25
+_EWMA = 0.3
+# early-ship rule: once the queue has been idle this long, stop waiting
+# for stragglers — under light traffic latency collapses to roughly
+# device time + one idle gap, while a burst (requests arriving
+# back-to-back) keeps admitting until the bucket or the SLA window
+# closes.  This is what makes the batcher ADAPTIVE rather than a fixed
+# timer: the wait is bounded by the SLA but paid only while it buys fill
+_IDLE_GAP_S = 0.002
+
+
+class PendingQuery:
+    """One in-flight request: parsed arrays in, margin (or error) out."""
+
+    __slots__ = ("idx", "val", "t_enq", "done", "margin", "error",
+                 "model_round")
+
+    def __init__(self, idx, val):
+        self.idx = idx
+        self.val = val
+        self.t_enq = time.monotonic()
+        self.done = threading.Event()
+        self.margin = None
+        self.error = None
+        self.model_round = None
+
+    def result(self, timeout: Optional[float] = None) -> float:
+        if not self.done.wait(timeout):
+            raise TimeoutError("serving batch never completed")
+        if self.error is not None:
+            raise self.error
+        return self.margin
+
+
+class MicroBatcher:
+    """Owns the scoring thread: drains the request queue into padded
+    buckets and dispatches them through the compiled scorer."""
+
+    def __init__(self, scorer, slots, sla_s: float = 0.05,
+                 algorithm: str = "serve"):
+        self.scorer = scorer
+        self.slots = slots
+        self.sla_s = float(sla_s)
+        self.algorithm = algorithm
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._device_est = {b: 0.0 for b in scorer.buckets}
+        self.batches_total = 0
+        self.requests_total = 0
+        self.slots_total = 0    # Σ bucket — the fill-ratio denominator
+        self.failed_total = 0   # requests that DIED (scorer raised);
+        # rejected-at-parse queries never reach the batcher
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cocoa-serve-batcher")
+        self._thread.start()
+
+    def submit(self, idx, val) -> PendingQuery:
+        """Enqueue one parsed query; returns its pending handle."""
+        pend = PendingQuery(idx, val)
+        self._q.put(pend)
+        return pend
+
+    def score_sync(self, idx, val, timeout: Optional[float] = None):
+        """Submit + wait: the in-process client the bench and tests use."""
+        return self.submit(idx, val).result(timeout)
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        self._q.put(None)   # wake the blocking get
+        self._thread.join(timeout)
+
+    # --- the scoring thread --------------------------------------------------
+
+    def _admit(self, first) -> list:
+        """Gather requests behind ``first`` while the SLA affords it."""
+        max_bucket = self.scorer.buckets[-1]
+        batch = [first]
+        est = max(self._device_est.values())
+        window = max(0.0, self.sla_s * (1.0 - _SLA_SAFETY) - est)
+        deadline = first.t_enq + window
+        while len(batch) < max_bucket:
+            remaining = deadline - time.monotonic()
+            try:
+                nxt = (self._q.get_nowait() if remaining <= 0
+                       else self._q.get(timeout=min(remaining,
+                                                    _IDLE_GAP_S)))
+            except queue.Empty:
+                break   # queue went idle (or the SLA window closed):
+                        # waiting longer buys latency, not fill
+            if nxt is None:   # stop sentinel — score what we hold
+                self._q.put(None)
+                break
+            batch.append(nxt)
+        return batch
+
+    def _run(self):
+        import numpy as np
+
+        from cocoa_tpu.analysis import sanitize
+        from cocoa_tpu.telemetry import events as tele_events
+        from cocoa_tpu.telemetry import tracing
+
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is None:
+                continue
+            with tracing.span("serve_admit"):
+                batch = self._admit(first)
+            bucket = pick_bucket(len(batch), self.scorer.buckets)
+            w_dev, info = self.slots.current()   # one model per batch
+            t_score = time.monotonic()
+            queue_s = t_score - first.t_enq
+            try:
+                with tracing.span("serve_score", bucket=bucket,
+                                  n=len(batch)):
+                    idx, val, hot = self.scorer.assemble(
+                        [(p.idx, p.val) for p in batch], bucket)
+                    out = self.scorer.score(w_dev, idx, val, hot)
+                    # the ONE sanctioned device→host crossing per batch
+                    # (the zero-unintended-transfers contract)
+                    with sanitize.intended_fetch("serve_fetch"):
+                        margins = np.asarray(out)
+            except Exception as e:   # answer the callers, keep serving
+                self.failed_total += len(batch)
+                for p in batch:
+                    p.error = e
+                    p.done.set()
+                continue
+            device_s = time.monotonic() - t_score
+            est = self._device_est[bucket]
+            self._device_est[bucket] = (device_s if est == 0.0
+                                        else (1 - _EWMA) * est
+                                        + _EWMA * device_s)
+            done = time.monotonic()
+            lats = [done - p.t_enq for p in batch]
+            for r, p in enumerate(batch):
+                p.margin = float(margins[r])
+                p.model_round = info.round
+                p.done.set()
+            self.batches_total += 1
+            self.requests_total += len(batch)
+            self.slots_total += bucket
+            bus = tele_events.get_bus()
+            if bus.active():
+                bus.emit(
+                    "serve_request", algorithm=self.algorithm,
+                    n=len(batch), bucket=bucket,
+                    fill_ratio=len(batch) / bucket, queue_s=queue_s,
+                    device_s=device_s, latency_max_s=max(lats),
+                    latency_mean_s=sum(lats) / len(lats),
+                    model_round=info.round)

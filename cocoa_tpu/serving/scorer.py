@@ -1,0 +1,280 @@
+"""Compiled batched margin scoring with static buckets + atomic hot-swap.
+
+The serving hot path answers batched margin queries ``x·w`` while a
+background trainer keeps ``w`` fresh (docs/DESIGN.md §17).  Two
+perf-critical contracts live here:
+
+- **One compile per bucket, ever.**  Queries are padded UP to a static
+  batch bucket (default 64/256/1024 — :data:`DEFAULT_BUCKETS`), so the
+  one jitted scoring function specializes exactly once per bucket shape
+  and NEVER again: the model ``w`` is an ordinary argument with a fixed
+  shape/dtype, which is what makes a hot-swap free — a swap changes
+  bytes, not shapes, so it cannot retrace, recompile, or stall the
+  dispatch queue behind a compile.  Padded slots carry index 0 / value
+  0 and contribute exactly 0 to every margin, the same convention as
+  the training padded-CSR (ops/rows.py).
+- **The same kernels the evaluator uses.**  Scoring goes through
+  ``ops/rows.shard_margins`` — the one layout dispatch point — so a
+  sparse query batch rides the gather-sum, and when the model was
+  trained with a hot/cold column split (``--hotCols``, data/hybrid.py)
+  the batch is split the same way: the hot majority of nonzeros as one
+  MXU panel matvec, only the cold tail through the gather.
+
+:class:`ModelSlots` is the double-buffered model holder: the live
+``(w, info)`` pair is published as ONE tuple behind a single attribute,
+so a reader (the batcher thread) either sees the old model or the new
+one, never a torn mix; an in-flight batch keeps its reference to the
+old device buffer until its dispatch completes, so a swap can never
+drop or block a request.  The spare slot is wherever the next upload
+lands — ``device_put`` into fresh memory while the old buffer serves.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+DEFAULT_BUCKETS = (64, 256, 1024)
+
+# static per-query nonzero budget when the caller gives none: covers the
+# text-classification row shapes this repo trains on (rcv1 max row nnz
+# 548 at the shard level, typical queries far shorter) without making
+# the padded batch huge.  `--serveMaxNnz` overrides it on the CLI.
+DEFAULT_MAX_NNZ = 512
+
+
+class QueryError(ValueError):
+    """A malformed or out-of-contract query — rejected with the numbers,
+    never silently truncated (the CLI-hardening principle)."""
+
+
+def parse_query(text: str, num_features: int, max_nnz: int):
+    """One query line (LIBSVM feature grammar, ``idx:val`` pairs,
+    1-based ids) -> ``(idx, val)`` int32/float arrays, 0-based.
+
+    Rejections carry the numbers: a feature id outside the trained
+    width, more nonzeros than the static padding budget, or a pair the
+    shared decimal grammar cannot parse."""
+    toks = text.split()
+    if not toks:
+        raise QueryError("empty query (expected 'idx:val idx:val ...', "
+                         "1-based feature ids)")
+    idx, val = [], []
+    for m, tok in enumerate(toks):
+        head, sep, tail = tok.partition(":")
+        try:
+            i = int(head)
+            v = float(tail)
+        except ValueError:
+            sep = ""
+        if not sep:
+            raise QueryError(f"malformed pair {tok!r} at position {m} "
+                             f"(expected 'idx:val')")
+        if i < 1 or i > num_features:
+            raise QueryError(
+                f"feature id {i} outside the trained width: this model "
+                f"serves num_features={num_features} (1-based ids "
+                f"1..{num_features})")
+        idx.append(i - 1)
+        val.append(v)
+    if len(toks) > max_nnz:
+        raise QueryError(
+            f"query carries {len(toks)} nonzeros but the compiled "
+            f"scoring path pads to max_nnz={max_nnz} — restart the "
+            f"server with --serveMaxNnz>={len(toks)} or sparsify the "
+            f"query")
+    # jaxlint: allow=f64 -- exact host-side text parse; values cast to
+    # the serving dtype at batch assembly, never enter device compute
+    return np.asarray(idx, np.int32), np.asarray(val, np.float64)
+
+
+def pick_bucket(n: int, buckets: tuple) -> int:
+    """The smallest static bucket that holds ``n`` requests (the
+    throughput maximizer: least padding = most real rows per compiled
+    dispatch).  Callers cap admission at ``buckets[-1]``."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds the largest bucket "
+                     f"{buckets[-1]} — the batcher must cap admission")
+
+
+class ModelInfo(NamedTuple):
+    """What the serving loop knows about the model in the live slot."""
+
+    round: Optional[int]       # training round the checkpoint stamped
+    path: Optional[str]        # checkpoint file it came from
+    birth_ts: float            # checkpoint mtime: when the certificate
+                               # (and the model) was produced — the
+                               # anchor of the gap-age freshness gauge
+    gap: Optional[float]       # certified duality gap the checkpoint
+                               # meta recorded (None on pre-gap metas)
+    seq: int                   # swap sequence number (0 = initial load)
+
+
+class ModelSlots:
+    """Double-buffered device-resident model with atomic hot-swap.
+
+    ``current()`` returns the live ``(w_device, ModelInfo)`` tuple; the
+    pair is swapped by replacing ONE attribute reference, so readers on
+    the scoring thread never observe a torn (new w, old info) state and
+    never block on a swap.  The upload of the incoming model happens on
+    the CALLER's thread (the watcher) into a fresh buffer — the live
+    buffer keeps serving until the publish, and in-flight batches that
+    already captured the old reference complete against it untouched.
+    """
+
+    def __init__(self, w, info: ModelInfo, dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._dtype = jnp.dtype(dtype) if dtype is not None else None
+        w_dev = jax.device_put(self._cast(w))
+        self._live = (w_dev, info)
+        self._lock = threading.Lock()   # serializes WRITERS only
+
+    def _cast(self, w):
+        w = np.asarray(w)
+        if self._dtype is not None:
+            w = w.astype(self._dtype)
+        return w
+
+    def current(self):
+        return self._live
+
+    @property
+    def info(self) -> ModelInfo:
+        return self._live[1]
+
+    def gap_age_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the live model's certificate was produced —
+        the freshness the serving loop exports
+        (``cocoa_model_gap_age_seconds``)."""
+        return (now if now is not None else time.time()) \
+            - self._live[1].birth_ts
+
+    def swap(self, w, info: ModelInfo):
+        """Upload ``w`` into the spare slot and publish atomically.
+
+        A shape/dtype change is rejected with the numbers — static
+        shapes are what make a swap compile-free, so a width change is
+        a different MODEL, not a fresh generation of this one."""
+        import jax
+
+        with self._lock:
+            live_w = self._live[0]
+            w = self._cast(w)
+            if w.shape != live_w.shape:
+                raise QueryError(
+                    f"refusing hot-swap: incoming w has shape "
+                    f"{tuple(w.shape)} but the serving executable is "
+                    f"compiled for {tuple(live_w.shape)} — a width "
+                    f"change is a new model (restart the server)")
+            w_dev = jax.device_put(w)
+            self._live = (w_dev, info)
+        return info
+
+
+class BatchScorer:
+    """The compiled scoring path: one jit, one specialization per
+    bucket, the model as a plain argument (hot-swap never retraces).
+
+    ``hot_ids`` (optional) arms the hybrid path: queries split into a
+    dense panel over the trained hot columns plus a cold residual, and
+    ride the SAME panel+residual dispatch in ``shard_margins`` the
+    evaluator uses (docs/DESIGN.md §3b-vi).
+    """
+
+    def __init__(self, num_features: int, dtype=None,
+                 buckets: tuple = DEFAULT_BUCKETS,
+                 max_nnz: int = DEFAULT_MAX_NNZ,
+                 hot_ids=None):
+        import jax
+        import jax.numpy as jnp
+
+        from cocoa_tpu.ops import rows as rows_mod
+
+        if not buckets or list(buckets) != sorted(set(int(b)
+                                                      for b in buckets)):
+            raise ValueError(f"buckets must be strictly increasing "
+                             f"positive ints, got {buckets!r}")
+        if buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {buckets!r}")
+        self.num_features = int(num_features)
+        self.dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.dtype(jnp.float32)
+        self.buckets = tuple(int(b) for b in buckets)
+        self.max_nnz = int(min(max_nnz, num_features))
+        self.hot_rank = None
+        self._hot_cols_dev = None
+        if hot_ids is not None and len(hot_ids):
+            from cocoa_tpu.data import hybrid as hybrid_lib
+
+            hot_ids = np.asarray(hot_ids, np.int64)
+            self.hot_rank = hybrid_lib.hot_rank(self.num_features,
+                                                hot_ids)
+            self._hot_cols_dev = jax.device_put(
+                np.asarray(hot_ids, np.int32))
+        self.n_hot = (0 if self._hot_cols_dev is None
+                      else int(self._hot_cols_dev.shape[0]))
+
+        hot_cols = self._hot_cols_dev
+
+        def serve_margins(w, idx, val, hot):
+            shard = {"sp_indices": idx, "sp_values": val}
+            if hot is not None:
+                shard["X_hot"] = hot
+                shard["hot_cols"] = hot_cols
+            return rows_mod.shard_margins(w, shard)
+
+        # built ONCE at construction (the serve-hygiene rule pins this
+        # shape statically): every later call only re-specializes on a
+        # new BUCKET shape, never on the model or the request content
+        self._jit = jax.jit(serve_margins)
+
+    def assemble(self, queries: list, bucket: int):
+        """Pad parsed ``(idx, val)`` queries up to ``bucket`` rows of
+        static width; returns the device-ready host arrays.  With a hot
+        split armed, each query's nonzeros partition into the panel
+        lanes and the cold residual exactly like the training slabs
+        (data/hybrid.split_slab semantics, per query row)."""
+        np_dtype = np.dtype(self.dtype)
+        idx = np.zeros((bucket, self.max_nnz), np.int32)
+        val = np.zeros((bucket, self.max_nnz), np_dtype)
+        hot = (np.zeros((bucket, self.n_hot), np_dtype)
+               if self.n_hot else None)
+        for r, (qi, qv) in enumerate(queries):
+            if self.hot_rank is None:
+                idx[r, :len(qi)] = qi
+                val[r, :len(qi)] = qv
+            else:
+                lanes = self.hot_rank[qi]
+                is_hot = lanes >= 0
+                # ACCUMULATE into the panel (np.add.at), don't assign:
+                # a query may repeat a feature id, and the gather path
+                # sums duplicates (each occupies its own CSR slot) — a
+                # last-write assignment here would answer differently
+                # on a --hotCols server than on a plain one
+                np.add.at(hot[r], lanes[is_hot], qv[is_hot])
+                ci, cv = qi[~is_hot], qv[~is_hot]
+                idx[r, :len(ci)] = ci
+                val[r, :len(cv)] = cv
+        return idx, val, hot
+
+    def score(self, w_dev, idx, val, hot=None):
+        """Dispatch one padded bucket; returns the DEVICE margins array
+        (the caller fetches once, under ``intended_fetch`` — the
+        zero-unintended-transfers contract)."""
+        return self._jit(w_dev, idx, val, hot)
+
+    def warmup(self, w_dev):
+        """Compile every bucket up front so no request ever pays a
+        compile; returns the bucket count (== the expected compile
+        count, what the sanitizer pin asserts)."""
+        for b in self.buckets:
+            idx, val, hot = self.assemble([], b)
+            np.asarray(self.score(w_dev, idx, val, hot))
+        return len(self.buckets)

@@ -1,0 +1,121 @@
+"""The serving front end: a line-oriented TCP margin server.
+
+Protocol (one JSON response line per request line):
+
+- a request line is one query in the LIBSVM feature grammar
+  (``idx:val idx:val ...``, 1-based ids), or several queries joined
+  with ``;`` — a client-side batch, which the micro-batcher scores as
+  one padded bucket;
+- the response is ``{"margin": m, "round": r}`` per query (``round`` =
+  the training round of the model generation that answered — how a
+  client observes a hot-swap), a JSON array of those for a ``;`` batch,
+  or ``{"error": "..."}`` with the numbers for a rejected query
+  (rejections are per query: one bad query in a batch fails only
+  itself);
+- ``shutdown`` stops the whole server (acknowledged first) — the
+  clean-exit path the smoke tests and the CLI's signal handlers share.
+
+Connections are thread-per-client (stdlib ThreadingTCPServer); the
+batcher is what turns concurrent connections into filled buckets.  The
+server owns no model state — it parses, submits, and relays — so
+nothing here ever touches the swap path.
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+from typing import Optional
+
+from cocoa_tpu.serving.scorer import QueryError, parse_query
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv = self.server
+        for raw in self.rfile:
+            try:
+                line = raw.decode("utf-8", errors="replace").strip()
+            except Exception:
+                break
+            if not line:
+                continue
+            if line == "shutdown":
+                self._reply({"ok": "shutting down"})
+                srv.initiate_shutdown()
+                return
+            self._reply(srv.margin_server.answer_line(line))
+
+    def _reply(self, obj):
+        try:
+            self.wfile.write((json.dumps(obj) + "\n").encode())
+            self.wfile.flush()
+        except OSError:
+            pass   # client went away; its answers are already computed
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+    margin_server: "MarginServer" = None
+
+    def initiate_shutdown(self):
+        # shutdown() blocks until serve_forever exits — never call it
+        # from a handler (or signal) frame that serve_forever is waiting
+        # on; hand it to a throwaway thread
+        threading.Thread(target=self.shutdown, daemon=True).start()
+
+
+class MarginServer:
+    """Glue: sockets in front, the micro-batcher behind."""
+
+    def __init__(self, batcher, num_features: int, max_nnz: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.batcher = batcher
+        self.num_features = int(num_features)
+        self.max_nnz = int(max_nnz)
+        self._tcp = _TCPServer((host, port), _Handler,
+                               bind_and_activate=True)
+        self._tcp.margin_server = self
+
+    @property
+    def address(self):
+        """(host, port) actually bound — port 0 resolves here."""
+        return self._tcp.server_address
+
+    def answer_line(self, line: str):
+        """Parse one request line, submit through the batcher, wait for
+        the batch, shape the JSON-able response."""
+        texts = [t for t in line.split(";") if t.strip()]
+        pendings = []
+        for text in texts:
+            try:
+                idx, val = parse_query(text, self.num_features,
+                                       self.max_nnz)
+            except QueryError as e:
+                pendings.append({"error": str(e)})
+                continue
+            pendings.append(self.batcher.submit(idx, val))
+        out = []
+        for p in pendings:
+            if isinstance(p, dict):
+                out.append(p)
+                continue
+            try:
+                margin = p.result(timeout=30.0)
+                out.append({"margin": margin, "round": p.model_round})
+            except Exception as e:
+                out.append({"error": f"{type(e).__name__}: {e}"})
+        return out if len(texts) > 1 else out[0] if out \
+            else {"error": "empty request line"}
+
+    def serve_forever(self, poll_interval: float = 0.2):
+        """Block until ``shutdown`` (protocol line or :meth:`stop`)."""
+        self._tcp.serve_forever(poll_interval=poll_interval)
+
+    def stop(self):
+        self._tcp.initiate_shutdown()
+
+    def close(self):
+        self._tcp.server_close()

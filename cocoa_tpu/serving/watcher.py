@@ -1,0 +1,166 @@
+"""The hot-swap watcher: poll for the newest VALIDATED generation, swap
+atomically, export freshness as gap age.
+
+A background thread polls ``checkpoint.latest()`` (whose validation is
+cached on (path, mtime, size) — an unchanged generation costs one stat
+per retained file, so poll-rate watching is cheap) and, when a NEW
+healthy generation appears, loads it and swaps the model slots.  The
+swap is a device-buffer update behind one atomic reference publish
+(serving/scorer.ModelSlots): shapes are static, so it never recompiles,
+and an in-flight batch keeps the old buffer — a swap under sustained
+traffic drops zero requests and the post-swap margins are bit-identical
+to a cold restart on the new checkpoint (pinned,
+tests/test_serving.py).
+
+Freshness semantics (docs/DESIGN.md §17): the paper's primal-dual
+certificate is what makes serving-while-training trustworthy, so the
+exported freshness is **gap age** — seconds since the live model's
+certificate (its checkpoint, whose meta carries the last certified
+duality gap) was produced.  A healthy trainer keeps gap age bounded by
+its checkpoint cadence; a dead or wedged trainer shows up as a
+monotonically climbing gauge long before anyone reads a stale margin.
+
+Elastic interaction: checkpoints are complete and shard-count-keyed
+(docs/DESIGN.md §13), so a gang restart or shrink-to-survivors of the
+background trainer changes WHO writes the next generation, never what
+this watcher reads — serving degrades to "older model, climbing gap
+age" during the outage and recovers at the next validated save.  A torn
+generation falls back inside ``checkpoint.latest`` (with its typed
+``checkpoint_corrupt`` event) and is simply not swapped in.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from cocoa_tpu.serving.scorer import ModelInfo, QueryError
+
+
+def load_model(path: str):
+    """(w, ModelInfo) from one validated checkpoint path."""
+    from cocoa_tpu import checkpoint as ckpt_lib
+
+    meta, arrays = ckpt_lib.load_full(path)
+    try:
+        birth = os.stat(path).st_mtime
+    except OSError:
+        birth = time.time()
+    info = ModelInfo(round=meta.get("round"), path=path, birth_ts=birth,
+                     gap=meta.get("gap"), seq=0)
+    return arrays["w"], info
+
+
+def wait_for_model(directory: str, algorithm: str,
+                   timeout_s: float = 300.0, poll_s: float = 0.25,
+                   quiet: bool = False) -> Optional[str]:
+    """Block until a validated checkpoint exists (serve-while-you-train:
+    the trainer may still be warming up when the server starts); None
+    on timeout."""
+    from cocoa_tpu import checkpoint as ckpt_lib
+
+    deadline = time.monotonic() + timeout_s
+    noted = False
+    while True:
+        path = ckpt_lib.latest(directory, algorithm)
+        if path is not None:
+            return path
+        if time.monotonic() >= deadline:
+            return None
+        if not noted and not quiet:
+            print(f"serve: waiting for the first validated {algorithm} "
+                  f"checkpoint in {directory} (the background trainer "
+                  f"has not saved yet)", file=sys.stderr, flush=True)
+            noted = True
+        time.sleep(poll_s)
+
+
+class SwapWatcher:
+    """Poll-and-swap thread.  ``on_swap(info)`` (optional) runs after
+    each publish — the server uses it for console notes."""
+
+    def __init__(self, slots, directory: str, algorithm: str,
+                 poll_s: float = 0.25, on_swap=None):
+        self.slots = slots
+        self.directory = directory
+        self.algorithm = algorithm
+        self.poll_s = float(poll_s)
+        self.on_swap = on_swap
+        self.swaps_total = 0
+        self.rejected_total = 0
+        self._stop = threading.Event()
+        self._seen = slots.info.path
+        self._rejected = None   # a generation refused once (width
+        # mismatch) is not retried every poll — it cannot heal in place
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cocoa-serve-watcher")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        self._thread.join(timeout)
+
+    def poll_once(self) -> bool:
+        """One poll step (also the test hook): swap if a new validated
+        generation appeared; returns whether a swap happened."""
+        from cocoa_tpu import checkpoint as ckpt_lib
+
+        path = ckpt_lib.latest(self.directory, self.algorithm)
+        if path is None or path == self._seen or path == self._rejected:
+            return False
+        try:
+            w, info = load_model(path)
+        except (OSError, ValueError, KeyError) as e:
+            # lost a race with pruning, or a reader-level tear latest()'s
+            # validation could not see — the next poll re-resolves
+            print(f"serve: could not load {path} ({e}); keeping the "
+                  f"current model", file=sys.stderr, flush=True)
+            return False
+        self.swaps_total += 1
+        info = info._replace(seq=self.swaps_total)
+        try:
+            self.slots.swap(w, info)
+        except QueryError as e:
+            self.rejected_total += 1
+            self.swaps_total -= 1
+            self._rejected = path
+            print(f"serve: {e}", file=sys.stderr, flush=True)
+            return False
+        self._seen = path
+        emit_model_swap(self.algorithm, info)
+        if self.on_swap is not None:
+            self.on_swap(info)
+        return True
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:   # the watcher must outlive hiccups
+                print(f"serve: watcher error ({type(e).__name__}: {e}); "
+                      f"retrying", file=sys.stderr, flush=True)
+            self._stop.wait(self.poll_s)
+
+
+def emit_model_swap(algorithm: str, info: ModelInfo):
+    """The typed ``model_swap`` event: which generation went live, what
+    certificate it carries, and how old that certificate already was at
+    swap time (the gap-age gauge anchors on ``birth_ts``)."""
+    from cocoa_tpu.telemetry import events as tele_events
+
+    bus = tele_events.get_bus()
+    if bus.active():
+        # swap_seq, not "seq": every bus record already carries the
+        # stream-ordering seq, and a same-named field would overwrite it
+        bus.emit("model_swap", algorithm=algorithm,
+                 round=(int(info.round) if info.round is not None
+                        else None),
+                 path=info.path, birth_ts=info.birth_ts, gap=info.gap,
+                 gap_age_s=max(0.0, time.time() - info.birth_ts),
+                 swap_seq=info.seq)

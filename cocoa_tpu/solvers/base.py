@@ -476,6 +476,18 @@ def resolve_divergence_guard(flag: str, mode: str, sigma: float, k: int,
     return mode in ("plus", "prox") and sigma < k * gamma
 
 
+def _last_gap(traj):
+    """The most recent eval-cadence duality gap the trajectory holds
+    (None before the first eval / on gap-less solvers) — what every
+    checkpoint save stamps into its meta so the serving hot-swap
+    watcher can report which certificate the model it publishes
+    carries (cocoa_tpu/serving/, docs/DESIGN.md §17)."""
+    for rec in reversed(traj.records):
+        if rec.gap is not None:
+            return float(rec.gap)
+    return None
+
+
 class _GapWatch:
     """Windowed no-improvement watch over eval-cadence gap values;
     ``update(gap)`` returns True when the run should bail out (diverged or
@@ -546,6 +558,7 @@ def drive(
                 state[1] if len(state) > 1 else None, seed=debug.seed,
                 sched=state[-1] if len(state) > 2 else None,
                 hist=state[2] if len(state) > 3 else None,
+                gap=_last_gap(traj),
             )
     return state, traj
 
@@ -670,6 +683,7 @@ def drive_chunked(
                 state[1] if len(state) > 1 else None, seed=debug.seed,
                 sched=state[-1] if len(state) > 2 else None,
                 hist=state[2] if len(state) > 3 else None,
+                gap=_last_gap(traj),
             )
     return state, traj
 
@@ -1210,6 +1224,7 @@ def drive_device_full(
                 seed=debug.seed,
                 sched=state[-1] if len(state) > 2 else None,
                 hist=state[2] if len(state) > 3 else None,
+                gap=_last_gap(traj),
             )
             if overlap_io:
                 _join_io()

@@ -83,6 +83,19 @@ EVENT_TYPES = (
     "tenant_certified", # one tenant crossed its duality-gap target
                         # inside the fleet's vmapped loop — what feeds
                         # cocoa_tenants_certified_total
+    "serve_request",    # one scored serving batch (--serve,
+                        # serving/batcher.py): n real requests, the
+                        # static bucket they padded into, fill ratio,
+                        # queue vs device seconds, per-request latency
+                        # max/mean, and the model round that answered —
+                        # what feeds cocoa_serve_qps /
+                        # cocoa_serve_latency_seconds /
+                        # cocoa_serve_batch_fill_ratio
+    "model_swap",       # the serving watcher published a new validated
+                        # checkpoint generation into the live model slot
+                        # (serving/watcher.py): round, path, certified
+                        # gap, and the certificate's birth timestamp —
+                        # what anchors cocoa_model_gap_age_seconds
 )
 
 
@@ -117,6 +130,10 @@ class EventBus:
         self._lock = threading.RLock()
         self.jsonl_path = None
         self.metrics_path = None
+        self.metrics_writer = None   # the MetricsWriter configure()
+        # attached (None otherwise) — owners that need more than the
+        # subscriber protocol (the serving loop's gap-age heartbeat)
+        # reach it here instead of poking _subscribers
         self.max_bytes = None
         self._subscribers = []
         self._seq = 0
@@ -143,8 +160,9 @@ class EventBus:
             if metrics_path and metrics_path != self.metrics_path:
                 from cocoa_tpu.telemetry.metrics import MetricsWriter
 
-                self.subscribe(MetricsWriter(
-                    metrics_path, flush_interval_s=metrics_interval_s))
+                self.metrics_writer = MetricsWriter(
+                    metrics_path, flush_interval_s=metrics_interval_s)
+                self.subscribe(self.metrics_writer)
                 self.metrics_path = metrics_path
         if self.active():
             from cocoa_tpu.analysis import sanitize
@@ -170,6 +188,9 @@ class EventBus:
         with self._lock:
             self.jsonl_path = None
             self.metrics_path = None
+            if self.metrics_writer is not None:
+                self.metrics_writer.stop_heartbeat()
+            self.metrics_writer = None
             self.max_bytes = None
             self._subscribers = []
             self._seq = 0
@@ -186,6 +207,12 @@ class EventBus:
         if event not in EVENT_TYPES:
             raise ValueError(f"unknown event type {event!r}; "
                              f"expected one of {EVENT_TYPES}")
+        reserved = {"event", "seq", "pid", "ts"} & fields.keys()
+        if reserved:
+            # a payload field named like the envelope would silently
+            # overwrite it — the model_swap 'seq' collision class of bug
+            raise ValueError(f"event field(s) {sorted(reserved)} collide "
+                             f"with the record envelope; rename them")
         with self._lock:
             self._seq += 1
             # pid identifies the EMITTER: a supervised run interleaves

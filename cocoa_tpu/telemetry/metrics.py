@@ -66,6 +66,31 @@ otherwise one opaque device dispatch:
 - ``cocoa_fleet_models_per_second`` gauge — the fleet run's headline
   throughput: tenants certified per wall-clock second through the ONE
   compiled vmapped round (carried by the final ``fleet_progress``)
+- ``cocoa_serve_qps``           gauge — serving throughput: requests
+  answered per second, averaged over the lifetime of the serving run
+  (the ``serve_request`` events; 1 s floor on the denominator so a
+  single burst cannot render an absurd rate).  Present only once a
+  serve run has answered.  ``cocoa_serve_requests_total`` /
+  ``cocoa_serve_batches_total`` counters ride alongside
+- ``cocoa_serve_latency_seconds`` histogram — per-batch WORST request
+  latency (admission to answer).  Charging every batch its max is the
+  conservative SLA accounting: the rendered p99 upper-bounds the true
+  per-request p99
+- ``cocoa_serve_batch_fill_ratio`` gauge — real requests / padded
+  bucket slots, cumulative: how much of the compiled dispatch work is
+  real.  Low fill under load means the bucket ladder or the admission
+  window is mis-tuned
+- ``cocoa_model_swaps_total``   counter — validated checkpoint
+  generations hot-swapped into the live serving slot (``model_swap``)
+- ``cocoa_model_gap_age_seconds`` gauge — freshness of the SERVING
+  model: seconds (at render time) since the live model's certificate —
+  its checkpoint — was produced.  A healthy background trainer keeps
+  this bounded by its checkpoint cadence; a climbing value is a dead or
+  wedged trainer, visible long before anyone reads a stale margin.
+  Because the value is computed at write time, the serving loop arms
+  :meth:`MetricsWriter.start_heartbeat` — a periodic unconditional
+  rewrite — so the gauge keeps climbing even when no events arrive
+  (a dead trainer + an idle server is exactly the alert scenario)
 - ``cocoa_last_gap``            gauge   — most recent duality gap
 - ``cocoa_round_seconds``       histogram — observed per-round wall time
   (host-clock deltas between consecutive evals divided by the rounds
@@ -126,6 +151,8 @@ class MetricsWriter:
         self._last_write = 0.0
         self._dirty = False
         self._timer = None
+        self._hb_timer = None       # start_heartbeat's repeating timer
+        self._hb_interval = None
         self.rounds_total = 0
         self.evals_total = 0
         self.sigma_backoffs_total = 0
@@ -148,6 +175,16 @@ class MetricsWriter:
         self.fleet_tenants_active = None
         self.tenants_certified_total = 0
         self.fleet_models_per_second = None
+        self.serve_requests_total = 0
+        self.serve_batches_total = 0
+        self.serve_slots_total = 0      # Σ bucket — the fill denominator
+        self.serve_first_ts = None
+        self.serve_last_ts = None
+        self.serve_lat_buckets = [0] * (len(BUCKETS) + 1)
+        self.serve_lat_sum = 0.0
+        self.serve_lat_count = 0
+        self.model_swaps_total = 0
+        self.model_birth_ts = None      # live model's certificate birth
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -259,6 +296,37 @@ class MetricsWriter:
                     rec["models_per_second"])
         elif ev == "tenant_certified":
             self.tenants_certified_total += 1
+        elif ev == "serve_request":
+            n = int(rec.get("n") or 0)
+            self.serve_requests_total += n
+            self.serve_batches_total += 1
+            self.serve_slots_total += int(rec.get("bucket") or 0)
+            ts = rec.get("ts")
+            if ts is not None:
+                if self.serve_first_ts is None:
+                    self.serve_first_ts = float(ts)
+                self.serve_last_ts = float(ts)
+            lat = rec.get("latency_max_s")
+            if lat is not None:
+                # per-batch WORST latency: conservative SLA accounting
+                # (the rendered p99 upper-bounds the per-request p99)
+                lat = float(lat)
+                self.serve_lat_sum += lat
+                self.serve_lat_count += 1
+                for j, b in enumerate(BUCKETS):
+                    if lat <= b:
+                        self.serve_lat_buckets[j] += 1
+                        break
+                else:
+                    self.serve_lat_buckets[-1] += 1
+        elif ev == "model_swap":
+            # swap_seq 0 is the server's INITIAL load (it anchors gap
+            # age but is not a hot-swap) — counting it would disagree by
+            # one with the watcher's swaps_total and the bench row
+            if rec.get("swap_seq"):
+                self.model_swaps_total += 1
+            if rec.get("birth_ts") is not None:
+                self.model_birth_ts = float(rec["birth_ts"])
 
     def _maybe_write(self, ev):
         """The write debounce (caller holds the lock): flush-now events
@@ -288,6 +356,42 @@ class MetricsWriter:
                     self.write()
                 except OSError:
                     pass
+
+    def start_heartbeat(self, interval_s: float = 5.0):
+        """Periodic UNCONDITIONAL rewrite, independent of events — the
+        serving loop arms this because its render-time gauges
+        (``cocoa_model_gap_age_seconds``) must keep moving when no
+        events arrive: a dead trainer plus an idle server is exactly
+        the scenario the climbing gauge exists to alert on, and an
+        event-driven-only writer would freeze the textfile there.
+        Best-effort like :meth:`flush`; idempotent; daemon timers."""
+        with self._lock:
+            self._hb_interval = float(interval_s)
+            if self._hb_timer is None:
+                self._arm_heartbeat()
+
+    def stop_heartbeat(self):
+        with self._lock:
+            self._hb_interval = None
+            if self._hb_timer is not None:
+                self._hb_timer.cancel()
+                self._hb_timer = None
+
+    def _arm_heartbeat(self):
+        t = threading.Timer(self._hb_interval, self._heartbeat)
+        t.daemon = True
+        t.start()
+        self._hb_timer = t
+
+    def _heartbeat(self):
+        with self._lock:
+            if self._hb_interval is None:
+                return
+            try:
+                self.write()
+            except OSError:
+                pass
+            self._arm_heartbeat()
 
     def _gang_lines(self) -> list:
         lines = ["# TYPE cocoa_gang_generations_total counter",
@@ -362,6 +466,44 @@ class MetricsWriter:
                 lines += ["# TYPE cocoa_fleet_models_per_second gauge",
                           f"cocoa_fleet_models_per_second "
                           f"{self.fleet_models_per_second!r}"]
+        if self.serve_batches_total:
+            # serving families render only once a --serve run answered
+            # (training runs must not carry zero-valued serve series)
+            qps = self.serve_requests_total / max(
+                (self.serve_last_ts or 0.0) - (self.serve_first_ts
+                                               or 0.0), 1.0)
+            fill = self.serve_requests_total / max(self.serve_slots_total,
+                                                   1)
+            lines += ["# TYPE cocoa_serve_requests_total counter",
+                      f"cocoa_serve_requests_total "
+                      f"{self.serve_requests_total}",
+                      "# TYPE cocoa_serve_batches_total counter",
+                      f"cocoa_serve_batches_total "
+                      f"{self.serve_batches_total}",
+                      "# TYPE cocoa_serve_qps gauge",
+                      f"cocoa_serve_qps {qps!r}",
+                      "# TYPE cocoa_serve_batch_fill_ratio gauge",
+                      f"cocoa_serve_batch_fill_ratio {fill!r}",
+                      "# TYPE cocoa_serve_latency_seconds histogram"]
+            cum = 0
+            for b, c in zip(BUCKETS, self.serve_lat_buckets):
+                cum += c
+                lines.append(
+                    f'cocoa_serve_latency_seconds_bucket{{le="{b}"}} '
+                    f"{cum}")
+            lines.append(f'cocoa_serve_latency_seconds_bucket'
+                         f'{{le="+Inf"}} '
+                         f"{cum + self.serve_lat_buckets[-1]}")
+            lines.append(f"cocoa_serve_latency_seconds_sum "
+                         f"{self.serve_lat_sum!r}")
+            lines.append(f"cocoa_serve_latency_seconds_count "
+                         f"{self.serve_lat_count}")
+        if self.model_birth_ts is not None:
+            age = max(0.0, time.time() - self.model_birth_ts)
+            lines += ["# TYPE cocoa_model_swaps_total counter",
+                      f"cocoa_model_swaps_total {self.model_swaps_total}",
+                      "# TYPE cocoa_model_gap_age_seconds gauge",
+                      f"cocoa_model_gap_age_seconds {age!r}"]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]

@@ -141,6 +141,23 @@ EVENT_FIELDS = {
     # what feeds cocoa_tenants_certified_total
     "tenant_certified": {"algorithm": (str,), "tenant": (str,),
                          "t": (int,), "gap": _OPT_NUM},
+    # one scored serving batch (--serve, serving/batcher.py): what feeds
+    # cocoa_serve_qps / cocoa_serve_latency_seconds /
+    # cocoa_serve_batch_fill_ratio.  model_round is None only before the
+    # first checkpoint carried a round (never in practice — the server
+    # refuses to start without a validated generation)
+    "serve_request": {"algorithm": (str,), "n": (int,), "bucket": (int,),
+                      "fill_ratio": _NUM, "queue_s": _NUM,
+                      "device_s": _NUM, "latency_max_s": _NUM,
+                      "latency_mean_s": _NUM, "model_round": _OPT_NUM},
+    # the serving watcher hot-swapped a new validated generation into
+    # the live slot (serving/watcher.py): what anchors
+    # cocoa_model_gap_age_seconds (birth_ts = the checkpoint's mtime =
+    # when its certificate was produced); gap is the certified duality
+    # gap the checkpoint meta recorded (None on pre-gap metas)
+    "model_swap": {"algorithm": (str,), "round": (int, type(None)),
+                   "path": (str,), "birth_ts": _NUM, "gap": _OPT_NUM,
+                   "gap_age_s": _NUM, "swap_seq": (int,)},
 }
 
 # --fleet manifest dialect (data/fleet.py): a ``fleet_manifest`` header
@@ -218,6 +235,14 @@ RESULTS_FIELDS = {
     "parse_s": _NUM, "bytes_read_mb": _NUM, "peak_rss_mb": _NUM,
     "rss_delta_mb": _NUM, "rss_vs_whole": _NUM,
     "predicted_parse_s": _NUM, "predicted_csr_mb": _NUM,
+    # the serving rows (--serve / benchmarks/serve_bench.py): queries/s
+    # under a pinned p99 SLA plus the model-freshness (gap age) the run
+    # observed; buckets is the static bucket ladder ("64/256"), compiles
+    # the measured XLA compile count (== bucket count, the
+    # one-compile-per-bucket pin), swaps the hot-swaps served through
+    "qps": _NUM, "p50_ms": _NUM, "p99_ms": _NUM, "sla_ms": _NUM,
+    "gap_age_s": _NUM, "buckets": (str,), "queries": (int,),
+    "swaps": (int,), "fill": _NUM, "threads": (int,),
 }
 
 

@@ -872,3 +872,117 @@ def test_analysis_cli_exits_clean(tmp_path):
     rc = main([f"--report={report}"])
     assert rc == 0
     assert schema.check_file(report) == []
+
+
+# --- serve-hygiene rule ------------------------------------------------------
+
+SERVE_JIT_IN_HOT_PATH = """
+import jax
+
+def score_batch(w, idx, val):
+    fn = jax.jit(lambda w, i, v: (w[i] * v).sum(-1))
+    return fn(w, idx, val)
+"""
+
+SERVE_LEN_SHAPE = """
+import numpy as np
+
+def drain_requests(requests, width):
+    idx = np.zeros((len(requests), width), np.int32)
+    return idx
+"""
+
+SERVE_CLOCK_IN_TRACED = """
+import time
+import jax
+
+@jax.jit
+def serve_margins(w, idx, val):
+    t0 = time.monotonic()
+    return (w[idx] * val).sum(-1)
+"""
+
+SERVE_SYNC_IN_TRACED = """
+import jax
+
+@jax.jit
+def serve_margins(w, idx, val):
+    out = (w[idx] * val).sum(-1)
+    out.block_until_ready()
+    return out
+"""
+
+SERVE_CLEAN = """
+import time
+import jax
+import numpy as np
+
+class Scorer:
+    def __init__(self):
+        # builder scope: the one sanctioned place to create the jit
+        self._jit = jax.jit(lambda w, i, v: (w[i] * v).sum(-1))
+
+    def assemble(self, queries, bucket, width):
+        # static bucket shape, never len(queries)
+        idx = np.zeros((bucket, width), np.int32)
+        return idx
+
+    def score(self, w, idx, val):
+        t0 = time.monotonic()   # host boundary: clocks are fine here
+        return self._jit(w, idx, val)
+"""
+
+
+def test_serve_hygiene_jit_in_hot_path_caught(tmp_path):
+    found = lint(tmp_path, SERVE_JIT_IN_HOT_PATH,
+                 relpath="cocoa_tpu/serving/fixture.py",
+                 rule="serve-hygiene")
+    assert len(found) == 1 and "fresh" in found[0].message
+
+
+def test_serve_hygiene_request_dependent_shape_caught(tmp_path):
+    found = lint(tmp_path, SERVE_LEN_SHAPE,
+                 relpath="cocoa_tpu/serving/fixture.py",
+                 rule="serve-hygiene")
+    assert len(found) == 1
+    assert "static bucket" in found[0].message
+
+
+def test_serve_hygiene_clock_in_traced_caught(tmp_path):
+    found = lint(tmp_path, SERVE_CLOCK_IN_TRACED,
+                 relpath="cocoa_tpu/serving/fixture.py",
+                 rule="serve-hygiene")
+    assert len(found) == 1 and "TRACE time" in found[0].message
+
+
+def test_serve_hygiene_device_sync_in_traced_caught(tmp_path):
+    found = lint(tmp_path, SERVE_SYNC_IN_TRACED,
+                 relpath="cocoa_tpu/serving/fixture.py",
+                 rule="serve-hygiene")
+    assert len(found) == 1 and "block_until_ready" in found[0].message
+
+
+def test_serve_hygiene_builder_scopes_clean(tmp_path):
+    found = lint(tmp_path, SERVE_CLEAN,
+                 relpath="cocoa_tpu/serving/fixture.py",
+                 rule="serve-hygiene")
+    assert found == []
+
+
+def test_serve_hygiene_scoped_to_serving(tmp_path):
+    # the same shapes OUTSIDE serving/ are not this rule's business
+    # (host-sync and friends still apply on their own terms)
+    found = lint(tmp_path, SERVE_JIT_IN_HOT_PATH,
+                 relpath="cocoa_tpu/solvers/fixture.py",
+                 rule="serve-hygiene")
+    assert found == []
+
+
+def test_serve_hygiene_full_serving_tree_clean():
+    """The shipped serving subsystem passes its own rule (and every
+    other rule) with zero new findings."""
+    findings, _, _ = analysis.run_analysis(
+        targets=["cocoa_tpu/serving"], with_budget_checks=False)
+    actionable = [f for f in findings if f.actionable]
+    assert actionable == [], [(f.rule, f.path, f.line, f.message)
+                              for f in actionable]

@@ -271,3 +271,80 @@ def test_cli_ingest_stream_whole_same_result(tmp_path, capsys):
     stream = [ln for ln in capsys.readouterr().out.splitlines()
               if "primal" in ln.lower() or "gap" in ln.lower()]
     assert whole and whole == stream
+
+
+def test_cli_serve_flag_hardening(tmp_path, capsys):
+    """--serve composes only with its documented flags (the serving
+    whitelist): every training flag explicitly passed alongside it is
+    rejected LOUDLY with a pointer, never accepted as a silent no-op;
+    malformed serve flags and missing prerequisites fail with the CLI
+    convention; and a serving-incompatible width is rejected with the
+    numbers."""
+    import numpy as np
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu.cli import main
+
+    ck = str(tmp_path / "ck")
+    base = ["--serve=0", f"--chkptDir={ck}", "--numFeatures=16"]
+
+    bad = [
+        (["--fleet=m.jsonl"], "separate processes"),
+        (["--elastic=2"], "outside the gang"),
+        (["--sigmaSchedule=trial", "--sigma=auto", "--gapTarget=1e-3"],
+         "trainer"),
+        (["--gapTarget=1e-4"], "freshness"),
+        (["--resume"], "nothing to resume"),
+        (["--lambda=0.1"], "background trainer process"),
+        (["--numRounds=100"], "background trainer process"),
+        (["--deviceLoop"], "background trainer process"),
+        (["--overlapComm=on"], "background trainer process"),
+        # rejected by the staleness path's own (earlier) loud check
+        (["--staleRounds=1"], "host-exchange"),
+        (["--accel=on"], "background trainer process"),
+        (["--warmStart=0.1,20"], "background trainer process"),
+        (["--blockSize=128"], "background trainer process"),
+        (["--objective=lasso"], "background trainer process"),
+        (["--testFile=x"], "background trainer process"),
+        (["--profile=/tmp/t"], "background trainer process"),
+        (["--mesh=4"], "background trainer process"),
+        (["--hotCols=auto"], "needs --trainFile"),
+    ]
+    for extra_flags, needle in bad:
+        assert main(base + extra_flags) == 2, extra_flags
+        err = capsys.readouterr().err
+        assert "error:" in err and needle in err, (extra_flags, err)
+
+    # serve flags need --serve; malformed values fail before anything runs
+    assert main(["--serveBatch=64", f"--chkptDir={ck}",
+                 "--numFeatures=16", "--trainFile=x"]) == 2
+    assert "needs --serve" in capsys.readouterr().err
+    assert main(["--serveSlaMs=50", f"--chkptDir={ck}",
+                 "--numFeatures=16", "--trainFile=x"]) == 2
+    assert "needs --serve" in capsys.readouterr().err
+    assert main(["--serveMaxNnz=64", f"--chkptDir={ck}",
+                 "--numFeatures=16", "--trainFile=x"]) == 2
+    assert "needs --serve" in capsys.readouterr().err
+    for bad_flag, needle in [("--serve=notaport", "TCP port"),
+                             ("--serve=70000", "TCP port")]:
+        assert main([bad_flag, f"--chkptDir={ck}",
+                     "--numFeatures=16"]) == 2
+        assert needle in capsys.readouterr().err
+    for bad_flag, needle in [("--serveBatch=0,64", "ascending bucket"),
+                             ("--serveBatch=oops", "ascending bucket"),
+                             ("--serveSlaMs=-1", "positive latency"),
+                             ("--serveSlaMs=oops", "positive latency"),
+                             ("--serveMaxNnz=0", "nonzero budget"),
+                             ("--serveMaxNnz=oops", "nonzero budget")]:
+        assert main(base + [bad_flag]) == 2, bad_flag
+        assert needle in capsys.readouterr().err
+    # --serve without --chkptDir: no model source to watch
+    assert main(["--serve=0", "--numFeatures=16"]) == 2
+    assert "--chkptDir" in capsys.readouterr().err
+
+    # serving-incompatible shapes are rejected with the numbers: the
+    # checkpoint carries w of width 8, the flag says 16
+    ckpt_lib.save(ck, "CoCoA+", 10, np.zeros(8, np.float32), None)
+    assert main(base) == 2
+    err = capsys.readouterr().err
+    assert "(8,)" in err and "--numFeatures=16" in err

@@ -1,0 +1,647 @@
+"""The serving subsystem (cocoa_tpu/serving/, docs/DESIGN.md §17):
+compiled bucket scoring vs a numpy reference, one-compile-per-bucket
+across hot-swaps, atomic swap semantics under traffic, the adaptive
+micro-batcher, the checkpoint-validation cache, the TCP protocol, and
+the serve telemetry — plus the chaos pin: serving keeps answering
+through a SIGKILL-triggered elastic shrink of the background trainer.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cocoa_tpu import checkpoint as ckpt_lib
+from cocoa_tpu import serving
+from cocoa_tpu.analysis import sanitize
+from cocoa_tpu.serving.watcher import emit_model_swap
+from cocoa_tpu.telemetry import events as tele
+from cocoa_tpu.telemetry import schema as tele_schema
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.dirname(os.path.abspath(__file__))
+
+D = 24
+
+
+@pytest.fixture
+def bus(tmp_path):
+    """An armed bus writing to a per-test JSONL, reset afterwards."""
+    b = tele.get_bus()
+    b.reset()
+    path = tmp_path / "events.jsonl"
+    b.configure(jsonl_path=str(path))
+    yield path
+    b.reset()
+
+
+def _read_events(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _save_model(ck, w, round_t, gap=None, algorithm="CoCoA+"):
+    return ckpt_lib.save(str(ck), algorithm, round_t,
+                         np.asarray(w, np.float32), None, gap=gap)
+
+
+def _serving_stack(ck, buckets=(4, 16), max_nnz=8, sla_s=0.01,
+                   algorithm="CoCoA+"):
+    w, info = serving.load_model(ckpt_lib.latest(str(ck), algorithm))
+    slots = serving.ModelSlots(w, info, dtype=np.float32)
+    scorer = serving.BatchScorer(D, dtype=np.float32, buckets=buckets,
+                                 max_nnz=max_nnz)
+    scorer.warmup(slots.current()[0])
+    batcher = serving.MicroBatcher(scorer, slots, sla_s=sla_s,
+                                   algorithm=algorithm)
+    return slots, scorer, batcher
+
+
+def _rand_queries(rng, n, max_nnz=8):
+    out = []
+    for _ in range(n):
+        nnz = int(rng.integers(1, max_nnz + 1))
+        idx = rng.choice(D, size=nnz, replace=False).astype(np.int32)
+        val = rng.standard_normal(nnz)
+        out.append((np.sort(idx), val[np.argsort(idx)]))
+    return out
+
+
+def _ref_margin(w32, idx, val):
+    # f64 reference accumulation of the f32 addends: identifies the
+    # model generation unambiguously; bitwise pins are reserved for
+    # same-compiled-path comparisons (swap vs cold restart), where the
+    # executable and inputs are identical by construction
+    val32 = np.asarray(val, np.float32)   # the cast assembly performs
+    return (np.asarray(w32, np.float64)[np.asarray(idx)]
+            * val32.astype(np.float64)).sum()
+
+
+def _assert_margin(m, w32, qi, qv):
+    np.testing.assert_allclose(np.float64(m),
+                               _ref_margin(w32, qi, qv),
+                               rtol=1e-5, atol=1e-5)
+
+
+# --- query grammar -----------------------------------------------------------
+
+
+def test_parse_query_grammar_and_rejections():
+    idx, val = serving.parse_query("1:0.5 3:-2 24:1e-3", D, 8)
+    assert idx.tolist() == [0, 2, 23]
+    np.testing.assert_allclose(val, [0.5, -2.0, 1e-3])
+    with pytest.raises(serving.QueryError, match=r"feature id 25.*"
+                                                 r"num_features=24"):
+        serving.parse_query("25:1.0", D, 8)
+    with pytest.raises(serving.QueryError, match="malformed"):
+        serving.parse_query("3:", D, 8)
+    with pytest.raises(serving.QueryError, match=r"3 nonzeros.*"
+                                                 r"max_nnz=2"):
+        serving.parse_query("1:1 2:1 3:1", D, 2)
+    with pytest.raises(serving.QueryError, match="empty"):
+        serving.parse_query("   ", D, 8)
+
+
+# --- the compiled scoring path ----------------------------------------------
+
+
+def test_scorer_matches_reference_across_buckets(tmp_path):
+    rng = np.random.default_rng(0)
+    w32 = rng.standard_normal(D).astype(np.float32)
+    _save_model(tmp_path, w32, 10)
+    slots, scorer, _ = _serving_stack(tmp_path)
+    for n in (1, 3, 4, 9, 16):
+        queries = _rand_queries(rng, n)
+        bucket = serving.pick_bucket(n, scorer.buckets)
+        idx, val, hot = scorer.assemble(queries, bucket)
+        out = np.asarray(scorer.score(slots.current()[0], idx, val, hot))
+        assert out.shape == (bucket,)
+        for r, (qi, qv) in enumerate(queries):
+            _assert_margin(out[r], w32, qi, qv)
+        # padded slots contribute exactly zero
+        np.testing.assert_array_equal(out[n:], 0.0)
+
+
+def test_scorer_hybrid_rides_panel_plus_residual(tmp_path):
+    """A hot/cold split scorer answers the same margins as the plain
+    gather path (fp reassociated — the §3b-vi contract), through the
+    same shard_margins dispatch the evaluator uses."""
+    rng = np.random.default_rng(1)
+    w32 = rng.standard_normal(D).astype(np.float32)
+    hot_ids = np.array([2, 5, 7, 11], np.int64)
+    plain = serving.BatchScorer(D, dtype=np.float32, buckets=(8,),
+                                max_nnz=8)
+    hybrid = serving.BatchScorer(D, dtype=np.float32, buckets=(8,),
+                                 max_nnz=8, hot_ids=hot_ids)
+    assert hybrid.n_hot == 4
+    queries = _rand_queries(rng, 6)
+    import jax
+
+    w_dev = jax.device_put(w32)
+    ip, vp, hp = plain.assemble(queries, 8)
+    assert hp is None
+    ih, vh, hh = hybrid.assemble(queries, 8)
+    assert hh.shape == (8, 4)
+    out_p = np.asarray(plain.score(w_dev, ip, vp, hp))
+    out_h = np.asarray(hybrid.score(w_dev, ih, vh, hh))
+    np.testing.assert_allclose(out_p, out_h, atol=1e-5)
+    # the residual really lost the hot entries: no hot column id appears
+    # in a residual slot with a nonzero value
+    assert not np.any(np.isin(ih, hot_ids) & (vh != 0))
+
+
+def test_one_compile_per_bucket_across_hot_swaps(tmp_path):
+    """The acceptance pin: N hot-swaps, zero new compiles — and the
+    post-swap margins are bit-identical to a cold restart on the new
+    checkpoint."""
+    rng = np.random.default_rng(2)
+    w1 = rng.standard_normal(D).astype(np.float32)
+    _save_model(tmp_path, w1, 10, gap=1e-3)
+    queries = _rand_queries(rng, 5)
+    with sanitize.watch_compiles() as compiles:
+        slots, scorer, batcher = _serving_stack(tmp_path)
+        n_warm = len([c for c in compiles if "serve_margins" in c.name])
+        assert n_warm == len(scorer.buckets) == 2
+        watcher = serving.SwapWatcher(slots, str(tmp_path), "CoCoA+")
+        w_new = w1
+        for gen in range(3):   # three swapped generations
+            w_new = (w_new * 0.7 + gen).astype(np.float32)
+            _save_model(tmp_path, w_new, 20 + 10 * gen, gap=1e-4)
+            assert watcher.poll_once()
+            for n in (1, 7):   # both buckets, post-swap
+                bucket = serving.pick_bucket(n, scorer.buckets)
+                idx, val, hot = scorer.assemble(queries[:n], bucket)
+                np.asarray(scorer.score(slots.current()[0], idx, val,
+                                        hot))
+        total = len([c for c in compiles if "serve_margins" in c.name])
+    assert total == n_warm, (
+        f"hot-swaps recompiled: {total} compiles for "
+        f"{len(scorer.buckets)} buckets")
+    assert watcher.swaps_total == 3
+    # bit-identity vs a cold restart on the final checkpoint
+    cold = serving.BatchScorer(D, dtype=np.float32,
+                               buckets=scorer.buckets, max_nnz=8)
+    w_cold, _ = serving.load_model(ckpt_lib.latest(str(tmp_path),
+                                                   "CoCoA+"))
+    import jax
+
+    w_cold_dev = jax.device_put(np.asarray(w_cold, np.float32))
+    idx, val, hot = scorer.assemble(queries, 8)
+    hot_live = np.asarray(scorer.score(slots.current()[0], idx, val,
+                                       hot))
+    cold_out = np.asarray(cold.score(w_cold_dev, idx, val, hot))
+    np.testing.assert_array_equal(hot_live, cold_out)
+    batcher.stop()
+
+
+def test_swap_rejects_width_change_with_numbers(tmp_path, capsys):
+    w = np.zeros(D, np.float32)
+    _save_model(tmp_path, w, 10)
+    slots, scorer, batcher = _serving_stack(tmp_path)
+    with pytest.raises(serving.QueryError, match=r"\(12,\).*\(24,\)"):
+        slots.swap(np.zeros(12, np.float32),
+                   slots.info._replace(seq=1))
+    # through the watcher: rejected loudly, old model keeps serving,
+    # and the bad generation is not retried every poll
+    ckpt_lib.save(str(tmp_path), "CoCoA+", 20,
+                  np.zeros(12, np.float32), None)
+    watcher = serving.SwapWatcher(slots, str(tmp_path), "CoCoA+")
+    assert not watcher.poll_once()
+    assert watcher.rejected_total == 1 and watcher.swaps_total == 0
+    assert slots.info.round == 10
+    assert not watcher.poll_once()       # cached rejection: no relooping
+    assert watcher.rejected_total == 1
+    err = capsys.readouterr().err
+    assert "(12,)" in err and "(24,)" in err
+    batcher.stop()
+
+
+# --- the micro-batcher -------------------------------------------------------
+
+
+def test_batcher_pads_to_bucket_and_reports_fill(tmp_path, bus):
+    w = np.arange(D, dtype=np.float32)
+    _save_model(tmp_path, w, 5, gap=2e-3)
+    slots, scorer, batcher = _serving_stack(tmp_path, sla_s=0.05)
+    queries = _rand_queries(np.random.default_rng(3), 3)
+    pendings = [batcher.submit(qi, qv) for qi, qv in queries]
+    margins = [p.result(timeout=10.0) for p in pendings]
+    for (qi, qv), m in zip(queries, margins):
+        _assert_margin(m, w, qi, qv)
+    assert all(p.model_round == 5 for p in pendings)
+    batcher.stop()
+    reqs = [r for r in _read_events(bus) if r["event"] == "serve_request"]
+    assert reqs, "no serve_request events"
+    assert sum(r["n"] for r in reqs) == 3
+    for r in reqs:
+        assert r["bucket"] in scorer.buckets
+        assert 0 < r["fill_ratio"] <= 1.0
+        assert r["queue_s"] >= 0 and r["device_s"] > 0
+        assert r["latency_max_s"] >= r["latency_mean_s"] > 0
+        assert r["model_round"] == 5
+    assert tele_schema.check_file(str(bus)) == []
+
+
+def test_batcher_one_intended_fetch_per_batch(tmp_path, bus):
+    """The zero-unintended-transfers contract, observable: every scored
+    batch crosses device→host exactly once, through intended_fetch."""
+    w = np.ones(D, np.float32)
+    _save_model(tmp_path, w, 5)
+    slots, scorer, batcher = _serving_stack(tmp_path)
+    for _ in range(3):
+        batcher.score_sync(np.array([0], np.int32),
+                           np.array([1.0]), timeout=10.0)
+    batcher.stop()
+    recs = _read_events(bus)
+    fetches = [r for r in recs if r["event"] == "host_transfer"
+               and r["label"] == "serve_fetch"]
+    batches = [r for r in recs if r["event"] == "serve_request"]
+    assert len(fetches) == len(batches) >= 1
+
+
+def test_batcher_spans_attribute_queue_vs_device(tmp_path, bus):
+    """--trace on a serving run: every batch leaves a serve_admit span
+    (queueing) and a serve_score span (device dispatch+fetch) — what
+    trace_report attributes the wall-clock with."""
+    from cocoa_tpu.telemetry import tracing
+
+    tracing.configure(enabled=True, worker=0)
+    try:
+        w = np.ones(D, np.float32)
+        _save_model(tmp_path, w, 5)
+        slots, scorer, batcher = _serving_stack(tmp_path)
+        batcher.score_sync(np.array([0], np.int32), np.array([1.0]),
+                           timeout=10.0)
+        batcher.stop()
+    finally:
+        tracing.reset()
+    spans = [r for r in _read_events(bus) if r["event"] == "span"]
+    phases = {s["phase"] for s in spans}
+    assert {"serve_admit", "serve_score"} <= phases, phases
+    score = [s for s in spans if s["phase"] == "serve_score"]
+    assert score[0]["dur_s"] > 0 and score[0]["bucket"] in scorer.buckets
+    assert tele_schema.check_file(str(bus)) == []
+
+
+# --- the swap watcher + freshness -------------------------------------------
+
+
+def test_watcher_swaps_and_exports_gap_age(tmp_path, bus):
+    w = np.zeros(D, np.float32)
+    _save_model(tmp_path, w, 10, gap=1e-2)
+    slots, scorer, batcher = _serving_stack(tmp_path)
+    emit_model_swap("CoCoA+", slots.info)       # the initial publish
+    age0 = slots.gap_age_s()
+    assert age0 >= 0.0
+    _save_model(tmp_path, w + 1, 20, gap=1e-3)
+    watcher = serving.SwapWatcher(slots, str(tmp_path), "CoCoA+")
+    assert watcher.poll_once()
+    assert slots.info.round == 20 and slots.info.gap == 1e-3
+    assert slots.gap_age_s() <= age0 + 1.0      # fresher certificate
+    batcher.stop()
+    swaps = [r for r in _read_events(bus) if r["event"] == "model_swap"]
+    assert len(swaps) == 2
+    assert swaps[-1]["round"] == 20
+    assert swaps[-1]["gap"] == 1e-3
+    assert swaps[-1]["gap_age_s"] >= 0
+    assert swaps[-1]["swap_seq"] == 1
+    assert tele_schema.check_file(str(bus)) == []
+
+
+def test_checkpoint_validation_cache(tmp_path, monkeypatch):
+    """Unchanged generations cost one stat; a rewritten-in-place file
+    (same path, new mtime) re-validates — including a corrupt rewrite,
+    which must still fall back."""
+    calls = []
+    real = ckpt_lib._validate
+
+    def counting(path):
+        calls.append(path)
+        return real(path)
+
+    monkeypatch.setattr(ckpt_lib, "_validate", counting)
+    w = np.ones(8, np.float32)
+    p10 = ckpt_lib.save(str(tmp_path), "CoCoA+", 10, w, None)
+    assert ckpt_lib.latest(str(tmp_path), "CoCoA+") == p10
+    first = len(calls)
+    assert first == 1
+    for _ in range(5):   # poll-rate reads: stat only
+        assert ckpt_lib.latest(str(tmp_path), "CoCoA+") == p10
+    assert len(calls) == first
+    # rewritten in place (same path, same round, new content/mtime):
+    # must NOT serve the stale pass
+    ckpt_lib.save(str(tmp_path), "CoCoA+", 10, w * 2, None)
+    assert ckpt_lib.latest(str(tmp_path), "CoCoA+") == p10
+    assert len(calls) == first + 1
+    # corrupt in-place rewrite of a NEWER generation: re-validated,
+    # rejected, clean fallback to the cached-good r10
+    p20 = ckpt_lib.save(str(tmp_path), "CoCoA+", 20, w, None)
+    assert ckpt_lib.latest(str(tmp_path), "CoCoA+") == p20
+    with open(p20, "wb") as f:
+        f.write(b"torn")
+    assert ckpt_lib.latest(str(tmp_path), "CoCoA+") == p10
+    assert ckpt_lib.latest(str(tmp_path), "CoCoA+") == p10
+
+
+# --- the TCP protocol --------------------------------------------------------
+
+
+def test_server_protocol_batches_errors_shutdown(tmp_path):
+    w = np.arange(D, dtype=np.float32)
+    _save_model(tmp_path, w, 7)
+    slots, scorer, batcher = _serving_stack(tmp_path)
+    srv = serving.MarginServer(batcher, D, 8, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        s = socket.create_connection(srv.address, timeout=10)
+        f = s.makefile("rwb")
+        f.write(b"1:1.0;3:2.0;99:1.0\n")
+        f.flush()
+        resp = json.loads(f.readline())
+        assert isinstance(resp, list) and len(resp) == 3
+        _assert_margin(resp[0]["margin"], w, [0], [1.0])
+        assert resp[0]["round"] == 7
+        assert "feature id 99" in resp[2]["error"]   # per-query reject
+        f.write(b"2:1.5\n")
+        f.flush()
+        single = json.loads(f.readline())
+        assert isinstance(single, dict) and single["round"] == 7
+        f.write(b"shutdown\n")
+        f.flush()
+        assert json.loads(f.readline())["ok"] == "shutting down"
+        s.close()
+        t.join(10)
+        assert not t.is_alive()
+    finally:
+        srv.close()
+        batcher.stop()
+
+
+# --- serve metrics families --------------------------------------------------
+
+
+def test_serve_metrics_families_rendered(tmp_path):
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    path = str(tmp_path / "m.prom")
+    wtr = MetricsWriter(path)
+    base = {"seq": 1, "pid": 1, "ts": 1000.0}
+    wtr({**base, "event": "serve_request", "n": 3, "bucket": 4,
+         "fill_ratio": 0.75, "queue_s": 0.001, "device_s": 0.002,
+         "latency_max_s": 0.004, "latency_mean_s": 0.003,
+         "model_round": 10})
+    wtr({**base, "event": "model_swap", "round": 10, "path": "x",
+         "birth_ts": time.time() - 2.0, "gap": 1e-3,
+         "gap_age_s": 2.0, "swap_seq": 1})
+    text = open(path).read()
+    for needle in ("cocoa_serve_qps", "cocoa_serve_requests_total 3",
+                   "cocoa_serve_batch_fill_ratio 0.75",
+                   "cocoa_serve_latency_seconds_count 1",
+                   "cocoa_model_swaps_total 1",
+                   "cocoa_model_gap_age_seconds"):
+        assert needle in text, f"{needle} missing from:\n{text}"
+    age = float([ln for ln in text.splitlines()
+                 if ln.startswith("cocoa_model_gap_age_seconds")][0]
+                .split()[1])
+    assert 1.5 <= age <= 30.0   # render-time age, anchored on birth_ts
+    # training-only runs must not render serve families
+    clean = str(tmp_path / "clean.prom")
+    MetricsWriter(clean)
+    assert "cocoa_serve" not in open(clean).read()
+
+
+def test_scorer_duplicate_ids_sum_on_both_paths():
+    """A query may repeat a feature id; the gather path sums duplicates
+    (each occupies its own slot), so the hot panel must ACCUMULATE them
+    too — a --hotCols server and a plain one answer identically."""
+    import jax
+
+    w32 = np.linspace(-1, 1, D).astype(np.float32)
+    w_dev = jax.device_put(w32)
+    hot_ids = np.array([2, 5], np.int64)
+    plain = serving.BatchScorer(D, dtype=np.float32, buckets=(4,),
+                                max_nnz=8)
+    hybrid = serving.BatchScorer(D, dtype=np.float32, buckets=(4,),
+                                 max_nnz=8, hot_ids=hot_ids)
+    # feature 3 (0-based id 2) is hot and appears twice
+    qi, qv = serving.parse_query("3:1.0 3:2.0 7:1.0", D, 8)
+    outs = []
+    for scorer in (plain, hybrid):
+        idx, val, hot = scorer.assemble([(qi, qv)], 4)
+        outs.append(np.asarray(scorer.score(w_dev, idx, val, hot))[0])
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+    _assert_margin(outs[1], w32, qi, qv)   # duplicates summed, not last
+
+
+def test_metrics_heartbeat_keeps_gap_age_climbing(tmp_path):
+    """The alert scenario: a dead trainer and an idle server emit no
+    events — the heartbeat's unconditional rewrites must keep the
+    render-time gap-age gauge climbing anyway."""
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    path = str(tmp_path / "m.prom")
+    wtr = MetricsWriter(path)
+    wtr({"event": "model_swap", "seq": 1, "pid": 1, "ts": 1.0,
+         "round": 10, "path": "x", "birth_ts": time.time() - 1.0,
+         "gap": 1e-3, "gap_age_s": 1.0, "swap_seq": 0})
+
+    def age():
+        ln = [x for x in open(path).read().splitlines()
+              if x.startswith("cocoa_model_gap_age_seconds")][0]
+        return float(ln.split()[1])
+
+    a0 = age()
+    wtr.start_heartbeat(0.05)
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and age() <= a0:
+            time.sleep(0.05)
+        assert age() > a0, "gauge frozen with no events"
+    finally:
+        wtr.stop_heartbeat()
+    # swap_seq 0 (the initial load) anchors the gauge but is NOT a swap
+    assert "cocoa_model_swaps_total 0" in open(path).read()
+
+
+def test_event_envelope_collision_guard(bus):
+    with pytest.raises(ValueError, match="envelope"):
+        tele.get_bus().emit("model_swap", algorithm="x", round=1,
+                            path="p", birth_ts=0.0, gap=None,
+                            gap_age_s=0.0, seq=3)
+
+
+# --- swap under sustained traffic (the acceptance pin) -----------------------
+
+
+@pytest.mark.slow
+def test_swap_under_sustained_traffic_drops_nothing(tmp_path, bus):
+    """Hot-swaps land while client threads hammer the batcher: zero
+    dropped/failed requests, every answer is bit-exact under the model
+    generation that answered it, and the post-drain margins equal a
+    cold restart on the final checkpoint."""
+    rng = np.random.default_rng(4)
+    gens = {10: rng.standard_normal(D).astype(np.float32)}
+    _save_model(tmp_path, gens[10], 10, gap=1e-3)
+    slots, scorer, batcher = _serving_stack(tmp_path, sla_s=0.02)
+    watcher = serving.SwapWatcher(slots, str(tmp_path), "CoCoA+",
+                                  poll_s=0.01).start()
+    stop = threading.Event()
+    failures, answers = [], []
+    lock = threading.Lock()
+
+    def client(seed):
+        crng = np.random.default_rng(seed)
+        while not stop.is_set():
+            (qi, qv), = _rand_queries(crng, 1)
+            p = batcher.submit(qi, qv)
+            try:
+                m = p.result(timeout=10.0)
+            except Exception as e:   # any failure is a dropped request
+                with lock:
+                    failures.append(repr(e))
+                continue
+            with lock:
+                answers.append((qi, qv, np.float32(m), p.model_round))
+
+    threads = [threading.Thread(target=client, args=(s,), daemon=True)
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    for gen in (20, 30, 40):   # three swaps under sustained traffic
+        time.sleep(0.15)
+        gens[gen] = rng.standard_normal(D).astype(np.float32)
+        _save_model(tmp_path, gens[gen], gen, gap=1e-4)
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    watcher.stop()
+    assert failures == []
+    assert watcher.swaps_total == 3
+    assert len(answers) > 20
+    rounds_seen = {r for _, _, _, r in answers}
+    assert len(rounds_seen) >= 2, "no traffic spanned a swap"
+    for qi, qv, m, r in answers:
+        assert r in gens, f"answered by unknown generation {r}"
+        _assert_margin(m, gens[r], qi, qv)
+    # post-drain: bit-identical to a cold restart on the newest ckpt
+    cold = serving.BatchScorer(D, dtype=np.float32,
+                               buckets=scorer.buckets, max_nnz=8)
+    import jax
+
+    w_cold = jax.device_put(gens[40])
+    queries = _rand_queries(rng, 4)
+    idx, val, hot = scorer.assemble(queries, 4)
+    np.testing.assert_array_equal(
+        np.asarray(scorer.score(slots.current()[0], idx, val, hot)),
+        np.asarray(cold.score(w_cold, idx, val, hot)))
+    batcher.stop()
+    assert batcher.failed_total == 0
+    assert tele_schema.check_file(str(bus)) == []
+
+
+# --- the chaos pin: serving through an elastic shrink ------------------------
+
+
+@pytest.mark.slow
+def test_serving_survives_elastic_shrink_of_trainer(tmp_path,
+                                                    monkeypatch):
+    """A real 2-process toy gang (tests/_gang_worker.py) trains in the
+    background under the elastic supervisor; worker 1 is SIGKILLed
+    mid-run and the gang shrinks to the survivor — while an in-process
+    serving stack pointed at the same checkpoint directory answers
+    queries continuously.  Acceptance: zero failed queries end to end,
+    at least one hot-swap during the outage window, and the final
+    answers match the survivor's final checkpoint."""
+    from _faults import Fault, FaultPlan, checkpoint_at_least, sigkill
+    from cocoa_tpu import elastic
+
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        f"{ROOT}{os.pathsep}{TESTS}{os.pathsep}"
+        f"{os.environ.get('PYTHONPATH', '')}")
+    monkeypatch.setenv("XLA_FLAGS", " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f))
+    ck = tmp_path / "ck"
+    k = 4
+    plan = FaultPlan(
+        Fault(generation=0, actions=(sigkill(1),),
+              trigger=checkpoint_at_least(ck, "ToyGang", 5),
+              name="kill-worker-1"),
+    )
+    gang_argv = [f"--chkptDir={ck}", f"--numSplits={k}",
+                 "--numRounds=20", "--chkptIter=5",
+                 "--stepSeconds=0.1"]
+    rc_box = {}
+
+    def run_gang():
+        rc_box["rc"] = elastic.supervise(
+            gang_argv, 2, module="_gang_worker", max_restarts=3,
+            poll_s=0.05, num_splits=k, shrink="now",
+            backoff_base_s=0.2, on_generation=plan.on_generation)
+
+    gang = threading.Thread(target=run_gang, daemon=True)
+    gang.start()
+    # serve the toy model (w has shape (k,)) from the same directory
+    assert serving.wait_for_model(str(ck), "ToyGang",
+                                  timeout_s=60.0) is not None
+    w0, info = serving.load_model(ckpt_lib.latest(str(ck), "ToyGang"))
+    slots = serving.ModelSlots(w0, info, dtype=np.float32)
+    scorer = serving.BatchScorer(k, dtype=np.float32, buckets=(4,),
+                                 max_nnz=k)
+    scorer.warmup(slots.current()[0])
+    batcher = serving.MicroBatcher(scorer, slots, sla_s=0.02,
+                                   algorithm="ToyGang")
+    watcher = serving.SwapWatcher(slots, str(ck), "ToyGang",
+                                  poll_s=0.05).start()
+    failures = []
+    n_answered = 0
+    qi = np.arange(k, dtype=np.int32)
+    qv = np.ones(k)
+    while gang.is_alive():
+        try:
+            m = batcher.score_sync(qi, qv, timeout=10.0)
+            assert np.isfinite(m)
+            n_answered += 1
+        except Exception as e:
+            failures.append(repr(e))
+        time.sleep(0.02)
+    gang.join(120)
+    plan.join()
+    assert rc_box.get("rc") == 0
+    assert plan.errors == []
+    assert plan.fired == ["kill-worker-1"]
+    assert failures == [], f"queries failed during the shrink: " \
+                           f"{failures[:3]}"
+    assert n_answered > 10
+    assert watcher.swaps_total >= 1, "no hot-swap reached the server"
+    # drain the final generation in, then check the served sum equals
+    # the survivor's final checkpoint state
+    deadline = time.monotonic() + 30.0
+    meta, w_final, _ = ckpt_lib.load(ckpt_lib.latest(str(ck),
+                                                     "ToyGang"))
+    assert meta["round"] == 20
+    while time.monotonic() < deadline:
+        if slots.info.round == 20:
+            break
+        time.sleep(0.05)
+    assert slots.info.round == 20
+    got = np.float32(batcher.score_sync(qi, qv, timeout=10.0))
+    # bit-identical to a cold restart on the survivor's final state:
+    # same compiled path, same inputs, same model bytes
+    import jax
+
+    cold = serving.BatchScorer(k, dtype=np.float32, buckets=(4,),
+                               max_nnz=k)
+    ci, cv, ch = cold.assemble([(qi, qv)], 4)
+    expect = np.asarray(cold.score(
+        jax.device_put(np.asarray(w_final, np.float32)), ci, cv, ch))[0]
+    np.testing.assert_array_equal(got, np.float32(expect))
+    watcher.stop()
+    batcher.stop()
