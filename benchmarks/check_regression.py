@@ -146,6 +146,21 @@ GATES = (
         "expected_compiles": 2,
         "flags": ["--duration=3", "--threads=4"],
     },
+    # The warm-ingest row (ISSUE 15, docs/DESIGN.md §18): --ingestCache
+    # serves device-ready shard slabs from memmap-able artifacts with
+    # ZERO parse.  The gate re-measures the full rcv1-synth warm-vs-
+    # streamed-cold A/B (benchmarks/run.py bench_ingest) and fails when
+    # the warm map drops below the ≥10× acceptance bar — wall-clock on a
+    # shared runner, so the bar IS the bound (the committed row shows
+    # 64×; a cache that has regressed to re-parsing or re-validating
+    # per byte lands well under 10×, timer noise never costs 6×).
+    {
+        "config": "ingest/warm-p2",
+        "runner": "ingest",
+        "kind": "ingest",
+        "min_speedup": 10.0,
+        "flags": [],
+    },
 )
 
 # bounded-staleness round overhead vs the synchronous control (the
@@ -165,10 +180,11 @@ def committed_baselines(path: str = RESULTS) -> dict:
                 continue
             row = json.loads(line)
             # perf-accounting rows share the config name but carry no
-            # round count — only rows with BOTH fields can anchor the
-            # gate, regardless of row order in the file
+            # round count — only rows with an anchoring metric (rounds,
+            # or warm_speedup for the ingest gate) can anchor the gate,
+            # regardless of row order in the file
             if isinstance(row, dict) and "config" in row \
-                    and "rounds" in row:
+                    and ("rounds" in row or "warm_speedup" in row):
                 # first qualifying row per config wins (the file appends
                 # refreshed rows last in regen; the gate keys on the
                 # curated head)
@@ -337,6 +353,59 @@ def run_fresh_serve(gate: dict, workdir: str) -> dict:
                 f"{type(e).__name__}: {e}"}
 
 
+def run_fresh_ingest(gate: dict, workdir: str) -> dict:
+    """One fresh warm-vs-cold ingest A/B at full rcv1-synth scale
+    (benchmarks/run.py bench_ingest, the producer of the committed
+    ingest/* rows, P=2 only — the gated config).  Same never-raises
+    contract as :func:`run_fresh`."""
+    try:
+        bench_dir = os.path.join(ROOT, "benchmarks")
+        if bench_dir not in sys.path:
+            sys.path.insert(0, bench_dir)
+        import run as bench_run
+
+        results: list = []
+        bench_run.bench_ingest(results, quick=False, processes=(2,))
+        row = next((r for r in results
+                    if r["config"] == gate["config"]), None)
+        if row is None:
+            return {"config": gate["config"], "error":
+                    f"bench_ingest produced no {gate['config']} row"}
+        return {**row, "type": "bench-regression-fresh"}
+    except (OSError, ValueError, KeyError, TypeError,
+            ImportError) as e:
+        return {"config": gate["config"], "error":
+                f"{type(e).__name__}: {e}"}
+
+
+def ingest_failures(gate: dict, fresh: dict, committed: dict) -> list:
+    """The warm-ingest bounds: the warm map stays ≥ min_speedup× faster
+    than the streamed cold parse of the same file/geometry, and warm
+    really parses nothing (the row carries mapped bytes, never read
+    bytes)."""
+    cfg = gate["config"]
+    if "error" in fresh:
+        return [f"{cfg}: fresh run failed — {fresh['error']}"]
+    failures = []
+    speedup = fresh.get("warm_speedup")
+    if speedup is None:
+        failures.append(f"{cfg}: fresh warm row carries no warm_speedup")
+    elif speedup < gate["min_speedup"]:
+        failures.append(
+            f"{cfg}: WARM INGEST REGRESSION — warm map only "
+            f"{speedup}× the streamed cold parse (bar ≥ "
+            f"{gate['min_speedup']:g}×); the cache is re-parsing or "
+            f"re-validating per byte")
+    if fresh.get("bytes_read_mb"):
+        failures.append(
+            f"{cfg}: warm ingest READ {fresh['bytes_read_mb']} MB of "
+            f"text — the zero-parse contract broke")
+    if committed.get(cfg) is None:
+        failures.append(f"{cfg}: no committed baseline row in "
+                        f"benchmarks/results.jsonl")
+    return failures
+
+
 def serve_failures(gate: dict, fresh: dict, committed: dict) -> list:
     """The serve-specific bounds (on top of :func:`evaluate`'s
     certification + round checks): the p99 SLA holds, the compile count
@@ -445,6 +514,11 @@ def main(argv=None) -> int:
                 failures.append(f"{gate['config']}: no row in "
                                 f"{fresh_path}")
                 continue
+            if gate.get("kind") == "ingest":
+                fresh = {**row, "config": gate["config"]}
+                rows.append({**fresh, "type": "bench-regression-fresh"})
+                failures += ingest_failures(gate, fresh, committed)
+                continue
             fresh = {**row,
                      "config": gate["config"],
                      "rounds": int(row["rounds"]),
@@ -470,10 +544,14 @@ def main(argv=None) -> int:
                   f"rounds)", flush=True)
             runner = {"gang": run_fresh_gang,
                       "fleet": run_fresh_fleet,
-                      "serve": run_fresh_serve}.get(
+                      "serve": run_fresh_serve,
+                      "ingest": run_fresh_ingest}.get(
                           gate.get("runner"), run_fresh)
             fresh = runner(gate, workdir)
             rows.append(fresh)
+            if gate.get("kind") == "ingest":
+                failures += ingest_failures(gate, fresh, committed)
+                continue
             failures += evaluate(gate, fresh, committed)
             if gate.get("kind") == "serve" and "error" not in fresh:
                 failures += serve_failures(gate, fresh, committed)

@@ -92,6 +92,23 @@ are bit-identical either way (the whole-file build stays the A/B
 control); fp meshes and ``--objective=lasso`` are whole-file only and
 reject ``--ingest=stream`` loudly.
 
+``--ingestCache=DIR`` (round 20, docs/DESIGN.md §18) makes ingest free
+after first touch: a cold run writes each built shard's device-ready
+slabs (plus the pass-1 index/histogram and the hybrid layout meta) as
+memmap-able artifacts under DIR — atomic rename, one writer wins,
+keyed by the source file's (size, mtime_ns, inode) and the full layout
+resolution — and every later run of the same file/config ``np.load``\\ s
+them straight into ``device_put``: zero parse, page-cache-shared RSS.
+The key is the SHARD, not the process geometry, so an elastic shrink's
+survivors re-ingest warm and the supervisor forwards the flag to every
+relaunched generation unchanged.  With the cache armed, ``--ingest=auto``
+routes every svm run through the shard-granular pipeline (bit-identical
+shards, pinned); cold pass-2 parses fan out over an intra-process thread
+pool when the native parser is available.  Torn or stale artifacts fall
+back to a cold parse with a typed ``ingest_cache_corrupt`` event —
+never a crash, never a silently wrong slab.  lasso column shards and fp
+meshes have no shard-keyed artifact and reject the flag loudly.
+
 ``--fleet=manifest.jsonl`` (round 18, docs/DESIGN.md §16) trains a
 FLEET: one tenant model per manifest line (dataset ref / λ / gap
 target — a schema-validated JSONL dialect, data/fleet.py), all of them
@@ -163,7 +180,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "blockPipeline", "divergenceGuard",
                 "sigmaSchedule", "warmStart", "accel", "theta",
                 "elastic", "stallTimeout", "evalDense", "hotCols",
-                "ingest", "metrics", "events", "quiet",
+                "ingest", "ingestCache", "metrics", "events", "quiet",
                 "trace", "flightRecorder", "eventsMaxMB",
                 "metricsInterval", "overlapComm",
                 "staleRounds", "fleet", "fleetLanes",
@@ -401,6 +418,11 @@ def main(argv=None) -> int:
                          "(docs/DESIGN.md §16)",
             "hotCols": "fleet v1 is dense-layout only",
             "evalDense": "fleet v1 is dense-layout only",
+            "ingestCache": "the slab cache is keyed to the solo shard "
+                           "layout; fleet tenants sharing a dataset ref "
+                           "already dedupe through the in-process memo "
+                           "(data/fleet.py — one parse per distinct "
+                           "ref)",
             "blockSize": "the block/Pallas kernels own their shard axes "
                          "and cannot ride the tenant vmap",
             "blockPipeline": "the block/Pallas kernels own their shard "
@@ -490,6 +512,11 @@ def main(argv=None) -> int:
                          "(cocoa_model_gap_age_seconds)",
             "resume": "the server always serves the newest validated "
                       "generation; there is nothing to resume",
+            "ingestCache": "the slab cache serves TRAINING ingest; put "
+                           "--ingestCache on the background trainer's "
+                           "command line (the serve-side --trainFile "
+                           "parse only derives the query nonzero "
+                           "budget)",
         }
         allowed = {
             # the documented serve surface (README flag table): the
@@ -1033,9 +1060,44 @@ def main(argv=None) -> int:
     # parse so a streamed run never pays a whole-file pass by accident.
     from cocoa_tpu.data import ingest as ingest_lib
 
+    # --ingestCache=DIR: the shard-granular persistent slab cache
+    # (data/slab_cache.py, docs/DESIGN.md §18).  Armed BEFORE mode
+    # resolution: with a cache, auto routes svm runs through the
+    # shard-granular pipeline so warm shards load with zero parse.
+    ingest_cache = None
+    if extras["ingestCache"]:
+        if objective == "lasso":
+            print("error: --ingestCache does not apply to "
+                  "--objective=lasso (the column shards transpose the "
+                  "row slabs per run — nothing shard-keyed to cache); "
+                  "drop the flag", file=sys.stderr)
+            return 2
+        from cocoa_tpu.parallel.mesh import has_fp as _has_fp
+
+        if _has_fp(mesh):
+            print("error: --ingestCache does not support "
+                  "feature-parallel (fp) meshes (the fp column split "
+                  "re-buckets rows per device grid — the shard "
+                  "artifacts are geometry-free by contract); drop --fp "
+                  "or the cache flag", file=sys.stderr)
+            return 2
+        from cocoa_tpu.data import slab_cache as slab_cache_lib
+
+        try:
+            ingest_cache = slab_cache_lib.SlabCache(
+                str(extras["ingestCache"]))
+        except OSError as e:
+            print(f"error: --ingestCache={extras['ingestCache']!r}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        if bus.active():
+            ingest_cache.on_corrupt = (
+                lambda **kw: bus.emit("ingest_cache_corrupt", **kw))
+
     try:
         ingest_mode = ingest_lib.resolve_ingest_mode(
-            extras["ingest"], mesh, objective=objective)
+            extras["ingest"], mesh, objective=objective,
+            cached=ingest_cache is not None)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -1045,6 +1107,27 @@ def main(argv=None) -> int:
     hot_n = 0
     layout_split = None
     ingest_reports = []
+    cache_events = []
+
+    def record_cache(path, status, info):
+        """One typed ``ingest_cache`` record per file (``info`` is the
+        StreamBuildInfo both ingest paths produce) — the single appender
+        every branch shares, so the event's field set cannot drift."""
+        if ingest_cache is not None:
+            cache_events.append(dict(
+                path=path, status=status,
+                shards_cached=info.shards_cached,
+                shards_total=info.shards_total,
+                bytes_mapped=info.cache_bytes_mapped,
+                seconds_saved=info.seconds_saved))
+
+    def cache_snap():
+        """Counter snapshot bracketing one whole-path build."""
+        if ingest_cache is None:
+            return (0, 0, 0)
+        return (ingest_cache.shard_hits, ingest_cache.shard_misses,
+                ingest_cache.bytes_mapped)
+
     data = None
     ds = test_ds = None
     if objective == "lasso" and extras["hotCols"] is not None:
@@ -1074,6 +1157,32 @@ def main(argv=None) -> int:
                   f"{layout_split['residual_mean_nnz']:.1f} (max "
                   f"{layout_split['residual_max_nnz']})")
 
+    def resolve_stats_knobs(n_, total_nnz_, hist_):
+        """``--layout``/``--hotCols``/``--evalDense=auto`` resolved from
+        dataset STATS alone — ONE implementation shared by the streaming
+        pass-1 path and the whole-path warm loader so the two cannot
+        drift (the cold whole path resolves from the parsed data via
+        resolve_hot_cols: the pinned A/B control of this resolution).
+        Returns ``(resolved_layout, hot_width, eval_dense)``; raises
+        ValueError for the --hotCols-vs-layout rejection and the
+        over-budget explicit panel."""
+        from cocoa_tpu.data import hybrid as hybrid_knobs
+        from cocoa_tpu.data.sharding import (eval_dense_fits,
+                                             resolve_layout_stats)
+
+        lay = resolve_layout_stats(n_, cfg.num_features, total_nnz_,
+                                   cfg.layout, mesh)
+        if extras["hotCols"] is not None and lay != "sparse":
+            raise ValueError("--hotCols (the hot/cold column split) "
+                             "only applies to the sparse layout")
+        hot_w, ed = 0, eval_dense
+        if lay == "sparse":
+            hot_w = hybrid_knobs.resolve_hot_width(
+                extras["hotCols"], hist_, n_, k, dtype)
+            if ed_spec == "auto":
+                ed = eval_dense_fits(n_, cfg.num_features, k, dtype)
+        return lay, hot_w, ed
+
     import time as time_mod
 
     if ingest_mode == "stream":
@@ -1083,32 +1192,30 @@ def main(argv=None) -> int:
         # from that histogram bit-identically to the whole-file build,
         # pass 2 parses only this process's shard byte ranges
         from cocoa_tpu.data import hybrid as hybrid_lib
-        from cocoa_tpu.data.sharding import resolve_layout_stats
+
+        def stream_cache_status(index, sinfo):
+            # one file's cache outcome: the shard status degraded to
+            # "partial" when the index itself had to be re-scanned (a
+            # warm run pays zero scan AND zero parse)
+            if ingest_cache is None:
+                return "off"
+            if sinfo.cache_status == "hit" and index.scan_bytes:
+                return "partial"
+            return sinfo.cache_status
 
         try:
             index = ingest_lib.build_index(cfg.train_file,
-                                           cfg.num_features)
+                                           cfg.num_features,
+                                           cache=ingest_cache)
             n = index.n
-            resolved_layout = resolve_layout_stats(
-                n, cfg.num_features, index.total_nnz, cfg.layout, mesh)
-            if (extras["hotCols"] is not None
-                    and resolved_layout != "sparse"):
-                print("error: --hotCols (the hot/cold column split) only "
-                      "applies to the sparse layout", file=sys.stderr)
-                return 2
-            if resolved_layout == "sparse":
-                hot_n = hybrid_lib.resolve_hot_width(
-                    extras["hotCols"], index.hist, n, k, dtype)
-                if ed_spec == "auto":
-                    from cocoa_tpu.data.sharding import eval_dense_fits
-
-                    eval_dense = eval_dense_fits(n, cfg.num_features, k,
-                                                 dtype)
-                    announce_eval(eval_dense, hot_n)
+            resolved_layout, hot_n, eval_dense = resolve_stats_knobs(
+                n, index.total_nnz, index.hist)
+            if resolved_layout == "sparse" and ed_spec == "auto":
+                announce_eval(eval_dense, hot_n)
             ds, sinfo = ingest_lib.stream_shard_dataset(
                 cfg.train_file, cfg.num_features, k, layout=cfg.layout,
                 dtype=dtype, mesh=mesh, eval_dense=eval_dense,
-                hot_cols=hot_n, index=index)
+                hot_cols=hot_n, index=index, cache=ingest_cache)
             if resolved_layout == "sparse":
                 layout_split = hybrid_lib.stats_from_counts(
                     extras["hotCols"], index.hist, hot_n,
@@ -1124,14 +1231,19 @@ def main(argv=None) -> int:
                 bytes_read=index.scan_bytes + sinfo.bytes_read,
                 rows=sinfo.rows, nnz=sinfo.nnz,
                 n=n, total_nnz=index.total_nnz,
-                peak_rss_bytes=ingest_lib.peak_rss_bytes()))
+                peak_rss_bytes=ingest_lib.peak_rss_bytes(),
+                cache=stream_cache_status(index, sinfo)))
+            record_cache(cfg.train_file,
+                         stream_cache_status(index, sinfo), sinfo)
             if cfg.test_file:
                 tindex = ingest_lib.build_index(cfg.test_file,
-                                                cfg.num_features)
+                                                cfg.num_features,
+                                                cache=ingest_cache)
                 test_ds, tinfo = ingest_lib.stream_shard_dataset(
                     cfg.test_file, cfg.num_features, k,
                     layout=cfg.layout, dtype=dtype, mesh=mesh,
-                    eval_dense=eval_dense, hot_cols=hot_n, index=tindex)
+                    eval_dense=eval_dense, hot_cols=hot_n, index=tindex,
+                    cache=ingest_cache)
                 ingest_reports.append(ingest_lib.IngestReport(
                     mode="stream", path=cfg.test_file,
                     file_bytes=tindex.file_bytes,
@@ -1141,54 +1253,38 @@ def main(argv=None) -> int:
                     bytes_read=tindex.scan_bytes + tinfo.bytes_read,
                     rows=tinfo.rows, nnz=tinfo.nnz,
                     n=tindex.n, total_nnz=tindex.total_nnz,
-                    peak_rss_bytes=ingest_lib.peak_rss_bytes()))
+                    peak_rss_bytes=ingest_lib.peak_rss_bytes(),
+                    cache=stream_cache_status(tindex, tinfo)))
+                record_cache(cfg.test_file,
+                             stream_cache_status(tindex, tinfo), tinfo)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
     else:
         # whole-file ingest: every process parses the full file, then
         # slices out its shards (the bit-exact A/B control; multi-process
-        # dp runs still materialize only their local shards host-side)
+        # dp runs still materialize only their local shards host-side).
+        # An explicit --ingest=whole with --ingestCache still consults
+        # AND populates the slab cache (docs/DESIGN.md §18): a warm full
+        # hit skips the parse entirely (data/ingest.load_cached_dataset),
+        # a cold parse publishes every built shard plus the file's stats
+        # artifact for the next process.
+        import numpy as _np
+
+        from cocoa_tpu.data.sharding import resolve_layout_stats as _rls
+
         t_load = time_mod.perf_counter()
-        try:
-            data = load_libsvm(cfg.train_file, cfg.num_features)
-        except (OSError, ValueError) as e:  # missing file, bad numFeatures
-            print(f"error: {e}", file=sys.stderr)
-            return 2
-        n = data.n
 
-        # --hotCols=auto|off|<n>: the hot/cold column split (sparse
-        # layout only, data/hybrid.py).  Resolved HERE — against the
-        # measured column histogram, with the panel's HBM bytes accounted
-        # explicitly — so the run_start manifest records the split the
-        # run actually trains on.
-        if objective == "svm":
-            resolved_layout = resolve_layout(data, cfg.layout, mesh)
-            if (extras["hotCols"] is not None
-                    and resolved_layout != "sparse"):
-                print("error: --hotCols (the hot/cold column split) only "
-                      "applies to the sparse layout", file=sys.stderr)
-                return 2
-            if resolved_layout == "sparse":
-                try:
-                    hot_n, layout_split = resolve_hot_cols(
-                        extras["hotCols"], data, k, dtype)
-                except ValueError as e:
-                    print(f"error: {e}", file=sys.stderr)
-                    return 2
-                if ed_spec == "auto":
-                    # materialize the dense eval twin only when it fits
-                    # the HBM budget; otherwise (with a hot panel) the
-                    # certificate margins ride the panel matvec +
-                    # residual stream (ops/rows.eval_margins)
-                    from cocoa_tpu.data.sharding import eval_dense_fits
+        def whole_handle(path):
+            if ingest_cache is None or objective != "svm":
+                return None
+            try:
+                return ingest_cache.for_file(path, cfg.num_features)
+            except OSError:
+                return None  # a vanished file fails the parse below
+                # with its own clean error
 
-                    eval_dense = eval_dense_fits(n, cfg.num_features, k,
-                                                 dtype)
-                    announce_eval(eval_dense, hot_n)
-                announce_hot(layout_split, hot_n)
-
-        def whole_report(path, parsed, seconds):
+        def whole_report(path, parsed, seconds, cache="off"):
             # one report per loaded file, like the stream branch, so the
             # stream-vs-whole telemetry is an apples-to-apples A/B;
             # parse seconds cover parse + shard/slab build, same span the
@@ -1203,39 +1299,195 @@ def main(argv=None) -> int:
                 bytes_read=fsize, rows=parsed.n,
                 nnz=int(parsed.indptr[-1]), n=parsed.n,
                 total_nnz=int(parsed.indptr[-1]),
-                peak_rss_bytes=ingest_lib.peak_rss_bytes())
+                peak_rss_bytes=ingest_lib.peak_rss_bytes(), cache=cache)
 
-        try:
+        def warm_whole(handle, stats, path, hot_w, ed, t0):
+            """(ds, report) served entirely from cache artifacts, or
+            None — the caller cold-parses, which re-populates."""
+            if handle is None or stats is None:
+                return None
+            lay = _rls(stats.n, cfg.num_features, stats.total_nnz,
+                       cfg.layout, mesh)
+            got = ingest_lib.load_cached_dataset(
+                handle, stats, k, layout=lay, dtype=dtype, mesh=mesh,
+                eval_dense=ed, hot_cols=hot_w)
+            if got is None:
+                return None
+            ds_w, winfo = got
+            record_cache(path, "hit", winfo)
+            rep = ingest_lib.IngestReport(
+                mode="whole", path=path, file_bytes=stats.file_bytes,
+                processes=jax.process_count(),
+                parse_seconds=time_mod.perf_counter() - t0,
+                bytes_read=0, rows=0, nnz=0, n=stats.n,
+                total_nnz=stats.total_nnz,
+                peak_rss_bytes=ingest_lib.peak_rss_bytes(),
+                cache="hit")
+            return ds_w, winfo, rep
+
+        def populate_whole(handle, parsed, path, snap, t0):
+            """After a cold whole parse+build: store the file's stats
+            artifact + (on a full miss) the cold cost, and emit the
+            cache outcome (the shard slabs were published inside
+            shard_dataset; ``snap`` is the :func:`cache_snap` taken
+            before the build)."""
+            handle.store_index(
+                hist=_np.bincount(parsed.indices,
+                                  minlength=cfg.num_features),
+                n=parsed.n, total_nnz=int(parsed.indptr[-1]),
+                max_row_nnz=int(parsed.max_nnz))
+            hits = ingest_cache.shard_hits - snap[0]
+            misses = ingest_cache.shard_misses - snap[1]
+            if hits == 0:
+                # only a FULL miss records the cold cost — a partial run
+                # re-paid its missed shards only, and that sliver would
+                # corrupt the seconds_saved estimate for good
+                handle.store_cost(time_mod.perf_counter() - t0)
+            status = "partial" if hits else "miss"
+            record_cache(path, status, ingest_lib.StreamBuildInfo(
+                rows=0, nnz=0, bytes_read=0, parse_seconds=0.0,
+                residual_max_nnz=0, shards_cached=hits,
+                shards_total=hits + misses,
+                cache_bytes_mapped=ingest_cache.bytes_mapped - snap[2],
+                cache_status=status))
+            return status
+
+        # the warm attempt resolves --layout/--hotCols/--evalDense=auto
+        # from the CACHED stats — bit-identical to the parsed-data
+        # resolution below (the stream-resolution parity pin) — so a
+        # full hit never reads a byte of text
+        train_handle = whole_handle(cfg.train_file)
+        train_stats = (train_handle.load_index()
+                       if train_handle is not None else None)
+        warm = None
+        if objective == "svm" and train_stats is not None:
+            n = train_stats.n
+            try:
+                resolved_layout, hot_n, eval_dense = resolve_stats_knobs(
+                    n, train_stats.total_nnz, train_stats.hist)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            warm = warm_whole(train_handle, train_stats, cfg.train_file,
+                              hot_n, eval_dense, t_load)
+            if warm is not None:
+                ds, winfo, rep = warm
+                ingest_reports.append(rep)
+                if resolved_layout == "sparse":
+                    from cocoa_tpu.data import hybrid as hybrid_mod
+                    if ed_spec == "auto":
+                        announce_eval(eval_dense, hot_n)
+                    layout_split = hybrid_mod.stats_from_counts(
+                        extras["hotCols"], train_stats.hist, hot_n,
+                        (winfo.residual_max_nnz if hot_n
+                         else int(train_stats.max_row_nnz)),
+                        n, k, dtype)
+                    announce_hot(layout_split, hot_n)
+
+        if warm is None:
+            try:
+                data = load_libsvm(cfg.train_file, cfg.num_features)
+            except (OSError, ValueError) as e:  # missing file, bad
+                # numFeatures
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            n = data.n
+
+            # --hotCols=auto|off|<n>: the hot/cold column split (sparse
+            # layout only, data/hybrid.py).  Resolved HERE — against the
+            # measured column histogram, with the panel's HBM bytes
+            # accounted explicitly — so the run_start manifest records
+            # the split the run actually trains on.
             if objective == "svm":
-                # --evalDense: dense eval twin for sparse layouts — the
-                # duality-gap certificate's full margins pass as one MXU
-                # matvec instead of an every-nonzero w-gather (31% of the
-                # rcv1 production round); costs K*n_shard*d*itemsize HBM
-                ds = shard_dataset(data, k=k, layout=cfg.layout,
-                                   dtype=dtype, mesh=mesh,
-                                   eval_dense=eval_dense, hot_cols=hot_n)
-                ingest_reports.append(whole_report(
-                    cfg.train_file, data,
-                    time_mod.perf_counter() - t_load))
-                if cfg.test_file:
-                    t_test = time_mod.perf_counter()
+                resolved_layout = resolve_layout(data, cfg.layout, mesh)
+                if (extras["hotCols"] is not None
+                        and resolved_layout != "sparse"):
+                    print("error: --hotCols (the hot/cold column split) "
+                          "only applies to the sparse layout",
+                          file=sys.stderr)
+                    return 2
+                if resolved_layout == "sparse":
+                    try:
+                        hot_n, layout_split = resolve_hot_cols(
+                            extras["hotCols"], data, k, dtype)
+                    except ValueError as e:
+                        print(f"error: {e}", file=sys.stderr)
+                        return 2
+                    if ed_spec == "auto":
+                        # materialize the dense eval twin only when it
+                        # fits the HBM budget; otherwise (with a hot
+                        # panel) the certificate margins ride the panel
+                        # matvec + residual stream (ops/rows.eval_margins)
+                        from cocoa_tpu.data.sharding import eval_dense_fits
+
+                        eval_dense = eval_dense_fits(n, cfg.num_features,
+                                                     k, dtype)
+                        announce_eval(eval_dense, hot_n)
+                    announce_hot(layout_split, hot_n)
+
+            try:
+                if objective == "svm":
+                    # --evalDense: dense eval twin for sparse layouts —
+                    # the duality-gap certificate's full margins pass as
+                    # one MXU matvec instead of an every-nonzero
+                    # w-gather (31% of the rcv1 production round); costs
+                    # K*n_shard*d*itemsize HBM
+                    snap = cache_snap()
+                    ds = shard_dataset(data, k=k, layout=cfg.layout,
+                                       dtype=dtype, mesh=mesh,
+                                       eval_dense=eval_dense,
+                                       hot_cols=hot_n,
+                                       cache=train_handle)
+                    status = "off"
+                    if train_handle is not None:
+                        status = populate_whole(
+                            train_handle, data, cfg.train_file, snap,
+                            t_load)
+                    ingest_reports.append(whole_report(
+                        cfg.train_file, data,
+                        time_mod.perf_counter() - t_load, cache=status))
+                else:
+                    ingest_reports.append(whole_report(
+                        cfg.train_file, data,
+                        time_mod.perf_counter() - t_load))
+            except (OSError, ValueError) as e:  # e.g. --layout=sparse
+                # + --fp>1
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+
+        if objective == "svm" and cfg.test_file:
+            try:
+                t_test = time_mod.perf_counter()
+                test_handle = whole_handle(cfg.test_file)
+                test_stats = (test_handle.load_index()
+                              if test_handle is not None else None)
+                test_warm = warm_whole(test_handle, test_stats,
+                                       cfg.test_file, hot_n, eval_dense,
+                                       t_test)
+                if test_warm is not None:
+                    test_ds, _, rep = test_warm
+                    ingest_reports.append(rep)
+                else:
                     test_data = load_libsvm(cfg.test_file,
                                             cfg.num_features)
+                    snap = cache_snap()
                     test_ds = shard_dataset(test_data, k=k,
                                             layout=cfg.layout,
                                             dtype=dtype, mesh=mesh,
                                             eval_dense=eval_dense,
-                                            hot_cols=hot_n)
+                                            hot_cols=hot_n,
+                                            cache=test_handle)
+                    status = "off"
+                    if test_handle is not None:
+                        status = populate_whole(
+                            test_handle, test_data, cfg.test_file,
+                            snap, t_test)
                     ingest_reports.append(whole_report(
                         cfg.test_file, test_data,
-                        time_mod.perf_counter() - t_test))
-            else:
-                ingest_reports.append(whole_report(
-                    cfg.train_file, data,
-                    time_mod.perf_counter() - t_load))
-        except (OSError, ValueError) as e:  # e.g. --layout=sparse + --fp>1
-            print(f"error: {e}", file=sys.stderr)
-            return 2
+                        time_mod.perf_counter() - t_test, cache=status))
+            except (OSError, ValueError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
 
     if layout_split is not None:
         cfg_manifest["layout_split"] = layout_split
@@ -1253,6 +1505,8 @@ def main(argv=None) -> int:
         bus.emit("run_start", manifest=manifest)
         for rep in ingest_reports:
             bus.emit("ingest", **rep.as_fields())
+        for ev_fields in cache_events:
+            bus.emit("ingest_cache", **ev_fields)
 
     params = cfg.to_params(n, k)
     debug = cfg.to_debug()

@@ -17,6 +17,7 @@ from cocoa_tpu.data.ingest import (  # noqa: F401
     resolve_ingest_mode,
     stream_shard_dataset,
 )
+from cocoa_tpu.data.slab_cache import SlabCache  # noqa: F401
 from cocoa_tpu.data.columns import shard_columns  # noqa: F401
 from cocoa_tpu.data.fleet import (  # noqa: F401
     FleetDataset,

@@ -312,7 +312,20 @@ def build_fleet(specs: list, k: int, *, dtype=None,
             f"would make every vmapped lane pay every branch); manifest "
             f"mixes {losses_seen} — split the fleet by loss")
 
-    parsed = [parse_dataset_ref(s.dataset, s.num_features) for s in specs]
+    # dataset refs resolve through an in-process memo: tenants sharing a
+    # ref (one-vs-rest heads, a λ path over one corpus) parse it ONCE
+    # per run — T tenants map one build T times, never T parses (the
+    # parse-count pin in tests/test_fleet.py).  Ref resolution is pure
+    # (synth refs are seed-keyed, file refs re-read the same bytes), so
+    # sharing the parsed CSR is exact; the slab build below only READS
+    # it per tenant.
+    ref_memo: dict = {}
+    parsed = []
+    for s in specs:
+        key = (s.dataset, int(s.num_features))
+        if key not in ref_memo:
+            ref_memo[key] = parse_dataset_ref(s.dataset, s.num_features)
+        parsed.append(ref_memo[key])
     ds_d = sorted({p.num_features for p in parsed})
     if len(ds_d) > 1:
         raise ValueError(

@@ -341,11 +341,38 @@ def _assemble_distributed(mesh, k, built, locals_, *, layout, n, d,
     )
 
 
+def _slab_view(cache, layout, k, n_shard, width, n_hot, d, np_dtype,
+               eval_dense):
+    """The fully-resolved layout's slab-cache view, or None when no
+    ``--ingestCache`` handle rides the build (data/slab_cache.py)."""
+    if cache is None:
+        return None
+    return cache.view(layout=layout, k=k, n_shard=n_shard, width=width,
+                      n_hot=n_hot, d=d, dtype=np_dtype,
+                      eval_dense=eval_dense)
+
+
+def _cached_or_built(view, s, build):
+    """One shard through the optional slab-cache view: a valid cached
+    artifact wins (zero build), a miss builds and publishes (atomic
+    rename, one writer wins) — the whole-path twin of the per-shard
+    logic in data/ingest._stream_build."""
+    if view is not None:
+        slab = view.load(s)
+        if slab is not None:
+            return slab
+    slab = build()
+    if view is not None:
+        view.store(s, slab)
+    return slab
+
+
 def _shard_dataset_distributed(data, k, layout, np_dtype, mesh, sizes,
                                offsets, n_shard, d, width, row_nnz,
                                row_sq, *, rank=None, n_hot=0,
                                hot_ids=None,
-                               eval_dense=False) -> ShardedDataset:
+                               eval_dense=False,
+                               cache_view=None) -> ShardedDataset:
     """Multi-process assembly from a WHOLE-parsed dataset: each process
     materializes ONLY the shards whose dp mesh position is one of its own
     devices — m = K/D consecutive logical shards per device when the mesh
@@ -361,9 +388,12 @@ def _shard_dataset_distributed(data, k, layout, np_dtype, mesh, sizes,
     replicated-assembly path)."""
     locals_ = mesh_lib.dp_local_shards(mesh, k)
     built = {
-        s: _build_shard_slabs(data, offsets[s], offsets[s + 1], n_shard,
-                              layout, np_dtype, d, width, row_nnz, row_sq,
-                              rank=rank, n_hot=n_hot, eval_dense=eval_dense)
+        s: _cached_or_built(
+            cache_view, s,
+            lambda s=s: _build_shard_slabs(
+                data, offsets[s], offsets[s + 1], n_shard, layout,
+                np_dtype, d, width, row_nnz, row_sq, rank=rank,
+                n_hot=n_hot, eval_dense=eval_dense))
         for _, lo, hi in locals_ for s in range(lo, hi)
     }
     return _assemble_distributed(mesh, k, built, locals_, layout=layout,
@@ -382,6 +412,7 @@ def shard_dataset(
     max_nnz: Optional[int] = None,
     eval_dense: bool = False,
     hot_cols: int = 0,
+    cache=None,
 ) -> ShardedDataset:
     """Partition ``data`` into K balanced contiguous shards and device_put them.
 
@@ -407,6 +438,14 @@ def shard_dataset(
     Multi-process runs (``jax.process_count() > 1`` with a dp mesh)
     materialize only each process's own shards host-side — see
     :func:`_shard_dataset_distributed`.
+
+    ``cache`` (an optional ``slab_cache.FileCacheHandle``,
+    ``--ingestCache``) serves each shard from its persistent slab
+    artifact when present and publishes every shard built cold — the
+    whole-file path's half of the docs/DESIGN.md §18 cache contract
+    (the zero-parse warm path lives in data/ingest.load_cached_dataset;
+    here the parse is already paid, so a hit saves the slab build and a
+    miss populates for the next process).
     """
     n, d = data.n, data.num_features
     layout = resolve_layout(data, layout, mesh)
@@ -459,8 +498,14 @@ def shard_dataset(
         # tail's max, not the full row's
         cold_rows = np.repeat(np.arange(n, dtype=np.int64),
                               row_nnz)[rank[data.indices] < 0]
-        width = max(1, int(np.bincount(cold_rows, minlength=max(1, n))
-                           .max(initial=0)))
+        resid_max = int(np.bincount(cold_rows, minlength=max(1, n))
+                        .max(initial=0))
+        width = max(1, resid_max)
+        if cache is not None:
+            # the measured residual width is what keys the hybrid shard
+            # artifacts — persist it so a warm run (data/ingest.py
+            # load_cached_dataset) resolves the SAME width with no parse
+            cache.store_hybrid_meta(n_hot, resid_max)
 
     if eval_dense and layout != "sparse":
         raise ValueError("eval_dense only applies to the sparse layout "
@@ -483,22 +528,29 @@ def shard_dataset(
                 f"mesh size: K={k} shards cannot multiplex onto "
                 f"{mesh.devices.size} devices"
             )
+        d_eff = mesh_lib.pad_features(d, mesh) if layout == "dense" else d
         return _shard_dataset_distributed(
             data, k, layout, np_dtype, mesh, sizes, offsets, n_shard,
             # mirror the replicated path: only the dense layout pads d
-            mesh_lib.pad_features(d, mesh) if layout == "dense" else d,
+            d_eff,
             width, row_nnz, row_sq, rank=rank, n_hot=n_hot,
             hot_ids=hot_ids, eval_dense=eval_dense,
+            cache_view=_slab_view(cache, layout, k, n_shard, width,
+                                  n_hot, d_eff, np_dtype, eval_dense),
         )
 
     if layout == "dense":
         d = mesh_lib.pad_features(d, mesh)
+    view = _slab_view(cache, layout, k, n_shard, width, n_hot, d,
+                      np_dtype, eval_dense)
     arrs: dict = {}
     for s in range(k):
-        slab = _build_shard_slabs(data, offsets[s], offsets[s + 1],
-                                  n_shard, layout, np_dtype, d, width,
-                                  row_nnz, row_sq, rank=rank, n_hot=n_hot,
-                                  eval_dense=eval_dense)
+        slab = _cached_or_built(
+            view, s,
+            lambda s=s: _build_shard_slabs(
+                data, offsets[s], offsets[s + 1], n_shard, layout,
+                np_dtype, d, width, row_nnz, row_sq, rank=rank,
+                n_hot=n_hot, eval_dense=eval_dense))
         for f, v in slab.items():
             arrs.setdefault(f, np.zeros((k, *v.shape), v.dtype))[s] = v
     if n_hot:

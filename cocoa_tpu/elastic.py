@@ -80,6 +80,15 @@ docs/DESIGN.md §12, README "Multi-host quickstart") — so a gang restart
 re-pays ~2/P of a full parse per worker, not P redundant whole-file
 parses; after a shrink the same pipeline hands each survivor its
 inherited m = K/P′ shards with no resharding code of its own.
+
+With ``--ingestCache=DIR`` (data/slab_cache.py, docs/DESIGN.md §18) a
+restart generation re-pays NOTHING: the supervisor re-executes the
+user's command line verbatim (``strip_elastic_flags`` removes only the
+flags the supervisor owns, so the cache dir is forwarded to every
+relaunched generation), and because the slab artifacts are keyed by
+SHARD — not by process count or mesh — a shrunk gang's survivors re-map
+their newly inherited shards warm: the shrink re-ingest parses zero
+bytes (pinned by the chaos suite's cache variant).
 """
 
 from __future__ import annotations

@@ -49,7 +49,19 @@ EVENT_TYPES = (
     "theta_stage",      # --accel: the Θ local-accuracy ladder stepped up
     "ingest",           # one loaded LIBSVM file (data/ingest.IngestReport:
                         # mode, parse seconds, bytes read, rows/nnz this
-                        # process materialized, peak host RSS)
+                        # process materialized, peak host RSS, and the
+                        # --ingestCache outcome: off|hit|partial|miss)
+    "ingest_cache",     # one file's --ingestCache outcome in detail
+                        # (data/slab_cache.py, docs/DESIGN.md §18):
+                        # shards served warm vs total, bytes mapped,
+                        # seconds the cache saved — what feeds
+                        # cocoa_ingest_cache_hits_total /
+                        # cocoa_ingest_cache_bytes
+    "ingest_cache_corrupt",  # a cache artifact failed validation on
+                        # load (torn/truncated/drifted file): the
+                        # artifact is evicted and the shard falls back
+                        # to a cold parse — never a crash, never a
+                        # silently wrong slab
     "gang_resize",      # the elastic supervisor reformed the gang at
                         # P′ < P survivors (shrink-to-survivors,
                         # cocoa_tpu/elastic.py, docs/DESIGN.md §13)

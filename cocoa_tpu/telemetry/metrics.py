@@ -29,6 +29,13 @@ otherwise one opaque device dispatch:
 - ``cocoa_ingest_bytes``        gauge   — cumulative bytes this process
   read to ingest data (streamed runs read ~2/P of the file vs the whole
   of it — the streaming win, observable)
+- ``cocoa_ingest_cache_hits_total`` counter — shards served warm from
+  the ``--ingestCache`` slab cache (the ``ingest_cache`` event;
+  rendered only once a cache-armed run reported).
+  ``cocoa_ingest_cache_bytes`` gauge (cumulative artifact bytes mapped)
+  and ``cocoa_ingest_cache_corrupt_total`` counter (artifacts evicted
+  by load validation — any nonzero value deserves a disk look) ride
+  alongside
 - ``cocoa_gang_size``           gauge   — current elastic gang size after
   a shrink-to-survivors resize (the ``gang_resize`` event; absent until
   the first resize — the configured size is in the run manifest)
@@ -167,6 +174,10 @@ class MetricsWriter:
         self.host_transfers_total = 0
         self.ingest_seconds = 0.0
         self.ingest_bytes = 0
+        self.ingest_cache_seen = False
+        self.ingest_cache_hits_total = 0
+        self.ingest_cache_bytes = 0
+        self.ingest_cache_corrupt_total = 0
         self.phase_seconds: dict = {}   # span phase -> cumulative seconds
         self.overlap_hidden_seconds = 0.0
         self.overlap_wait_seconds = 0.0
@@ -266,6 +277,15 @@ class MetricsWriter:
                 self.ingest_seconds += float(rec["parse_seconds"])
             if rec.get("bytes_read") is not None:
                 self.ingest_bytes += int(rec["bytes_read"])
+        elif ev == "ingest_cache":
+            self.ingest_cache_seen = True
+            if rec.get("shards_cached") is not None:
+                self.ingest_cache_hits_total += int(rec["shards_cached"])
+            if rec.get("bytes_mapped") is not None:
+                self.ingest_cache_bytes += int(rec["bytes_mapped"])
+        elif ev == "ingest_cache_corrupt":
+            self.ingest_cache_seen = True
+            self.ingest_cache_corrupt_total += 1
         elif ev == "span":
             # per-phase wall-clock gauge (tracing.py spans): cumulative
             # seconds this process spent in each instrumented phase —
@@ -431,6 +451,18 @@ class MetricsWriter:
             "# TYPE cocoa_checkpoint_corrupt_total counter",
             f"cocoa_checkpoint_corrupt_total {self.checkpoint_corrupt_total}",
         ]
+        if self.ingest_cache_seen:
+            # cache families render only once a --ingestCache run has
+            # reported (uncached runs must not carry zero-valued series)
+            lines += ["# TYPE cocoa_ingest_cache_hits_total counter",
+                      f"cocoa_ingest_cache_hits_total "
+                      f"{self.ingest_cache_hits_total}",
+                      "# TYPE cocoa_ingest_cache_bytes gauge",
+                      f"cocoa_ingest_cache_bytes "
+                      f"{self.ingest_cache_bytes}",
+                      "# TYPE cocoa_ingest_cache_corrupt_total counter",
+                      f"cocoa_ingest_cache_corrupt_total "
+                      f"{self.ingest_cache_corrupt_total}"]
         if self.gang_generations_total:
             # gang families appear in an "all" file only when this
             # process actually saw gang events (a worker never does —

@@ -64,6 +64,7 @@ INGEST_FIELDS = {
     "n": (int,),
     "total_nnz": (int,),
     "peak_rss_bytes": (int,),
+    "cache": (str,),     # --ingestCache outcome: off|hit|partial|miss
 }
 
 # event type -> {field: allowed types}; every event also needs seq/ts
@@ -95,6 +96,17 @@ EVENT_FIELDS = {
     # streaming/whole ingest of one LIBSVM file (data/ingest.py): what
     # feeds cocoa_ingest_seconds / cocoa_ingest_bytes in --metrics
     "ingest": INGEST_FIELDS,
+    # one file's --ingestCache outcome (data/slab_cache.py, DESIGN.md
+    # §18): what feeds cocoa_ingest_cache_hits_total /
+    # cocoa_ingest_cache_bytes in --metrics
+    "ingest_cache": {"path": (str,), "status": (str,),
+                     "shards_cached": (int,), "shards_total": (int,),
+                     "bytes_mapped": (int,), "seconds_saved": _NUM},
+    # a cache artifact failed validation on load and was evicted; the
+    # shard fell back to a cold parse (the torn/truncated-file recovery
+    # path, pinned with the tests/_faults.py truncate fault)
+    "ingest_cache_corrupt": {"path": (str,), "artifact": (str,),
+                             "reason": (str,)},
     # the elastic supervisor reformed the gang at P′ < P survivors
     # (cocoa_tpu/elastic.py shrink-to-survivors): what feeds the
     # cocoa_gang_size gauge.  ``restart`` events additionally carry
@@ -235,6 +247,10 @@ RESULTS_FIELDS = {
     "parse_s": _NUM, "bytes_read_mb": _NUM, "peak_rss_mb": _NUM,
     "rss_delta_mb": _NUM, "rss_vs_whole": _NUM,
     "predicted_parse_s": _NUM, "predicted_csr_mb": _NUM,
+    # the warm-ingest rows (--ingestCache, benchmarks/run.py
+    # bench_ingest "warm" mode): zero-parse slab mapping vs the streamed
+    # cold parse of the same file/geometry
+    "warm_speedup": _NUM, "bytes_mapped_mb": _NUM,
     # the serving rows (--serve / benchmarks/serve_bench.py): queries/s
     # under a pinned p99 SLA plus the model-freshness (gap age) the run
     # observed; buckets is the static bucket ladder ("64/256"), compiles

@@ -65,6 +65,26 @@ def truncate_newest_checkpoint(ckdir, keep_bytes: int = 64) -> Callable:
     return act
 
 
+def truncate_newest_cache_artifact(cache_dir, keep_bytes: int = 64
+                                   ) -> Callable:
+    """Action: tear the most recently written ``.npy`` slab under an
+    ``--ingestCache`` directory down to ``keep_bytes`` — the torn/
+    bit-rotted artifact ``slab_cache.ShardCacheView.load`` must reject
+    (typed ``ingest_cache_corrupt`` event, artifact evicted) so the
+    shard falls back to a cold parse instead of training on garbage.
+    Selected by mtime like :func:`truncate_newest_checkpoint`."""
+    def act(procs):
+        paths = []
+        for root, _, files in os.walk(str(cache_dir)):
+            paths += [os.path.join(root, f) for f in files
+                      if f.endswith(".npy") and "slab-" in root]
+        if paths:
+            newest = max(paths, key=lambda p: (os.path.getmtime(p), p))
+            with open(newest, "r+b") as f:
+                f.truncate(keep_bytes)
+    return act
+
+
 def checkpoint_at_least(ckdir, algorithm: str,
                         min_round: int = 1) -> Callable:
     """Trigger: a round-stamped checkpoint for ``algorithm`` at round >=

@@ -165,6 +165,7 @@ def main(argv=None) -> int:
                   flush=True)
 
     failures += overlap_smoke(outdir, workdir)
+    failures += ingest_cache_smoke(outdir, workdir)
 
     if failures:
         for f in failures:
@@ -173,7 +174,9 @@ def main(argv=None) -> int:
     print("chaos-smoke: OK — kill survived, gang shrunk 2->1, final "
           "state bit-identical to the control, events schema-valid, "
           "gang gauges present, flightrec dumped, trace assembled, "
-          "overlap+staleness cut the exchange slack", flush=True)
+          "overlap+staleness cut the exchange slack, restarted "
+          "generation re-ingested from the slab cache with zero "
+          "re-parsed bytes", flush=True)
     return 0
 
 
@@ -245,6 +248,84 @@ def overlap_smoke(outdir: str, workdir: str) -> list:
                             f"stream")
     with open(os.path.join(outdir, "overlap-straggler.prom"), "w") as f:
         f.write(trace_report.metrics_text(spans))
+    return failures
+
+
+def ingest_cache_smoke(outdir: str, workdir: str) -> list:
+    """The ISSUE-15 chaos-step variant: a supervised REAL-CLI training
+    run with `--ingestCache` loses its worker to a deterministic SIGKILL
+    mid-run; the relaunched generation must RE-INGEST ENTIRELY FROM THE
+    SLAB CACHE — its typed ``ingest`` event reports cache=hit with zero
+    bytes read (the shard artifacts are geometry-free, so a restart or
+    shrink re-pays nothing) — and the run still completes its full round
+    budget.  The worker event stream (incl. the typed ``ingest_cache``
+    events) schema-validates and lands in the artifact dir."""
+    from _faults import Fault, FaultPlan, checkpoint_at_least, sigkill
+
+    failures = []
+    ck = os.path.join(workdir, "ck_cache")
+    cache_dir = os.path.join(workdir, "icache")
+    ev = os.path.join(outdir, "cache-events.jsonl")
+    train = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "data",
+        "small_train.dat")
+    argv = [
+        f"--trainFile={train}", "--numFeatures=9947", "--numSplits=4",
+        "--numRounds=40", "--debugIter=10", "--localIterFrac=0.05",
+        "--lambda=0.001", "--justCoCoA=true", f"--chkptDir={ck}",
+        "--chkptIter=10", "--quiet", f"--ingestCache={cache_dir}",
+        f"--events={ev}",
+    ]
+    plan = FaultPlan(
+        Fault(generation=0, actions=(sigkill(0),),
+              trigger=checkpoint_at_least(ck, "CoCoA+", 10),
+              name="kill-worker"),
+    )
+    print("chaos-smoke: supervised CLI run with --ingestCache, SIGKILL "
+          "mid-run, warm re-ingest", flush=True)
+    rc = elastic.supervise(argv, 1, max_restarts=3, poll_s=0.05,
+                           backoff_base_s=0.2,
+                           on_generation=plan.on_generation)
+    plan.join()
+    if rc != 0:
+        failures.append(f"cache-smoke supervised run exited {rc}")
+    if plan.errors:
+        failures.append(f"cache-smoke fault plan errors: {plan.errors}")
+    if plan.fired != ["kill-worker"]:
+        failures.append(f"cache-smoke fault never fired: {plan.fired}")
+    path = ckpt_lib.latest(ck, "CoCoA+")
+    if path is None:
+        failures.append("cache-smoke: no final checkpoint")
+    else:
+        meta, _, _ = ckpt_lib.load(path)
+        if meta["round"] != 40:
+            failures.append(f"cache-smoke stopped at round "
+                            f"{meta['round']}")
+    errs = tele_schema.check_file(ev)
+    if errs:
+        failures.append(f"cache-smoke events schema violations: "
+                        f"{errs[:5]}")
+    recs = [json.loads(ln) for ln in open(ev)]
+    ingests = [r for r in recs if r["event"] == "ingest"]
+    if len(ingests) < 2:
+        failures.append(f"cache-smoke: expected one ingest event per "
+                        f"generation, got {len(ingests)}")
+    else:
+        if ingests[0]["cache"] != "miss":
+            failures.append(f"cache-smoke: first generation should miss "
+                            f"({ingests[0]['cache']})")
+        last = ingests[-1]
+        if last["cache"] != "hit" or last["bytes_read"] != 0:
+            failures.append(
+                f"cache-smoke: restarted generation re-parsed — "
+                f"cache={last['cache']}, bytes_read="
+                f"{last['bytes_read']} (the zero-reparse contract)")
+        else:
+            print(f"chaos-smoke: restart re-ingested warm (cache=hit, "
+                  f"0 bytes re-parsed, cold paid "
+                  f"{ingests[0]['bytes_read']} bytes)", flush=True)
+    if not any(r["event"] == "ingest_cache" for r in recs):
+        failures.append("cache-smoke: no typed ingest_cache event")
     return failures
 
 

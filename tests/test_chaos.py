@@ -750,14 +750,17 @@ def test_gang_kill_plus_torn_checkpoint_resumes_previous(tmp_path,
 # --- the real-training chaos pin (needs multi-process CPU collectives) -------
 
 
-def _real_training_argv(train, ckdir, ev, rounds=200):
-    return [
+def _real_training_argv(train, ckdir, ev, rounds=200, cache_dir=None):
+    argv = [
         f"--trainFile={train}", "--numFeatures=64",
         f"--numRounds={rounds}", "--localIterFrac=0.2", "--numSplits=2",
         "--lambda=.01", "--justCoCoA=true", "--debugIter=10",
         f"--chkptDir={ckdir}", "--chkptIter=10", "--dtype=float64",
         f"--events={ev}",
     ]
+    if cache_dir is not None:
+        argv.append(f"--ingestCache={cache_dir}")
+    return argv
 
 
 def _final_gaps(ev_path):
@@ -780,7 +783,12 @@ def test_chaos_real_training_shrink_bit_identical(tmp_path, monkeypatch,
     worker SIGKILLed mid-run completes on the survivor (P'=1) and its
     final (w, alpha, gap) is bit-identical to the unfailed 2-process
     control; with the newest checkpoint also torn, the survivor resumes
-    from the previous generation and the pin still holds."""
+    from the previous generation and the pin still holds.  The chaos arm
+    rides --ingestCache (the control stays uncached — slab-cache
+    bit-identity is part of what the A/B proves): the shrunken
+    generation's re-ingest must be a full cache hit with ZERO re-parsed
+    bytes (the ISSUE-15 shrink contract — shard artifacts are
+    geometry-free, so the survivor maps its inherited shards warm)."""
     if not hasattr(jax, "shard_map"):
         pytest.skip("the 2-process training gang rides the mesh path, "
                     "which needs jax.shard_map (newer jax)")
@@ -800,7 +808,9 @@ def test_chaos_real_training_shrink_bit_identical(tmp_path, monkeypatch,
               name="chaos"),
     )
     rc = elastic.supervise(
-        _real_training_argv(train, ck, ev), 2, max_restarts=3,
+        _real_training_argv(train, ck, ev,
+                            cache_dir=tmp_path / "icache"),
+        2, max_restarts=3,
         num_splits=2, shrink="now", backoff_base_s=0.2,
         on_generation=plan.on_generation,
         # tearing in the on_restart window (gang down, survivors not yet
@@ -832,6 +842,13 @@ def test_chaos_real_training_shrink_bit_identical(tmp_path, monkeypatch,
     # the certified gap agrees exactly too (run_end carries it)
     assert _final_gaps(ev) == _final_gaps(ev_ref)
     assert tele_schema.check_file(str(ev)) == []
+    recs = [json.loads(ln) for ln in ev.read_text().splitlines()]
     if tear_newest:
-        recs = [json.loads(ln) for ln in ev.read_text().splitlines()]
         assert any(r["event"] == "checkpoint_corrupt" for r in recs)
+    # the shrink re-ingest contract: the reformed generation (the last
+    # ingest on worker 0's stream, after the gang_resize) served every
+    # inherited shard from the slab cache — zero re-parsed bytes
+    ingests = [r for r in recs if r["event"] == "ingest"]
+    assert ingests and ingests[0]["cache"] == "miss"
+    assert ingests[-1]["cache"] == "hit"
+    assert ingests[-1]["bytes_read"] == 0

@@ -186,6 +186,52 @@ def test_cli_ingest_flag(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_cli_ingest_cache_flag(tmp_path, capsys):
+    """--ingestCache lands in the run-level extras; lasso and --fleet
+    reject it loudly (nothing shard-keyed to cache); a cache-armed run
+    warms the SECOND invocation — its ingest event reports cache=hit
+    with zero bytes read."""
+    cfg, extras = parse_args(["--ingestCache=/tmp/x"])
+    assert extras["ingestCache"] == "/tmp/x"
+
+    from cocoa_tpu.cli import main
+    from cocoa_tpu.data.fleet import synth_fleet_specs, write_fleet_manifest
+    from cocoa_tpu.data.synth import synth_sparse, write_libsvm
+
+    path = str(tmp_path / "t.dat")
+    write_libsvm(synth_sparse(64, 400, nnz_mean=8, seed=0), path)
+    cache_dir = str(tmp_path / "icache")
+    base = [f"--trainFile={path}", "--numFeatures=400", "--numSplits=4",
+            "--mesh=1", "--numRounds=1", "--debugIter=0",
+            f"--ingestCache={cache_dir}"]
+
+    assert main(base + ["--objective=lasso", "--lambda=0.1"]) == 2
+    assert "lasso" in capsys.readouterr().err
+
+    manifest = str(tmp_path / "fleet.jsonl")
+    write_fleet_manifest(manifest, synth_fleet_specs(2, n=32, d=8))
+    assert main([f"--fleet={manifest}", "--numSplits=2",
+                 f"--ingestCache={cache_dir}"]) == 2
+    assert "memo" in capsys.readouterr().err
+
+    # cold run populates, warm run hits with zero parse — checked off
+    # the machine-readable ingest events
+    ev1, ev2 = str(tmp_path / "e1.jsonl"), str(tmp_path / "e2.jsonl")
+    assert main(base + ["--quiet", f"--events={ev1}"]) == 0
+    assert main(base + ["--quiet", f"--events={ev2}"]) == 0
+    capsys.readouterr()
+
+    import json as _json
+
+    def ingest_events(p):
+        return [r for r in map(_json.loads, open(p))
+                if r["event"] == "ingest"]
+
+    cold, warm = ingest_events(ev1)[0], ingest_events(ev2)[0]
+    assert cold["cache"] == "miss" and cold["bytes_read"] > 0
+    assert warm["cache"] == "hit" and warm["bytes_read"] == 0
+
+
 def test_cli_fleet_flag_hardening(tmp_path, capsys):
     """--fleet's surface is deliberately narrow: every flag that cannot
     mean anything on the one-dispatch tenant-vmapped path is rejected

@@ -126,6 +126,40 @@ def test_build_fleet_rejects_shape_mismatches_with_numbers():
         build_fleet([TenantSpec("a", "synth:dense:n=3,d=16", 0.1)], k=4)
 
 
+def test_build_fleet_dedupes_shared_dataset_refs(monkeypatch):
+    """Tenants sharing a dataset ref parse it ONCE per run (the
+    in-process ref memo): a T-tenant fleet over one corpus maps one
+    build T times, never T parses — and the stacked slabs are bitwise
+    the no-dedupe build's."""
+    from cocoa_tpu.data import fleet as fleet_mod
+
+    calls = []
+    real = fleet_mod.parse_dataset_ref
+
+    def counting(ref, num_features=0):
+        calls.append(ref)
+        return real(ref, num_features)
+
+    monkeypatch.setattr(fleet_mod, "parse_dataset_ref", counting)
+    shared = "synth:dense:n=64,d=16,seed=3"
+    other = "synth:dense:n=64,d=16,seed=4"
+    specs = [TenantSpec(tenant=f"t{i}", dataset=shared, lam=0.01)
+             for i in range(4)]
+    specs.append(TenantSpec(tenant="t4", dataset=other, lam=0.02))
+    fleet = build_fleet(specs, k=2)
+    # one parse per DISTINCT ref — the parse-count pin
+    assert calls == [shared, other]
+    assert fleet.t == 5
+    # duplicate-ref tenants hold bitwise the same slab
+    for t in range(1, 4):
+        np.testing.assert_array_equal(np.asarray(fleet.X[0]),
+                                      np.asarray(fleet.X[t]))
+        np.testing.assert_array_equal(np.asarray(fleet.labels[0]),
+                                      np.asarray(fleet.labels[t]))
+    assert not np.array_equal(np.asarray(fleet.X[0]),
+                              np.asarray(fleet.X[4]))
+
+
 def test_build_fleet_pads_unequal_tenants_to_common_shape():
     fleet = build_fleet([
         TenantSpec("small", "synth:dense:n=48,d=16,seed=1", 0.1),

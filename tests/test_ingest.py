@@ -175,6 +175,13 @@ def test_resolve_ingest_mode():
     assert resolve_ingest_mode(None, None) == "whole"
     assert resolve_ingest_mode("auto", None) == "whole"
     assert resolve_ingest_mode("whole", None) == "whole"
+    # --ingestCache armed: auto routes through the shard-granular
+    # pipeline (what consults/populates the cache), explicit whole wins
+    assert resolve_ingest_mode("auto", None, cached=True) == "stream"
+    assert resolve_ingest_mode(None, None, cached=True) == "stream"
+    assert resolve_ingest_mode("whole", None, cached=True) == "whole"
+    assert resolve_ingest_mode("auto", None, objective="lasso",
+                               cached=True) == "whole"
     # explicit stream is honored wherever it is legal
     assert resolve_ingest_mode("stream", None) == "stream"
     if len(jax.devices()) >= 2:
@@ -201,6 +208,8 @@ def test_resolve_ingest_mode_rejects_fp_mesh():
     with pytest.raises(ValueError, match="feature-parallel"):
         resolve_ingest_mode("stream", fp_mesh)
     assert resolve_ingest_mode("auto", fp_mesh) == "whole"
+    # even with a cache armed, fp keeps whole (nothing shard-keyed)
+    assert resolve_ingest_mode("auto", fp_mesh, cached=True) == "whole"
 
 
 def test_stream_rejects_fp_mesh_and_bad_eval_dense(tmp_path):

@@ -1,0 +1,513 @@
+"""The shard-granular persistent slab cache (data/slab_cache.py,
+docs/DESIGN.md §18).
+
+The contract under test: cached-vs-fresh slabs are BITWISE identical and
+so is the downstream (w, α, gap) trajectory; a warm load parses ZERO
+bytes; the key invalidates on any source-file identity change (size,
+mtime_ns, inode — the coarse-mtime rewrite class included); a torn
+artifact falls back to a cold parse with a typed ``ingest_cache_corrupt``
+event, never a crash or a wrong slab; warm reads survive a process/mesh
+GEOMETRY change (the elastic-shrink re-ingest contract — keys are
+shard-granular, not geometry-keyed); and two processes racing to build
+the same shard settle on one valid artifact (atomic rename, one writer
+wins).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import DEMO_NUM_FEATURES, SMALL_TRAIN
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.dirname(os.path.abspath(__file__))
+
+
+def _assert_ds_equal(ds_a, ds_b):
+    assert ds_a.layout == ds_b.layout
+    assert ds_a.n == ds_b.n
+    assert ds_a.num_features == ds_b.num_features
+    np.testing.assert_array_equal(ds_a.counts, ds_b.counts)
+    arrs_a, arrs_b = ds_a.shard_arrays(), ds_b.shard_arrays()
+    assert arrs_a.keys() == arrs_b.keys()
+    for f in arrs_a:
+        a, b = np.asarray(arrs_a[f]), np.asarray(arrs_b[f])
+        assert a.dtype == b.dtype, f
+        np.testing.assert_array_equal(a, b, err_msg=f)
+
+
+def test_warm_stream_build_zero_parse_bitwise(tmp_path):
+    """Cold populates, warm maps: zero bytes parsed, slabs bit-identical
+    to the uncached control, index scan skipped too."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import (SlabCache, load_libsvm, shard_dataset,
+                                stream_shard_dataset)
+    from cocoa_tpu.data.ingest import build_index
+
+    d = DEMO_NUM_FEATURES
+    cold_cache = SlabCache(str(tmp_path / "c"))
+    ds_cold, info_cold = stream_shard_dataset(
+        SMALL_TRAIN, d, 4, layout="sparse", dtype=jnp.float32,
+        cache=cold_cache)
+    assert info_cold.cache_status == "miss"
+    assert info_cold.bytes_read == os.path.getsize(SMALL_TRAIN)
+
+    warm_cache = SlabCache(str(tmp_path / "c"))   # fresh instance: no
+    # in-process state survives — persistence is the whole point
+    index = build_index(SMALL_TRAIN, d, cache=warm_cache)
+    assert index.scan_bytes == 0 and index.scan_seconds == 0.0
+    ds_warm, info = stream_shard_dataset(
+        SMALL_TRAIN, d, 4, layout="sparse", dtype=jnp.float32,
+        index=index, cache=warm_cache)
+    assert info.cache_status == "hit"
+    assert info.bytes_read == 0 and info.rows == 0
+    assert info.shards_cached == info.shards_total == 4
+    assert info.cache_bytes_mapped > 0
+    assert info.seconds_saved > 0.0   # the cold run recorded its cost
+
+    ctrl = shard_dataset(load_libsvm(SMALL_TRAIN, d), k=4,
+                         layout="sparse", dtype=jnp.float32)
+    _assert_ds_equal(ctrl, ds_cold)
+    _assert_ds_equal(ctrl, ds_warm)
+    # the cached index is bit-identical to a fresh scan
+    fresh = build_index(SMALL_TRAIN, d)
+    np.testing.assert_array_equal(index.row_off, fresh.row_off)
+    np.testing.assert_array_equal(index.row_nnz, fresh.row_nnz)
+    np.testing.assert_array_equal(index.hist, fresh.hist)
+
+
+def test_whole_path_populates_and_warm_loads(tmp_path):
+    """shard_dataset(cache=handle) publishes every shard; the zero-parse
+    whole-path loader (ingest.load_cached_dataset) then rebuilds the
+    identical dataset from the artifacts alone."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import SlabCache, load_libsvm, shard_dataset
+    from cocoa_tpu.data.ingest import load_cached_dataset
+
+    d = DEMO_NUM_FEATURES
+    data = load_libsvm(SMALL_TRAIN, d)
+    cache = SlabCache(str(tmp_path / "c"))
+    handle = cache.for_file(SMALL_TRAIN, d)
+    ctrl = shard_dataset(data, k=4, layout="sparse", dtype=jnp.float32,
+                         cache=handle)
+    handle.store_index(
+        hist=np.bincount(data.indices, minlength=d), n=data.n,
+        total_nnz=int(data.indptr[-1]), max_row_nnz=int(data.max_nnz))
+
+    h2 = SlabCache(str(tmp_path / "c")).for_file(SMALL_TRAIN, d)
+    stats = h2.load_index()
+    assert stats is not None and not stats.has_rows
+    assert stats.n == data.n
+    np.testing.assert_array_equal(
+        stats.hist, np.bincount(data.indices, minlength=d))
+    got = load_cached_dataset(h2, stats, 4, layout="sparse",
+                              dtype=jnp.float32)
+    assert got is not None
+    ds_warm, info = got
+    assert info.cache_status == "hit" and info.bytes_read == 0
+    _assert_ds_equal(ctrl, ds_warm)
+
+
+def test_key_invalidates_on_rewrite_and_inode_change(tmp_path):
+    """The invalidation contract: a content rewrite (size/mtime change)
+    misses; an atomic-rename rewrite with the SAME size and a forged
+    identical mtime_ns still misses, because the inode changed — the
+    coarse-mtime-filesystem aliasing class (the PR-13 checkpoint-validate
+    lesson) cannot serve stale slabs."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import SlabCache, stream_shard_dataset
+
+    path = tmp_path / "mut.svm"
+    path.write_text("1 1:1.0\n-1 2:2.0\n1 3:3.0\n-1 1:4.0\n")
+    root = str(tmp_path / "c")
+    _, info = stream_shard_dataset(str(path), 10, 2, layout="sparse",
+                                   dtype=jnp.float32,
+                                   cache=SlabCache(root))
+    assert info.cache_status == "miss"
+    _, info = stream_shard_dataset(str(path), 10, 2, layout="sparse",
+                                   dtype=jnp.float32,
+                                   cache=SlabCache(root))
+    assert info.cache_status == "hit"
+
+    # content rewrite (different size): must re-parse
+    path.write_text("1 1:9.0 2:9.0\n-1 2:2.0\n1 3:3.0\n-1 1:4.0\n")
+    ds2, info = stream_shard_dataset(str(path), 10, 2, layout="sparse",
+                                     dtype=jnp.float32,
+                                     cache=SlabCache(root))
+    assert info.cache_status == "miss"
+    assert float(np.asarray(ds2.sp_values).max()) == 9.0
+
+    # same-size atomic-rename rewrite with the mtime forged back: the
+    # inode is new, so the key still changes
+    st = os.stat(path)
+    tmp2 = tmp_path / "mut.svm.new"
+    tmp2.write_text("1 1:8.0 2:8.0\n-1 2:2.0\n1 3:3.0\n-1 1:4.0\n")
+    os.replace(tmp2, path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+    st2 = os.stat(path)
+    assert st2.st_size == st.st_size and st2.st_mtime_ns == st.st_mtime_ns
+    ds3, info = stream_shard_dataset(str(path), 10, 2, layout="sparse",
+                                     dtype=jnp.float32,
+                                     cache=SlabCache(root))
+    assert info.cache_status == "miss"
+    assert float(np.asarray(ds3.sp_values).max()) == 8.0
+
+
+def test_torn_artifact_falls_back_cold_with_typed_event(tmp_path):
+    """The truncate-the-newest fault (tests/_faults.py): the torn slab
+    fails load validation, fires ``ingest_cache_corrupt``, is evicted,
+    and the shard re-parses cold — the rebuilt dataset stays
+    bit-identical and the NEXT run is a clean full hit again."""
+    import jax.numpy as jnp
+
+    from _faults import truncate_newest_cache_artifact
+    from cocoa_tpu.data import SlabCache, stream_shard_dataset
+
+    root = str(tmp_path / "c")
+    ds_ref, _ = stream_shard_dataset(SMALL_TRAIN, DEMO_NUM_FEATURES, 4,
+                                     layout="sparse", dtype=jnp.float32,
+                                     cache=SlabCache(root))
+    truncate_newest_cache_artifact(root)([])
+
+    corrupt = []
+    cache = SlabCache(root, on_corrupt=lambda **kw: corrupt.append(kw))
+    ds, info = stream_shard_dataset(SMALL_TRAIN, DEMO_NUM_FEATURES, 4,
+                                    layout="sparse", dtype=jnp.float32,
+                                    cache=cache)
+    assert info.cache_status == "partial"
+    assert info.shards_cached == 3 and info.shards_total == 4
+    assert len(corrupt) == 1
+    assert corrupt[0]["artifact"].startswith("slab-")
+    assert cache.corrupt_total == 1
+    _assert_ds_equal(ds_ref, ds)
+
+    # the evicted artifact was re-published by the fallback parse
+    _, info = stream_shard_dataset(SMALL_TRAIN, DEMO_NUM_FEATURES, 4,
+                                   layout="sparse", dtype=jnp.float32,
+                                   cache=SlabCache(root))
+    assert info.cache_status == "hit"
+
+
+def test_warm_read_across_geometry_change(tmp_path):
+    """The elastic-shrink re-ingest contract: artifacts populated under
+    one geometry (no mesh) serve a DIFFERENT geometry (2-device
+    multiplexed dp mesh, m=2 shards per device) warm — the key is the
+    shard, not the process/mesh layout — with zero bytes parsed and the
+    assembled dataset bit-identical to a fresh build on that mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import SlabCache, stream_shard_dataset
+    from cocoa_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device CPU backend")
+    root = str(tmp_path / "c")
+    stream_shard_dataset(SMALL_TRAIN, DEMO_NUM_FEATURES, 4,
+                         layout="sparse", dtype=jnp.float32,
+                         cache=SlabCache(root))
+
+    mesh = make_mesh(2)
+    ds_warm, info = stream_shard_dataset(
+        SMALL_TRAIN, DEMO_NUM_FEATURES, 4, layout="sparse",
+        dtype=jnp.float32, mesh=mesh, cache=SlabCache(root))
+    assert info.cache_status == "hit" and info.bytes_read == 0
+    ds_fresh, _ = stream_shard_dataset(
+        SMALL_TRAIN, DEMO_NUM_FEATURES, 4, layout="sparse",
+        dtype=jnp.float32, mesh=mesh)
+    _assert_ds_equal(ds_fresh, ds_warm)
+
+
+def test_cached_hybrid_matches_fresh_auto_resolution(tmp_path):
+    """``--hotCols=auto`` resolved from the CACHED histogram equals the
+    fresh whole-file resolution, the cached residual width equals the
+    measured one, and the warm hybrid dataset (panel + residual + eval
+    twin) is bit-identical to the fresh build."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import SlabCache, load_libsvm, shard_dataset
+    from cocoa_tpu.data import hybrid as hybrid_lib
+    from cocoa_tpu.data import stream_shard_dataset
+
+    d = DEMO_NUM_FEATURES
+    data = load_libsvm(SMALL_TRAIN, d)
+    k, dtype = 2, jnp.float32
+    hot_fresh, _ = hybrid_lib.resolve_hot_cols("auto", data, k, dtype)
+
+    root = str(tmp_path / "c")
+    ds_cold, icold = stream_shard_dataset(
+        SMALL_TRAIN, d, k, layout="sparse", dtype=dtype,
+        hot_cols=hot_fresh, eval_dense=True, cache=SlabCache(root))
+
+    cache = SlabCache(root)
+    handle = cache.for_file(SMALL_TRAIN, d)
+    stats = handle.load_index()
+    hot_cached = hybrid_lib.resolve_hot_width("auto", stats.hist,
+                                              stats.n, k, dtype)
+    assert hot_cached == hot_fresh
+    assert handle.load_hybrid_meta(hot_fresh) == icold.residual_max_nnz
+
+    ds_warm, info = stream_shard_dataset(
+        SMALL_TRAIN, d, k, layout="sparse", dtype=dtype,
+        hot_cols=hot_cached, eval_dense=True, cache=cache)
+    assert info.cache_status == "hit" and info.bytes_read == 0
+    assert info.residual_max_nnz == icold.residual_max_nnz
+    ctrl = shard_dataset(data, k=k, layout="sparse", dtype=dtype,
+                         hot_cols=hot_fresh, eval_dense=True)
+    _assert_ds_equal(ctrl, ds_warm)
+
+
+def test_warm_trajectory_bit_identical(tmp_path):
+    """The downstream pin: training on warm-loaded slabs produces the
+    bit-identical (w, α, gap) trajectory to the uncached control."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.config import DebugParams, Params
+    from cocoa_tpu.data import (SlabCache, load_libsvm, shard_dataset,
+                                stream_shard_dataset)
+    from cocoa_tpu.solvers import run_cocoa
+
+    d = DEMO_NUM_FEATURES
+    root = str(tmp_path / "c")
+    stream_shard_dataset(SMALL_TRAIN, d, 4, layout="sparse",
+                         dtype=jnp.float32, cache=SlabCache(root))
+    ds_warm, info = stream_shard_dataset(
+        SMALL_TRAIN, d, 4, layout="sparse", dtype=jnp.float32,
+        cache=SlabCache(root))
+    assert info.cache_status == "hit"
+    ds_ctrl = shard_dataset(load_libsvm(SMALL_TRAIN, d), k=4,
+                            layout="sparse", dtype=jnp.float32)
+
+    params = Params(n=ds_ctrl.n, num_rounds=5, local_iters=10, lam=0.01)
+
+    def train(ds):
+        w, alpha, traj = run_cocoa(ds, params,
+                                   DebugParams(debug_iter=1, seed=0),
+                                   plus=True, quiet=True)
+        return (np.asarray(w), np.asarray(alpha),
+                np.asarray([r.gap for r in traj.records]))
+
+    for got, want, name in zip(train(ds_warm), train(ds_ctrl),
+                               ("w", "alpha", "gaps")):
+        np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_parallel_cold_parse_bit_identical(monkeypatch):
+    """The pass-2 thread-pool fan-out cannot perturb a byte: a forced
+    multi-worker parse builds the identical dataset (assembly is keyed
+    by shard id; with the pure-Python parser the pool degrades to the
+    sequential loop, which passes trivially)."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import ingest as ingest_lib
+    from cocoa_tpu.data import load_libsvm, shard_dataset
+    from cocoa_tpu.data import stream_shard_dataset
+
+    d = DEMO_NUM_FEATURES
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    workers = ingest_lib._pass2_workers(8)
+    ds, info = stream_shard_dataset(SMALL_TRAIN, d, 8, layout="sparse",
+                                    dtype=jnp.float32)
+    assert info.bytes_read == os.path.getsize(SMALL_TRAIN)
+    ctrl = shard_dataset(load_libsvm(SMALL_TRAIN, d), k=8,
+                         layout="sparse", dtype=jnp.float32)
+    _assert_ds_equal(ctrl, ds)
+    from cocoa_tpu.data import native_loader
+
+    if native_loader.available():
+        assert workers == 4  # the fan-out actually engaged above
+
+
+def test_publish_failure_degrades_to_uncached(tmp_path, monkeypatch):
+    """A cache volume that cannot be written (ENOSPC, lost permission)
+    must never kill a run whose data is already parsed: every store
+    degrades to uncached operation with one warning, the build completes
+    bit-identically, and no temp debris is left behind."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import (SlabCache, load_libsvm, shard_dataset,
+                                stream_shard_dataset)
+    from cocoa_tpu.data import slab_cache as slab_cache_mod
+
+    def boom(*a, **kw):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(slab_cache_mod.np, "save", boom)
+    cache = SlabCache(str(tmp_path / "c"))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        ds, info = stream_shard_dataset(
+            SMALL_TRAIN, DEMO_NUM_FEATURES, 4, layout="sparse",
+            dtype=jnp.float32, cache=cache)
+    assert info.cache_status == "miss"
+    assert cache.store_failures > 0
+    assert any("continuing uncached" in str(w.message) for w in caught)
+    ctrl = shard_dataset(load_libsvm(SMALL_TRAIN, DEMO_NUM_FEATURES),
+                         k=4, layout="sparse", dtype=jnp.float32)
+    _assert_ds_equal(ctrl, ds)
+    assert not any(".tmp." in e for e in os.listdir(tmp_path / "c"))
+
+
+def test_store_rejects_field_drift(tmp_path):
+    """A slab whose field set disagrees with the view's key is a
+    LAYOUT_VERSION bug — store must refuse it loudly, never publish a
+    mismatched artifact."""
+    from cocoa_tpu.data import SlabCache
+
+    cache = SlabCache(str(tmp_path / "c"))
+    handle = cache.for_file(SMALL_TRAIN, DEMO_NUM_FEATURES)
+    view = handle.view(layout="sparse", k=2, n_shard=16, width=4,
+                       n_hot=0, d=DEMO_NUM_FEATURES, dtype=np.float32,
+                       eval_dense=False)
+    with pytest.raises(ValueError, match="LAYOUT_VERSION"):
+        view.store(0, {"labels": np.zeros(16)})
+
+
+# --- real-process pins (slow: subprocess jax imports) -----------------------
+
+_RACE_WORKER = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from cocoa_tpu.data import SlabCache, stream_shard_dataset
+path, cache_dir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+ds, info = stream_shard_dataset(path, 9947, 4, layout="sparse",
+                                dtype=jnp.float32,
+                                cache=SlabCache(cache_dir))
+np.savez(out, status=np.array([info.cache_status]),
+         **{f: np.asarray(v) for f, v in ds.shard_arrays().items()})
+print("RACE_WORKER_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_build_race_one_winner(tmp_path):
+    """Two processes cold-build the same shard artifacts concurrently:
+    both succeed, both datasets are bit-identical to the control, the
+    cache holds exactly one valid artifact per shard (atomic rename —
+    the loser read or discarded, never clobbered), no temp debris, and
+    a third run is a clean full hit."""
+    import jax.numpy as jnp
+
+    from cocoa_tpu.data import (SlabCache, load_libsvm, shard_dataset,
+                                stream_shard_dataset)
+
+    cache_dir = str(tmp_path / "c")
+    worker = tmp_path / "race_worker.py"
+    worker.write_text(_RACE_WORKER)
+    env = {**os.environ, "PYTHONPATH": f"{ROOT}{os.pathsep}{TESTS}",
+           "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), SMALL_TRAIN, cache_dir,
+             str(tmp_path / f"out{i}.npz")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            cwd=ROOT, text=True)
+        for i in range(2)
+    ]
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, f"race worker failed:\n{out[-3000:]}"
+            assert "RACE_WORKER_DONE" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    ctrl = shard_dataset(load_libsvm(SMALL_TRAIN, DEMO_NUM_FEATURES),
+                         k=4, layout="sparse", dtype=jnp.float32)
+    arrs_ctrl = {f: np.asarray(v)
+                 for f, v in ctrl.shard_arrays().items()}
+    for i in range(2):
+        got = dict(np.load(tmp_path / f"out{i}.npz"))
+        got.pop("status")
+        assert got.keys() == arrs_ctrl.keys()
+        for f in arrs_ctrl:
+            np.testing.assert_array_equal(got[f], arrs_ctrl[f],
+                                          err_msg=f"worker{i}: {f}")
+    # no leftover temp dirs, one artifact per shard
+    entries = os.listdir(cache_dir)
+    assert not any(".tmp." in e for e in entries), entries
+    assert sum(e.startswith("slab-") for e in entries) == 4
+    _, info = stream_shard_dataset(SMALL_TRAIN, DEMO_NUM_FEATURES, 4,
+                                   layout="sparse", dtype=jnp.float32,
+                                   cache=SlabCache(cache_dir))
+    assert info.cache_status == "hit" and info.bytes_read == 0
+
+
+@pytest.mark.slow
+def test_elastic_restart_reingests_warm_zero_bytes(tmp_path,
+                                                   monkeypatch):
+    """The elastic re-ingest pin (runs on ANY jax — single-worker gang):
+    a supervised CLI training run with --ingestCache loses its worker to
+    a SIGKILL mid-run; the relaunched generation re-ingests entirely
+    from the cache — its ingest event reports cache=hit with ZERO bytes
+    read — and the run completes its full round budget."""
+    from _faults import Fault, FaultPlan, checkpoint_at_least, sigkill
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu import elastic
+    from cocoa_tpu.telemetry import events as tele_events
+    from cocoa_tpu.telemetry import schema as tele_schema
+
+    # the spawned worker must not inherit the virtual multi-device
+    # backend (this container's jax has no shard_map for the mesh path)
+    monkeypatch.setenv("XLA_FLAGS", " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        f"{ROOT}{os.pathsep}{os.environ.get('PYTHONPATH', '')}")
+
+    ck = tmp_path / "ck"
+    ev = tmp_path / "events.jsonl"
+    cache_dir = tmp_path / "icache"
+    argv = [
+        f"--trainFile={SMALL_TRAIN}", f"--numFeatures={DEMO_NUM_FEATURES}",
+        "--numSplits=4", "--numRounds=40", "--debugIter=10",
+        "--localIterFrac=0.05", "--lambda=0.001", "--justCoCoA=true",
+        f"--chkptDir={ck}", "--chkptIter=10", "--quiet",
+        f"--ingestCache={cache_dir}", f"--events={ev}",
+    ]
+    bus = tele_events.get_bus()
+    bus.configure(jsonl_path=str(ev))
+    try:
+        plan = FaultPlan(
+            Fault(generation=0, actions=(sigkill(0),),
+                  trigger=checkpoint_at_least(ck, "CoCoA+", 10),
+                  name="kill-worker"),
+        )
+        rc = elastic.supervise(argv, 1, max_restarts=3, poll_s=0.05,
+                               backoff_base_s=0.0,
+                               on_generation=plan.on_generation)
+        plan.join()
+        assert rc == 0
+        assert plan.errors == []
+        assert plan.fired == ["kill-worker"]
+    finally:
+        bus.reset()
+
+    meta, _, _ = ckpt_lib.load(ckpt_lib.latest(str(ck), "CoCoA+"))
+    assert meta["round"] == 40
+    assert tele_schema.check_file(str(ev)) == []
+    recs = [json.loads(ln) for ln in ev.read_text().splitlines()]
+    ingests = [r for r in recs if r["event"] == "ingest"]
+    assert len(ingests) >= 2   # one per generation
+    first, last = ingests[0], ingests[-1]
+    assert first["cache"] == "miss" and first["bytes_read"] > 0
+    # the relaunched generation re-ingested with ZERO re-parsed bytes
+    assert last["cache"] == "hit"
+    assert last["bytes_read"] == 0 and last["rows"] == 0
+    caches = [r for r in recs if r["event"] == "ingest_cache"]
+    assert caches[-1]["status"] == "hit"
+    assert caches[-1]["shards_cached"] == caches[-1]["shards_total"] == 4
