@@ -146,6 +146,27 @@ GATES = (
         "expected_compiles": 2,
         "flags": ["--duration=3", "--threads=4"],
     },
+    # The low-precision serving row (ISSUE 16, docs/DESIGN.md §20): the
+    # packed-bf16 compiled scoring path vs the SAME-harness f32 control
+    # at the L2-straddle geometry (benchmarks/serve_bench.py
+    # --serveDtype=bf16).  The committed row must hold the acceptance
+    # bar (qps_ratio >= 1.7, zero sign flips beyond 2x the certified
+    # bound); the fresh CI re-run — interleaved-pass wall-clock on a
+    # shared runner — is gated at a catastrophic floor plus the
+    # environment-robust axes: zero flips, the quantized form actually
+    # served ("stopped" == "target" requires swap >= 1 + no certificate
+    # fallback), and exactly one compile per (bucket, dtype) per scorer
+    # (3 = control f32 + packed bf16 + the f32 fallback form).
+    {
+        "config": "serve-cpu-synth-bf16",
+        "runner": "serve",
+        "kind": "serve_quant",
+        "min_qps_ratio": 1.7,
+        "fresh_ratio_floor": 1.3,
+        "expected_compiles": 3,
+        "flags": ["--serveDtype=bf16", "--duration=3",
+                  "--ratio-bar=1.3"],
+    },
     # The warm-ingest row (ISSUE 15, docs/DESIGN.md §18): --ingestCache
     # serves device-ready shard slabs from memmap-able artifacts with
     # ZERO parse.  The gate re-measures the full rcv1-synth warm-vs-
@@ -181,10 +202,12 @@ def committed_baselines(path: str = RESULTS) -> dict:
             row = json.loads(line)
             # perf-accounting rows share the config name but carry no
             # round count — only rows with an anchoring metric (rounds,
-            # or warm_speedup for the ingest gate) can anchor the gate,
+            # warm_speedup for the ingest gate, or qps_ratio for the
+            # low-precision serving gate) can anchor the gate,
             # regardless of row order in the file
             if isinstance(row, dict) and "config" in row \
-                    and ("rounds" in row or "warm_speedup" in row):
+                    and ("rounds" in row or "warm_speedup" in row
+                         or "qps_ratio" in row):
                 # first qualifying row per config wins (the file appends
                 # refreshed rows last in regen; the gate keys on the
                 # curated head)
@@ -437,6 +460,63 @@ def serve_failures(gate: dict, fresh: dict, committed: dict) -> list:
     return failures
 
 
+def serve_quant_failures(gate: dict, fresh: dict,
+                         committed: dict) -> list:
+    """The low-precision serving bounds.  The COMMITTED row carries the
+    acceptance bar (qps_ratio >= min_qps_ratio at zero flips — it was
+    produced by serve_bench's own 1.7 self-gate); the fresh re-run is
+    held to the environment-robust axes hard (flips, compile count,
+    quantized-form-served) and to a catastrophic ratio floor only,
+    because absolute wall-clock on a shared CI runner is noise the
+    cache-footprint mechanism itself is not."""
+    cfg = gate["config"]
+    if "error" in fresh:
+        return [f"{cfg}: fresh run failed — {fresh['error']}"]
+    failures = []
+    base = committed.get(cfg)
+    if base is None:
+        failures.append(f"{cfg}: no committed baseline row in "
+                        f"benchmarks/results.jsonl")
+    else:
+        if (base.get("qps_ratio") or 0) < gate["min_qps_ratio"]:
+            failures.append(
+                f"{cfg}: COMMITTED ROW BELOW BAR — qps_ratio "
+                f"{base.get('qps_ratio')} < {gate['min_qps_ratio']:g}; "
+                f"regen the row (serve_bench --serveDtype) on a quiet "
+                f"machine, never commit one under the bar")
+        if base.get("flips") != 0:
+            failures.append(
+                f"{cfg}: COMMITTED ROW CARRIES {base.get('flips')} sign "
+                f"flips beyond 2x the certified bound — the certificate "
+                f"understated the quantization error")
+    if fresh.get("stopped") != "target":
+        failures.append(
+            f"{cfg}: fresh run did not serve the quantized form to "
+            f"target (stopped={fresh.get('stopped')!r}: needs >= 1 "
+            f"hot-swap, zero flips, and no certificate fallback)")
+    if fresh.get("flips") != 0:
+        failures.append(
+            f"{cfg}: SIGN FLIPS — {fresh.get('flips')} of "
+            f"{fresh.get('flip_checked')} audited margins flipped at "
+            f"|m32| > 2x the certified bound "
+            f"{fresh.get('margin_err_bound')}")
+    if fresh.get("compiles") != gate["expected_compiles"]:
+        failures.append(
+            f"{cfg}: COMPILE LEAK — {fresh.get('compiles')} scoring "
+            f"compiles, expected {gate['expected_compiles']} (control "
+            f"f32 + packed form + the f32 certificate-fallback form); "
+            f"a quantized swap must never compile mid-flight")
+    if (fresh.get("qps_ratio") or 0) < gate["fresh_ratio_floor"]:
+        failures.append(
+            f"{cfg}: RATIO COLLAPSE — fresh qps_ratio "
+            f"{fresh.get('qps_ratio')} under the "
+            f"{gate['fresh_ratio_floor']:g} catastrophic floor "
+            f"(committed {base.get('qps_ratio') if base else '?'}); "
+            f"the packed path lost its cache-footprint mechanism, not "
+            f"just runner speed")
+    return failures
+
+
 def gang_ratio_failures(rows: list) -> list:
     """The cross-config staleness bound: overlap+stale rounds <=
     STALE_ROUNDS_RATIO x sync rounds (evaluated only when both gang
@@ -519,6 +599,13 @@ def main(argv=None) -> int:
                 rows.append({**fresh, "type": "bench-regression-fresh"})
                 failures += ingest_failures(gate, fresh, committed)
                 continue
+            if gate.get("kind") == "serve_quant":
+                # quant rows anchor on qps_ratio, not rounds — the
+                # generic convergence evaluate() does not apply
+                fresh = {**row, "config": gate["config"]}
+                rows.append({**fresh, "type": "bench-regression-fresh"})
+                failures += serve_quant_failures(gate, fresh, committed)
+                continue
             fresh = {**row,
                      "config": gate["config"],
                      "rounds": int(row["rounds"]),
@@ -538,10 +625,12 @@ def main(argv=None) -> int:
     else:
         workdir = tempfile.mkdtemp(prefix="bench-regress-")
         for gate in gates:
+            base = committed.get(gate["config"], {})
+            anchor = (f"qps_ratio {base.get('qps_ratio')}"
+                      if "qps_ratio" in base
+                      else f"{base.get('rounds')} rounds")
             print(f"check_regression: running {gate['config']} "
-                  f"(committed baseline "
-                  f"{committed.get(gate['config'], {}).get('rounds')} "
-                  f"rounds)", flush=True)
+                  f"(committed baseline {anchor})", flush=True)
             runner = {"gang": run_fresh_gang,
                       "fleet": run_fresh_fleet,
                       "serve": run_fresh_serve,
@@ -551,6 +640,9 @@ def main(argv=None) -> int:
             rows.append(fresh)
             if gate.get("kind") == "ingest":
                 failures += ingest_failures(gate, fresh, committed)
+                continue
+            if gate.get("kind") == "serve_quant":
+                failures += serve_quant_failures(gate, fresh, committed)
                 continue
             failures += evaluate(gate, fresh, committed)
             if gate.get("kind") == "serve" and "error" not in fresh:
@@ -568,7 +660,14 @@ def main(argv=None) -> int:
             failures.append(f"report schema violations: {errs[:5]}")
 
     for row in rows:
-        if "error" not in row:
+        if "error" in row:
+            continue
+        if "qps_ratio" in row:
+            print(f"check_regression: {row['config']}: "
+                  f"qps_ratio {row.get('qps_ratio')}, "
+                  f"flips {row.get('flips')}/{row.get('flip_checked')}, "
+                  f"stopped={row.get('stopped')}", flush=True)
+        else:
             print(f"check_regression: {row['config']}: "
                   f"{row.get('rounds')} rounds, gap {row.get('gap')}, "
                   f"stopped={row.get('stopped')}", flush=True)

@@ -66,8 +66,12 @@ Rules (ids are what ``jaxlint: allow=<rule>`` and the baseline key on):
   path is an error (request-dependent shapes compile one executable per
   batch size; pad UP to a static bucket), and inside the compiled
   scoring functions a host clock read or ``.block_until_ready()`` is an
-  error (it times/syncs the trace, not the request).  Rides the
-  host-sync rule's traced-context machinery.
+  error (it times/syncs the trace, not the request).  Quantization
+  belongs at swap time on the host (serving/quantize.py, DESIGN.md
+  §20): a narrowing ``.astype(...)`` (bf16/f16/int8/…) or a
+  max-of-abs scale compute inside a traced scoring def is an error —
+  the compiled path serves a published form, it never re-derives one.
+  Rides the host-sync rule's traced-context machinery.
 """
 
 from __future__ import annotations
@@ -1095,6 +1099,43 @@ _SERVE_CLOCK_CHAINS = {"time.time", "time.monotonic",
                        "time.perf_counter", "time.perf_counter_ns",
                        "time.monotonic_ns"}
 
+# dtypes whose appearance as an `.astype(...)` target inside a TRACED
+# scoring def marks in-graph quantization.  All narrowing happens on
+# the host at swap time (serving/quantize.py) where the error
+# certificate can see it; the compiled path only ever consumes the
+# published form.  Widening casts (float32/int32/uint32/…) and
+# bitcast_convert_type (the packed-bf16 reinterpretation) stay legal.
+_SERVE_NARROW_DTYPES = {"bfloat16", "float16", "int8", "uint8",
+                        "int16", "uint16", "int4", "uint4",
+                        "float8_e4m3fn", "float8_e5m2"}
+
+# max/amax spellings that, applied over an abs(), form the symmetric
+# quantization scale (max|w|) — the other half of an in-graph quantize
+_SERVE_SCALE_REDUCERS = {"max", "amax"}
+
+
+def _narrow_dtype_name(expr: ast.AST) -> Optional[str]:
+    """The narrow dtype an ``.astype(...)`` argument names, else None.
+    Recognizes attribute spellings (``jnp.bfloat16``,
+    ``ml_dtypes.bfloat16``, ``np.int8``) and string literals."""
+    chain = _attr_chain(expr)
+    if chain:
+        tail = chain.rsplit(".", 1)[-1]
+        if tail in _SERVE_NARROW_DTYPES:
+            return tail
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+            and expr.value in _SERVE_NARROW_DTYPES:
+        return expr.value
+    return None
+
+
+def _contains_abs_call(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and \
+                _callee_tail(sub) in ("abs", "absolute"):
+            return True
+    return False
+
 
 def _contains_len_call(expr: ast.AST) -> bool:
     for sub in ast.walk(expr):
@@ -1124,6 +1165,14 @@ def check_serve_hygiene(src: SourceFile, index: ModuleIndex) -> list:
        TRACE, once per compile, not the request; latency accounting
        belongs at the host boundary (the batcher's spans).  Rides the
        host-sync rule's traced-context machinery.
+    4. inside TRACED defs: a narrowing ``.astype(...)`` (bf16 / f16 /
+       int8 / …) or a max-of-abs scale compute is an error — in-graph
+       quantization bypasses the per-swap error certificate and burns
+       the cast into every dispatch.  Quantize ONCE on the host at
+       swap time (serving/quantize.quantize, DESIGN.md §20); the
+       compiled scorer consumes the published form.  Widening casts
+       (``astype(jnp.float32)`` on a dequantized gather) and
+       ``lax.bitcast_convert_type`` (the packed-bf16 view) stay legal.
     """
     if not _SERVING_PATH_RE.search(src.path.replace(os.sep, "/")):
         return []
@@ -1185,6 +1234,37 @@ def check_serve_hygiene(src: SourceFile, index: ModuleIndex) -> list:
                              "per call — fetch once on the host after "
                              "the dispatch (the batcher's single "
                              "intended_fetch)")
+                    elif isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "astype" and node.args \
+                            and _narrow_dtype_name(node.args[0]):
+                        flag(node,
+                             f"narrowing `.astype("
+                             f"{_narrow_dtype_name(node.args[0])})` "
+                             f"inside the compiled scoring path — "
+                             f"in-graph quantization bypasses the "
+                             f"per-swap error certificate and re-casts "
+                             f"on every dispatch; quantize ONCE on the "
+                             f"host at swap time "
+                             f"(serving/quantize.quantize) and publish "
+                             f"the narrow form")
+                    elif (node.func.attr if isinstance(
+                            node.func, ast.Attribute) else
+                            _callee_tail(node)) in \
+                            _SERVE_SCALE_REDUCERS \
+                            and (any(_contains_abs_call(a)
+                                     for a in node.args)
+                                 or (isinstance(node.func,
+                                                ast.Attribute)
+                                     and _contains_abs_call(
+                                         node.func.value))):
+                        flag(node,
+                             "max-of-abs inside the compiled scoring "
+                             "path — this is the symmetric "
+                             "quantization scale (max|w|) being "
+                             "derived in-graph, per dispatch; the "
+                             "scale is computed once on the host at "
+                             "swap time (serving/quantize.quantize) "
+                             "and published alongside the model")
     return findings
 
 

@@ -185,7 +185,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "metricsInterval", "overlapComm",
                 "staleRounds", "fleet", "fleetLanes",
                 "serve", "serveBatch", "serveSlaMs",
-                "serveMaxNnz")  # run-level
+                "serveMaxNnz", "serveDtype")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -485,7 +485,8 @@ def main(argv=None) -> int:
     for dep, what in (("serveBatch", "sets the static batch buckets"),
                       ("serveSlaMs", "sets the p99 latency budget"),
                       ("serveMaxNnz", "sets the per-query nonzero "
-                                      "budget")):
+                                      "budget"),
+                      ("serveDtype", "sets the serving precision")):
         if extras[dep] and not serve_flag:
             print(f"error: --{dep} {what} of the serving loop and needs "
                   f"--serve", file=sys.stderr)
@@ -517,14 +518,18 @@ def main(argv=None) -> int:
                            "command line (the serve-side --trainFile "
                            "parse only derives the query nonzero "
                            "budget)",
+            "dtype": "--dtype is the TRAINING precision; the serving "
+                     "stack quantizes the model at swap time — set "
+                     "--serveDtype=f32|bf16|int8 instead "
+                     "(docs/DESIGN.md §20)",
         }
         allowed = {
             # the documented serve surface (README flag table): the
             # serve flags, the model source, the query-side layout, and
             # the observability flags every mode shares
             "serve", "serveBatch", "serveSlaMs", "serveMaxNnz",
-            "chkptDir",
-            "numFeatures", "trainFile", "hotCols", "dtype", "quiet",
+            "serveDtype", "chkptDir",
+            "numFeatures", "trainFile", "hotCols", "quiet",
             "metrics", "events", "trace", "flightRecorder",
             "eventsMaxMB", "metricsInterval", "seed",
         }
@@ -2026,6 +2031,17 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
             print(f"error: --serveSlaMs takes a positive latency budget "
                   f"in ms, got {extras['serveSlaMs']!r}", file=sys.stderr)
             return 2
+    # --serveDtype: the serving precision (docs/DESIGN.md §20) — the
+    # model is quantized ONCE per swap with a margin-error certificate;
+    # queries and the compiled reduction stay f32
+    serve_dtype = "f32"
+    if extras["serveDtype"]:
+        try:
+            serve_dtype = serving.resolve_serve_dtype(
+                extras["serveDtype"])
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     d = cfg.num_features
     dtype = jnp.dtype(cfg.dtype)
@@ -2101,23 +2117,43 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
             "algorithm": algorithm, "buckets": list(buckets),
             "sla_ms": sla_ms, "max_nnz": max_nnz, "num_features": d,
             "hot_cols": 0 if hot_ids is None else int(len(hot_ids)),
+            "serve_dtype": serve_dtype,
         }
         bus.emit("run_start", manifest=manifest)
 
-    slots = serving.ModelSlots(w, info, dtype=dtype)
-    scorer = serving.BatchScorer(d, dtype=dtype, buckets=buckets,
-                                 max_nnz=max_nnz, hot_ids=hot_ids)
+    # the calibration ring the per-swap certificate is computed over:
+    # warmup-seeded now, refilled by real traffic as it arrives
+    calib = (serving.CalibrationBuffer(d, max_nnz=max_nnz,
+                                       seed=cfg.seed)
+             if serve_dtype != "f32" else None)
+    slots = serving.ModelSlots(w, info, dtype=serve_dtype,
+                               calibration=calib, algorithm=algorithm)
+    scorer = serving.BatchScorer(d, dtype=serve_dtype, buckets=buckets,
+                                 max_nnz=max_nnz, hot_ids=hot_ids,
+                                 model_width=int(w.shape[0]))
     serving.watcher.emit_model_swap(algorithm, info)   # the initial load
     with tracing.span("serve_warmup", buckets=len(buckets)):
-        scorer.warmup(slots.current()[0])
+        w_dev, scale, _ = slots.current()
+        n_exec = scorer.warmup(w_dev, scale)
     if not quiet:
         print(f"serve: model {algorithm} r{info.round} "
               f"(gap={info.gap if info.gap is not None else 'n/a'}) — "
-              f"{len(buckets)} bucket executables compiled, swaps are "
+              f"{n_exec} bucket executables compiled, swaps are "
               f"compile-free from here")
+        if serve_dtype != "f32":
+            print(f"serve: quantized to {slots.served_dtype} at load "
+                  f"(serveDtype={serve_dtype}, margin error bound "
+                  f"{slots.last_bound:.3g} over the warmup calibration "
+                  f"batch)" if slots.served_dtype != "f32" else
+                  f"serve: certificate fallback at load — the "
+                  f"{serve_dtype} margin error bound "
+                  f"{slots.last_bound:.3g} could flip a calibrated "
+                  f"sign; serving f32 until a generation certifies",
+                  flush=True)
 
     batcher = serving.MicroBatcher(scorer, slots, sla_s=sla_ms / 1000.0,
-                                   algorithm=algorithm)
+                                   algorithm=algorithm,
+                                   calibration=calib)
 
     def note_swap(inf):
         if not quiet:
@@ -2133,7 +2169,8 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
     # not chatter — it prints even under --quiet
     print(f"serve: listening on {host}:{bound} "
           f"(buckets={','.join(str(b) for b in buckets)}, "
-          f"slaMs={sla_ms:g}, maxNnz={max_nnz})", flush=True)
+          f"slaMs={sla_ms:g}, maxNnz={max_nnz}, dtype={serve_dtype})",
+          flush=True)
 
     # gap-age heartbeat: the freshness gauge renders `now - birth` at
     # WRITE time, and writes are otherwise event-driven — a dead trainer

@@ -98,6 +98,65 @@ def shard_margins(w: jax.Array, shard: dict) -> jax.Array:
     return m
 
 
+def gather_dequant(w: jax.Array, idx: jax.Array) -> jax.Array:
+    """``w[idx]`` that understands the packed low-precision serving
+    forms (serving/quantize.py): the model's DEVICE dtype is the
+    trace-time dispatch key, so one jitted scoring function specializes
+    per (bucket, dtype) and a hot-swap between forms never retraces.
+
+    - f32 (the training dtype): a plain gather — BIT-IDENTICAL to the
+      pre-quantization path, which is what makes the certificate
+      fallback a normal slot publish.
+    - uint32 = two packed bf16 lanes per word: gather word ``i>>1``,
+      shift lane ``i&1`` down, widen by bit-shift + bitcast (bf16->f32
+      is exact).  The gather rides the hardware 4-byte path at HALF the
+      f32 cache/HBM footprint — XLA would EMULATE a narrow bf16 gather,
+      so the packing, not the arithmetic, is the throughput mechanism.
+    - int32 = four packed int8 lanes per word: gather word ``i>>2``,
+      shift lane ``i&3`` down, sign-extend exactly; the caller applies
+      the per-model symmetric scale ONCE on the reduced margins.
+
+    Padded query slots (index 0, value 0) dequantize whatever lane 0
+    holds and multiply by 0 — the padding convention is unchanged.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if w.dtype == jnp.uint32:
+        word = w[idx >> 1]
+        lane = (word >> ((idx & 1).astype(jnp.uint32) << 4)) \
+            & jnp.uint32(0xFFFF)
+        return lax.bitcast_convert_type(lane << 16, jnp.float32)
+    if w.dtype == jnp.int32:
+        word = w[idx >> 2]
+        lane = (word >> ((idx & 3) << 3)) & jnp.int32(0xFF)
+        lane = lane - ((lane & jnp.int32(0x80)) << 1)
+        return lane.astype(jnp.float32)
+    return w[idx]
+
+
+def serve_margins(w: jax.Array, shard: dict, scale=None) -> jax.Array:
+    """Dtype-generic serving twin of :func:`shard_margins`: the same
+    panel+residual split, but every model read goes through
+    :func:`gather_dequant` so packed bf16/int8 models ride the same
+    dispatch.  With an f32 model and ``scale=None`` this traces to
+    EXACTLY the :func:`shard_margins` sparse/hybrid graph (the
+    serving bit-identity pin in tests/test_serving.py).
+
+    ``scale`` is the int8 per-model symmetric scale as a TRACED scalar
+    (a new scale per swap never retraces); it multiplies the reduced
+    margins once — the hot panel term gathers the same quantized model,
+    so panel + residual share the one scale.
+    """
+    m = (gather_dequant(w, shard["sp_indices"])
+         * shard["sp_values"]).sum(-1)
+    if "X_hot" in shard:
+        m = m + shard["X_hot"] @ gather_dequant(w, shard["hot_cols"])
+    if scale is not None:
+        m = m * scale
+    return m
+
+
 def shards_axpy(coefs: jax.Array, shards: dict, vec: jax.Array) -> jax.Array:
     """vec + Σ_{k,i} coefs[k,i] · x_{k,i} over EVERY row of the stacked
     (K, …) shard arrays — the transpose counterpart of

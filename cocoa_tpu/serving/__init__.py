@@ -13,9 +13,13 @@ bench:
 - batcher.py  — MicroBatcher (admission under the SLA, bucket choice)
 - watcher.py  — SwapWatcher / wait_for_model (checkpoint → slot)
 - server.py   — MarginServer (the TCP line protocol)
+- quantize.py — swap-time bf16/int8 packing + the per-swap margin-error
+                certificate (``--serveDtype``, docs/DESIGN.md §20)
 """
 
 from cocoa_tpu.serving.batcher import MicroBatcher, PendingQuery
+from cocoa_tpu.serving.quantize import (SERVE_DTYPES, CalibrationBuffer,
+                                        resolve_serve_dtype)
 from cocoa_tpu.serving.scorer import (DEFAULT_BUCKETS, DEFAULT_MAX_NNZ,
                                       BatchScorer, ModelInfo, ModelSlots,
                                       QueryError, parse_query,
@@ -28,5 +32,6 @@ __all__ = [
     "DEFAULT_BUCKETS", "DEFAULT_MAX_NNZ", "BatchScorer", "ModelInfo",
     "ModelSlots", "QueryError", "parse_query", "pick_bucket",
     "MicroBatcher", "PendingQuery", "MarginServer", "SwapWatcher",
-    "load_model", "wait_for_model",
+    "load_model", "wait_for_model", "SERVE_DTYPES", "CalibrationBuffer",
+    "resolve_serve_dtype",
 ]

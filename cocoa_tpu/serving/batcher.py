@@ -53,7 +53,7 @@ class PendingQuery:
     """One in-flight request: parsed arrays in, margin (or error) out."""
 
     __slots__ = ("idx", "val", "t_enq", "done", "margin", "error",
-                 "model_round")
+                 "model_round", "served_dtype")
 
     def __init__(self, idx, val):
         self.idx = idx
@@ -63,6 +63,7 @@ class PendingQuery:
         self.margin = None
         self.error = None
         self.model_round = None
+        self.served_dtype = None
 
     def result(self, timeout: Optional[float] = None) -> float:
         if not self.done.wait(timeout):
@@ -77,11 +78,22 @@ class MicroBatcher:
     buckets and dispatches them through the compiled scorer."""
 
     def __init__(self, scorer, slots, sla_s: float = 0.05,
-                 algorithm: str = "serve"):
+                 algorithm: str = "serve", calibration=None):
+        slots_sd = getattr(slots, "serve_dtype", "f32")
+        scorer_sd = getattr(scorer, "serve_dtype", "f32")
+        if slots_sd != scorer_sd:
+            raise ValueError(
+                f"serve dtype mismatch: ModelSlots publishes "
+                f"{slots_sd} model forms but BatchScorer compiled for "
+                f"{scorer_sd} — construct both with the same dtype= "
+                f"(the CLI wires --serveDtype={slots_sd!s} into both)")
         self.scorer = scorer
         self.slots = slots
         self.sla_s = float(sla_s)
         self.algorithm = algorithm
+        # ring of recent real queries the per-swap quantization
+        # certificate is computed over (serving/quantize.py)
+        self._calibration = calibration
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._device_est = {b: 0.0 for b in scorer.buckets}
@@ -96,6 +108,8 @@ class MicroBatcher:
 
     def submit(self, idx, val) -> PendingQuery:
         """Enqueue one parsed query; returns its pending handle."""
+        if self._calibration is not None:
+            self._calibration.record(idx, val)
         pend = PendingQuery(idx, val)
         self._q.put(pend)
         return pend
@@ -150,7 +164,10 @@ class MicroBatcher:
             with tracing.span("serve_admit"):
                 batch = self._admit(first)
             bucket = pick_bucket(len(batch), self.scorer.buckets)
-            w_dev, info = self.slots.current()   # one model per batch
+            # one model per batch: the (w, scale, info) triple is
+            # published atomically, so the scale always matches the
+            # buffer it scales
+            w_dev, scale, info = self.slots.current()
             t_score = time.monotonic()
             queue_s = t_score - first.t_enq
             try:
@@ -158,7 +175,8 @@ class MicroBatcher:
                                   n=len(batch)):
                     idx, val, hot = self.scorer.assemble(
                         [(p.idx, p.val) for p in batch], bucket)
-                    out = self.scorer.score(w_dev, idx, val, hot)
+                    out = self.scorer.score(w_dev, idx, val, hot,
+                                            scale)
                     # the ONE sanctioned device→host crossing per batch
                     # (the zero-unintended-transfers contract)
                     with sanitize.intended_fetch("serve_fetch"):
@@ -176,9 +194,16 @@ class MicroBatcher:
                                         + _EWMA * device_s)
             done = time.monotonic()
             lats = [done - p.t_enq for p in batch]
+            # the form that ANSWERED, derived from the captured buffer
+            # (not a racy slots attribute read): how a client observes
+            # a certificate fallback, the same way `round` observes a
+            # hot-swap
+            served = {"uint32": "bf16", "int32": "int8"} \
+                .get(str(np.dtype(w_dev.dtype)), "f32")
             for r, p in enumerate(batch):
                 p.margin = float(margins[r])
                 p.model_round = info.round
+                p.served_dtype = served
                 p.done.set()
             self.batches_total += 1
             self.requests_total += len(batch)

@@ -1,0 +1,221 @@
+"""Swap-time model quantization for low-precision serving
+(``--serveDtype``, docs/DESIGN.md §20).
+
+Training rejected bf16 for a measured reason (tests/test_bf16.py: the
+bf16 duality gap quantizes to 0.0) — but serving never evaluates the
+gap.  A margin only needs SIGN and RANKING fidelity, so the scorer can
+trade precision for throughput without touching the certificate the
+trainer owns.  Three design decisions keep that trade honest:
+
+- **Weights-only, once per swap.**  Only the MODEL is narrowed, on the
+  host, at publish time; query values and the padded-batch assembly
+  stay f32, and the compiled scoring path dequantizes gathered lanes in
+  registers (ops/rows.gather_dequant).  Quantization never appears
+  inside the compiled path — the serve-hygiene lint rule makes that an
+  error — so a batch never pays a cast of the model, and the f32
+  serving path is BIT-IDENTICAL to the pre-quantization scorer.
+- **Packed lanes, not narrow arrays.**  bf16 is stored two lanes per
+  uint32 word, int8 four lanes per int32 word, so the per-nonzero
+  gather stays on the hardware 4-byte gather path while the model's
+  cache/HBM footprint halves (quarters).  XLA's CPU backend EMULATES
+  narrow arithmetic — a plain ``jnp.bfloat16`` model measures SLOWER
+  than f32 — so the packed layout is where the measured throughput win
+  actually comes from (benchmarks/serve_bench.py ``--serveDtype``); on
+  TPU the same layout is what halves the HBM stream.  Dequantization is
+  exact bit manipulation (bf16 -> f32 is lossless; int8 lanes sign-
+  extend exactly), so packed and unpacked forms answer identically.
+- **A per-swap error certificate.**  Every publish computes an
+  empirical f32-vs-quantized margin-error bound over a calibration
+  batch of recent real queries (warmup-seeded synthetic fallback,
+  :class:`CalibrationBuffer`) and compares it against the weakest
+  calibrated margin: if the bound could flip that sign, the swap FALLS
+  BACK to publishing the f32 model — a normal slot publish, so it
+  inherits the atomic no-recompile swap guarantees (the scorer warms
+  both forms; one compiled executable per (bucket, dtype), ever).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+# the serve-dtype vocabulary: resolve_serve_dtype() maps every accepted
+# spelling (CLI strings, numpy/jax dtype objects) onto these
+SERVE_DTYPES = ("f32", "bf16", "int8")
+
+# device dtype of each packed model form — the trace-time dispatch key:
+# the compiled scoring path picks its dequantize kernel from w.dtype
+# alone (ops/rows.gather_dequant), so the three forms MUST be distinct
+PACKED_DTYPE = {"f32": np.dtype(np.float32),
+                "bf16": np.dtype(np.uint32),   # 2 bf16 lanes per word
+                "int8": np.dtype(np.int32)}    # 4 int8 lanes per word
+
+LANES = {"f32": 1, "bf16": 2, "int8": 4}
+
+_ALIASES = {"f32": "f32", "float32": "f32",
+            "bf16": "bf16", "bfloat16": "bf16",
+            "int8": "int8"}
+
+
+def resolve_serve_dtype(dtype) -> str:
+    """Canonical serve dtype (``f32``/``bf16``/``int8``) from any
+    accepted spelling; anything else is rejected with the vocabulary."""
+    if dtype is None:
+        return "f32"
+    if isinstance(dtype, str):
+        key = dtype.strip().lower()
+    else:
+        try:
+            key = np.dtype(dtype).name
+        except TypeError:
+            key = str(dtype)
+    got = _ALIASES.get(key)
+    if got is None:
+        raise ValueError(
+            f"unsupported serve dtype {dtype!r}: the serving stack "
+            f"quantizes to one of {'/'.join(SERVE_DTYPES)} "
+            f"(--serveDtype)")
+    return got
+
+
+def packed_len(num_features: int, serve_dtype: str) -> int:
+    """Length of the packed device array for a width-``num_features``
+    model (the tail word zero-padded; pad lanes dequantize to 0.0 and a
+    padded query slot carries value 0, so they contribute nothing)."""
+    lanes = LANES[serve_dtype]
+    return -(-int(num_features) // lanes)
+
+
+class QuantizedModel(NamedTuple):
+    """One quantized publishable form of a model vector."""
+
+    serve_dtype: str              # "bf16" | "int8" ("f32" = passthrough)
+    packed: np.ndarray            # device-ready packed array
+    scale: Optional[np.float32]   # int8 symmetric per-model scale, else
+                                  # None (the scale rides the compiled
+                                  # path as a TRACED scalar — a new
+                                  # scale per swap never retraces)
+
+
+def quantize(w, serve_dtype: str) -> QuantizedModel:
+    """Host-side quantize+pack of a model vector.  bf16 rounds to
+    nearest-even then packs lane ``i`` into bits ``16*(i&1)`` of word
+    ``i>>1``; int8 uses a symmetric per-model scale ``max|w|/127``
+    (zero-model guard: scale 1.0) and packs lane ``i`` into bits
+    ``8*(i&3)`` of word ``i>>2`` — both exactly the layouts
+    ops/rows.gather_dequant unpacks."""
+    import ml_dtypes
+
+    w = np.asarray(w, np.float32).reshape(-1)
+    d = w.shape[0]
+    sd = resolve_serve_dtype(serve_dtype)
+    if sd == "f32":
+        return QuantizedModel("f32", w, None)
+    if sd == "bf16":
+        lanes = np.asarray(w.astype(ml_dtypes.bfloat16)
+                           .view(np.uint16), np.uint32)
+        pad = packed_len(d, sd) * 2 - d
+        if pad:
+            lanes = np.concatenate(
+                [lanes, np.asarray([0] * pad, np.uint32)])
+        return QuantizedModel(
+            "bf16", lanes[0::2] | (lanes[1::2] << np.uint32(16)), None)
+    scale = np.float32(np.max(np.abs(w)) / 127.0) if np.any(w) \
+        else np.float32(1.0)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    lanes = q.view(np.uint8).astype(np.uint32)
+    pad = packed_len(d, sd) * 4 - d
+    if pad:
+        lanes = np.concatenate([lanes, np.asarray([0] * pad, np.uint32)])
+    packed = (lanes[0::4] | (lanes[1::4] << np.uint32(8))
+              | (lanes[2::4] << np.uint32(16))
+              | (lanes[3::4] << np.uint32(24))).view(np.int32)
+    return QuantizedModel("int8", packed, scale)
+
+
+def dequantize(qm: QuantizedModel, num_features: int) -> np.ndarray:
+    """Exact f32 image of the quantized model — what the compiled path
+    effectively serves.  Bounds and tests compare against THIS, so the
+    certificate measures quantization error, not kernel mystery."""
+    d = int(num_features)
+    if qm.serve_dtype == "f32":
+        return np.asarray(qm.packed, np.float32)[:d]
+    if qm.serve_dtype == "bf16":
+        lanes = np.empty(qm.packed.shape[0] * 2, np.uint16)
+        lanes[0::2] = (qm.packed & np.uint32(0xFFFF)).astype(np.uint16)
+        lanes[1::2] = (qm.packed >> np.uint32(16)).astype(np.uint16)
+        return (lanes.astype(np.uint32) << np.uint32(16)) \
+            .view(np.float32)[:d]
+    words = qm.packed.view(np.uint32)
+    lanes = np.empty(words.shape[0] * 4, np.uint8)
+    for j in range(4):
+        lanes[j::4] = ((words >> np.uint32(8 * j))
+                       & np.uint32(0xFF)).astype(np.uint8)
+    return lanes.view(np.int8).astype(np.float32)[:d] \
+        * np.float32(qm.scale)
+
+
+def margin_error_bound(w32, w_served, queries):
+    """Empirical per-swap certificate over a calibration batch.
+
+    Returns ``(bound, weakest, flips)``: the max f64 margin error of the
+    served (dequantized) model vs the incoming f32 model, the smallest
+    nonzero |f32 margin| it must not exceed, and how many calibration
+    margins actually changed sign.  The fallback policy is
+    ``bound >= weakest`` — the measured error could flip the weakest
+    margin a real query produced, so sign fidelity is no longer
+    certified and the swap publishes f32 instead."""
+    # jaxlint: allow=f64 -- host-side certificate arithmetic at swap
+    # time; never enters device compute
+    w32 = np.asarray(w32, np.float64)
+    wq = np.asarray(w_served, np.float64)  # jaxlint: allow=f64 -- cert
+    bound, weakest, flips = 0.0, np.inf, 0
+    for qi, qv in queries:
+        qi = np.asarray(qi, np.int64)
+        qv = np.asarray(qv, np.float64)  # jaxlint: allow=f64 -- cert
+        m32 = float(np.dot(w32[qi], qv))
+        mq = float(np.dot(wq[qi], qv))
+        bound = max(bound, abs(mq - m32))
+        if m32 != 0.0:
+            weakest = min(weakest, abs(m32))
+        if (mq < 0.0) != (m32 < 0.0) and mq != m32:
+            flips += 1
+    return bound, weakest, flips
+
+
+class CalibrationBuffer:
+    """Ring of recent REAL queries the certificate is computed over,
+    warmup-seeded with synthetic queries so the very first publish
+    (before any traffic) still carries a bound.  The batcher records
+    every admitted query (cheap append under a lock); the swap path
+    samples the most recent window."""
+
+    def __init__(self, num_features: int, max_nnz: int = 16,
+                 capacity: int = 256, seed: int = 0,
+                 warmup_n: int = 64):
+        self._lock = threading.Lock()
+        self._cap = int(capacity)
+        self._ring = []
+        self.recorded_total = 0
+        rng = np.random.default_rng(seed)
+        nnz = max(1, min(int(max_nnz), 8))
+        for _ in range(warmup_n):
+            qi = rng.integers(0, num_features, size=nnz,
+                              dtype=np.int32)
+            qv = rng.standard_normal(nnz).astype(np.float32)
+            self._ring.append((qi, qv))
+
+    def record(self, idx, val):
+        with self._lock:
+            self._ring.append((idx, val))
+            self.recorded_total += 1
+            if len(self._ring) > self._cap:
+                del self._ring[:len(self._ring) - self._cap]
+
+    def sample(self, n: int = 64) -> list:
+        """The most recent ``n`` queries (newest-biased: recent traffic
+        is what the next generation will actually answer)."""
+        with self._lock:
+            return list(self._ring[-int(n):])

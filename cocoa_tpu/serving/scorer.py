@@ -21,12 +21,23 @@ perf-critical contracts live here:
   MXU panel matvec, only the cold tail through the gather.
 
 :class:`ModelSlots` is the double-buffered model holder: the live
-``(w, info)`` pair is published as ONE tuple behind a single attribute,
-so a reader (the batcher thread) either sees the old model or the new
-one, never a torn mix; an in-flight batch keeps its reference to the
-old device buffer until its dispatch completes, so a swap can never
-drop or block a request.  The spare slot is wherever the next upload
-lands — ``device_put`` into fresh memory while the old buffer serves.
+``(w, scale, info)`` triple is published as ONE tuple behind a single
+attribute, so a reader (the batcher thread) either sees the old model
+or the new one, never a torn mix; an in-flight batch keeps its
+reference to the old device buffer until its dispatch completes, so a
+swap can never drop or block a request.  The spare slot is wherever the
+next upload lands — ``device_put`` into fresh memory while the old
+buffer serves.
+
+Low-precision serving (``--serveDtype``, docs/DESIGN.md §20) hangs off
+the publish: with a bf16/int8 serve dtype, :meth:`ModelSlots.swap`
+quantizes the incoming f32 model ONCE on the host (serving/quantize.py
+packed-lane forms), computes the per-swap margin-error certificate
+over a calibration batch, and — if the bound could flip the weakest
+calibrated margin's sign — publishes the f32 model instead.  Either
+way it is the same atomic publish, and the scorer warmed BOTH model
+forms per bucket up front, so neither the quantized generation nor the
+certificate fallback ever compiles after warmup.
 """
 
 from __future__ import annotations
@@ -87,7 +98,8 @@ def parse_query(text: str, num_features: int, max_nnz: int):
             f"server with --serveMaxNnz>={len(toks)} or sparsify the "
             f"query")
     # jaxlint: allow=f64 -- exact host-side text parse; values cast to
-    # the serving dtype at batch assembly, never enter device compute
+    # f32 at batch assembly (quantization is weights-only — the query
+    # side never narrows), never enter device compute as f64
     return np.asarray(idx, np.int32), np.asarray(val, np.float64)
 
 
@@ -127,54 +139,110 @@ class ModelSlots:
     already captured the old reference complete against it untouched.
     """
 
-    def __init__(self, w, info: ModelInfo, dtype=None):
-        import jax
-        import jax.numpy as jnp
+    def __init__(self, w, info: ModelInfo, dtype=None, calibration=None,
+                 algorithm: str = "serve",
+                 flip_guard: Optional[float] = None):
+        from cocoa_tpu.serving import quantize as quantize_mod
 
-        self._dtype = jnp.dtype(dtype) if dtype is not None else None
-        w_dev = jax.device_put(self._cast(w))
-        self._live = (w_dev, info)
+        self.serve_dtype = quantize_mod.resolve_serve_dtype(dtype)
+        self.algorithm = algorithm
+        self._calibration = calibration   # CalibrationBuffer or None
+        # certificate fallback threshold: publish f32 when the measured
+        # bound reaches the weakest calibrated |margin| (default), or an
+        # explicit absolute threshold (tests force the crossing with it)
+        self._flip_guard = flip_guard
+        w = np.asarray(w, np.float32).reshape(-1)
+        self._d = int(w.shape[0])
+        self.served_dtype = "f32"       # form of the LIVE slot
+        self.last_bound: Optional[float] = None
+        self.fallbacks_total = 0
         self._lock = threading.Lock()   # serializes WRITERS only
+        self._publish(w, info)
 
-    def _cast(self, w):
-        w = np.asarray(w)
-        if self._dtype is not None:
-            w = w.astype(self._dtype)
-        return w
+    def _publish(self, w32, info: ModelInfo):
+        """Quantize (if armed), certify, upload, publish — the one
+        place a model becomes live.  Caller holds the writer lock (or
+        is ``__init__``)."""
+        import jax
+
+        from cocoa_tpu.serving import quantize as quantize_mod
+
+        served, qm, bound, calib_n, flips, fallback = \
+            "f32", None, None, 0, 0, 0
+        if self.serve_dtype != "f32":
+            qm = quantize_mod.quantize(w32, self.serve_dtype)
+            if self._calibration is not None:
+                batch = self._calibration.sample()
+                calib_n = len(batch)
+                if batch:
+                    wq = quantize_mod.dequantize(qm, self._d)
+                    bound, weakest, flips = \
+                        quantize_mod.margin_error_bound(w32, wq, batch)
+                    guard = (weakest if self._flip_guard is None
+                             else self._flip_guard)
+                    fallback = int(bound >= guard)
+            if not fallback:
+                served = self.serve_dtype
+        if served == "f32":
+            w_dev, scale = jax.device_put(w32), None
+        else:
+            w_dev, scale = jax.device_put(qm.packed), qm.scale
+        self._live = (w_dev, scale, info)
+        self.served_dtype = served
+        self.last_bound = bound
+        self.fallbacks_total += fallback
+        if self.serve_dtype != "f32":
+            self._emit_quantize(info, served, bound, calib_n, flips,
+                                fallback, qm)
+
+    def _emit_quantize(self, info, served, bound, calib_n, flips,
+                       fallback, qm):
+        from cocoa_tpu.telemetry import events as tele_events
+
+        bus = tele_events.get_bus()
+        if not bus.active():
+            return
+        bus.emit(
+            "model_quantize", algorithm=self.algorithm,
+            serve_dtype=self.serve_dtype, served=served,
+            round=info.round, swap_seq=info.seq, bound=bound,
+            calib_n=calib_n, flips=flips, fallback=fallback,
+            scale=(None if qm is None or qm.scale is None
+                   else float(qm.scale)))
 
     def current(self):
+        """The live ``(w_device, scale, info)`` triple — ``scale`` is
+        the int8 per-model symmetric scale (None for f32/bf16 forms),
+        published atomically WITH the buffer it scales."""
         return self._live
 
     @property
     def info(self) -> ModelInfo:
-        return self._live[1]
+        return self._live[2]
 
     def gap_age_s(self, now: Optional[float] = None) -> float:
         """Seconds since the live model's certificate was produced —
         the freshness the serving loop exports
         (``cocoa_model_gap_age_seconds``)."""
         return (now if now is not None else time.time()) \
-            - self._live[1].birth_ts
+            - self._live[2].birth_ts
 
     def swap(self, w, info: ModelInfo):
-        """Upload ``w`` into the spare slot and publish atomically.
+        """Quantize + certify + upload ``w`` into the spare slot and
+        publish atomically.
 
-        A shape/dtype change is rejected with the numbers — static
-        shapes are what make a swap compile-free, so a width change is
-        a different MODEL, not a fresh generation of this one."""
-        import jax
-
+        A shape change is rejected with the numbers — static shapes are
+        what make a swap compile-free, so a width change is a different
+        MODEL, not a fresh generation of this one."""
         with self._lock:
-            live_w = self._live[0]
-            w = self._cast(w)
-            if w.shape != live_w.shape:
+            w = np.asarray(w)
+            if tuple(w.shape) != (self._d,):
                 raise QueryError(
                     f"refusing hot-swap: incoming w has shape "
                     f"{tuple(w.shape)} but the serving executable is "
-                    f"compiled for {tuple(live_w.shape)} — a width "
-                    f"change is a new model (restart the server)")
-            w_dev = jax.device_put(w)
-            self._live = (w_dev, info)
+                    f"compiled for ({self._d},) — a width change is a "
+                    f"new model (restart the server)")
+            self._publish(np.asarray(w, np.float32), info)
         return info
 
 
@@ -191,11 +259,12 @@ class BatchScorer:
     def __init__(self, num_features: int, dtype=None,
                  buckets: tuple = DEFAULT_BUCKETS,
                  max_nnz: int = DEFAULT_MAX_NNZ,
-                 hot_ids=None):
+                 hot_ids=None, model_width=None):
         import jax
         import jax.numpy as jnp
 
         from cocoa_tpu.ops import rows as rows_mod
+        from cocoa_tpu.serving import quantize as quantize_mod
 
         if not buckets or list(buckets) != sorted(set(int(b)
                                                       for b in buckets)):
@@ -204,8 +273,32 @@ class BatchScorer:
         if buckets[0] < 1:
             raise ValueError(f"buckets must be >= 1, got {buckets!r}")
         self.num_features = int(num_features)
-        self.dtype = jnp.dtype(dtype) if dtype is not None \
-            else jnp.dtype(jnp.float32)
+        # the trained width may exceed the query width by lane padding
+        # (the CLI passes the checkpoint's w width); the packed model
+        # forms are sized from THIS, so the warmed executables match
+        # every future publish exactly
+        self.model_width = (int(model_width) if model_width is not None
+                            else self.num_features)
+        if self.model_width < self.num_features:
+            raise ValueError(
+                f"model_width={self.model_width} is narrower than the "
+                f"query surface num_features={self.num_features} — a "
+                f"query could gather past the model")
+        # ``dtype`` is the SERVE dtype (--serveDtype): it selects which
+        # packed model form this scorer compiles for.  Query assembly is
+        # always f32 — quantization is weights-only (quantize.py), so
+        # the request side never narrows
+        self.serve_dtype = quantize_mod.resolve_serve_dtype(dtype)
+        self.dtype = jnp.dtype(jnp.float32)
+        # model forms this scorer serves: the configured form plus the
+        # f32 certificate-fallback form — keyed by (device dtype,
+        # packed length), the numbers a mismatch is rejected with
+        self._forms = {"f32": (np.dtype(np.float32), self.model_width)}
+        if self.serve_dtype != "f32":
+            self._forms[self.serve_dtype] = (
+                quantize_mod.PACKED_DTYPE[self.serve_dtype],
+                quantize_mod.packed_len(self.model_width,
+                                        self.serve_dtype))
         self.buckets = tuple(int(b) for b in buckets)
         self.max_nnz = int(min(max_nnz, num_features))
         self.hot_rank = None
@@ -223,16 +316,20 @@ class BatchScorer:
 
         hot_cols = self._hot_cols_dev
 
-        def serve_margins(w, idx, val, hot):
+        def serve_margins(w, idx, val, hot, scale):
             shard = {"sp_indices": idx, "sp_values": val}
             if hot is not None:
                 shard["X_hot"] = hot
                 shard["hot_cols"] = hot_cols
-            return rows_mod.shard_margins(w, shard)
+            return rows_mod.serve_margins(w, shard, scale)
 
         # built ONCE at construction (the serve-hygiene rule pins this
         # shape statically): every later call only re-specializes on a
-        # new BUCKET shape, never on the model or the request content
+        # new BUCKET shape or model FORM (the w dtype is the trace-time
+        # dispatch key in rows.gather_dequant — both forms are warmed up
+        # front), never on the model bytes or the request content.  The
+        # int8 scale rides as a traced scalar: a new scale per swap
+        # never retraces
         self._jit = jax.jit(serve_margins)
 
     def assemble(self, queries: list, bucket: int):
@@ -264,17 +361,56 @@ class BatchScorer:
                 val[r, :len(cv)] = cv
         return idx, val, hot
 
-    def score(self, w_dev, idx, val, hot=None):
+    def score(self, w_dev, idx, val, hot=None, scale=None):
         """Dispatch one padded bucket; returns the DEVICE margins array
         (the caller fetches once, under ``intended_fetch`` — the
-        zero-unintended-transfers contract)."""
-        return self._jit(w_dev, idx, val, hot)
+        zero-unintended-transfers contract).
 
-    def warmup(self, w_dev):
-        """Compile every bucket up front so no request ever pays a
-        compile; returns the bucket count (== the expected compile
-        count, what the sanitizer pin asserts)."""
+        The model must be one of the forms this scorer compiled for
+        (its ``--serveDtype`` form or the f32 certificate fallback) —
+        anything else would silently compile a new executable per
+        publish, so it is rejected with the numbers instead."""
+        wd, wl = np.dtype(w_dev.dtype), int(w_dev.shape[0])
+        if not any(wd == fd and wl == fl
+                   for fd, fl in self._forms.values()):
+            raise QueryError(
+                f"model form mismatch: got w dtype={wd.name} shape="
+                f"({wl},) but this scorer (serve dtype "
+                f"{self.serve_dtype}, num_features="
+                f"{self.num_features}) compiles only "
+                + " or ".join(f"{sd}:{fd.name}({fl},)"
+                              for sd, (fd, fl) in self._forms.items())
+                + " — construct ModelSlots and BatchScorer with the "
+                  "same dtype= (the CLI wires --serveDtype into both)")
+        needs_scale = wd == np.dtype(np.int32)
+        if (scale is None) == needs_scale:
+            raise QueryError(
+                f"scale mismatch: an int8-packed model carries its "
+                f"per-model scale as a traced scalar and every other "
+                f"form carries None — got w dtype={wd.name} with "
+                f"scale={scale!r}; a stray scale would silently "
+                f"compile a new specialization per publish")
+        return self._jit(w_dev, idx, val, hot, scale)
+
+    def warmup(self, w_dev, scale=None):
+        """Compile every (bucket, model form) pair up front so no
+        request ever pays a compile — under a quantized serve dtype
+        that is TWO forms per bucket (the packed form and the f32
+        certificate-fallback form), so a mid-flight fallback publish
+        can never stall the dispatch queue behind a compile.  Returns
+        the specialization count (== the expected compile count, what
+        the sanitizer pin asserts)."""
+        import jax
+
+        wd = np.dtype(w_dev.dtype)
+        forms = [(w_dev, scale)]
+        for sd, (fd, fl) in self._forms.items():
+            if fd == wd:
+                continue
+            forms.append((jax.device_put(np.zeros((fl,), fd)),
+                          np.float32(1.0) if sd == "int8" else None))
         for b in self.buckets:
             idx, val, hot = self.assemble([], b)
-            np.asarray(self.score(w_dev, idx, val, hot))
-        return len(self.buckets)
+            for wv, sv in forms:
+                np.asarray(self.score(wv, idx, val, hot, sv))
+        return len(self.buckets) * len(forms)

@@ -6,9 +6,12 @@ Protocol (one JSON response line per request line):
   (``idx:val idx:val ...``, 1-based ids), or several queries joined
   with ``;`` — a client-side batch, which the micro-batcher scores as
   one padded bucket;
-- the response is ``{"margin": m, "round": r}`` per query (``round`` =
-  the training round of the model generation that answered — how a
-  client observes a hot-swap), a JSON array of those for a ``;`` batch,
+- the response is ``{"margin": m, "round": r, "dtype": d}`` per query
+  (``round`` = the training round of the model generation that answered
+  — how a client observes a hot-swap; ``dtype`` = the model form that
+  answered, ``f32``/``bf16``/``int8`` — how a client observes a
+  ``--serveDtype`` certificate fallback), a JSON array of those for a
+  ``;`` batch,
   or ``{"error": "..."}`` with the numbers for a rejected query
   (rejections are per query: one bad query in a batch fails only
   itself);
@@ -104,7 +107,8 @@ class MarginServer:
                 continue
             try:
                 margin = p.result(timeout=30.0)
-                out.append({"margin": margin, "round": p.model_round})
+                out.append({"margin": margin, "round": p.model_round,
+                            "dtype": p.served_dtype})
             except Exception as e:
                 out.append({"error": f"{type(e).__name__}: {e}"})
         return out if len(texts) > 1 else out[0] if out \

@@ -12,6 +12,14 @@ traffic drops zero requests and the post-swap margins are bit-identical
 to a cold restart on the new checkpoint (pinned,
 tests/test_serving.py).
 
+Low-precision serving rides the same publish: with ``--serveDtype``
+armed, ``slots.swap`` quantizes the incoming generation and computes
+its margin-error certificate INSIDE the swap (serving/quantize.py), so
+this watcher needs no dtype awareness — a generation that certifies
+serves quantized, one that doesn't serves f32, and either way the poll
+loop here only ever sees an atomic publish that cannot recompile (the
+scorer warmed both forms).
+
 Freshness semantics (docs/DESIGN.md §17): the paper's primal-dual
 certificate is what makes serving-while-training trustworthy, so the
 exported freshness is **gap age** — seconds since the live model's

@@ -108,6 +108,16 @@ EVENT_TYPES = (
                         # (serving/watcher.py): round, path, certified
                         # gap, and the certificate's birth timestamp —
                         # what anchors cocoa_model_gap_age_seconds
+    "model_quantize",   # one --serveDtype publish decision
+                        # (serving/scorer.ModelSlots._publish): the
+                        # configured serve dtype, the form actually
+                        # published (== serve dtype, or f32 on a
+                        # certificate fallback), the measured
+                        # f32-vs-quantized margin-error bound over the
+                        # calibration batch, its size, and the int8
+                        # scale — what feeds
+                        # cocoa_serve_margin_error_bound /
+                        # cocoa_serve_dtype_fallbacks_total
 )
 
 

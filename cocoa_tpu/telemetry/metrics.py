@@ -89,6 +89,15 @@ otherwise one opaque device dispatch:
   window is mis-tuned
 - ``cocoa_model_swaps_total``   counter — validated checkpoint
   generations hot-swapped into the live serving slot (``model_swap``)
+- ``cocoa_serve_margin_error_bound`` gauge — the live ``--serveDtype``
+  certificate: the measured f32-vs-quantized margin-error bound of the
+  most recent publish over its calibration batch (the
+  ``model_quantize`` events; present only once a quantized serve run
+  published).  ``cocoa_serve_dtype_fallbacks_total`` counter rides
+  alongside — publishes whose bound could flip the weakest calibrated
+  margin's sign, so the swap served f32 instead; a steadily climbing
+  value means the trained models stopped surviving quantization and
+  the serve dtype should be revisited
 - ``cocoa_model_gap_age_seconds`` gauge — freshness of the SERVING
   model: seconds (at render time) since the live model's certificate —
   its checkpoint — was produced.  A healthy background trainer keeps
@@ -196,6 +205,9 @@ class MetricsWriter:
         self.serve_lat_count = 0
         self.model_swaps_total = 0
         self.model_birth_ts = None      # live model's certificate birth
+        self.serve_quantize_seen = False
+        self.serve_margin_error_bound = None
+        self.serve_dtype_fallbacks_total = 0
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -347,6 +359,16 @@ class MetricsWriter:
                 self.model_swaps_total += 1
             if rec.get("birth_ts") is not None:
                 self.model_birth_ts = float(rec["birth_ts"])
+        elif ev == "model_quantize":
+            self.serve_quantize_seen = True
+            if rec.get("bound") is not None:
+                # the LIVE certificate: the most recent publish's bound
+                # (kept even on a fallback — it is why the fallback
+                # happened, and the one number to look at when the
+                # fallbacks counter climbs)
+                self.serve_margin_error_bound = float(rec["bound"])
+            if rec.get("fallback"):
+                self.serve_dtype_fallbacks_total += 1
 
     def _maybe_write(self, ev):
         """The write debounce (caller holds the lock): flush-now events
@@ -536,6 +558,17 @@ class MetricsWriter:
                       f"cocoa_model_swaps_total {self.model_swaps_total}",
                       "# TYPE cocoa_model_gap_age_seconds gauge",
                       f"cocoa_model_gap_age_seconds {age!r}"]
+        if self.serve_quantize_seen:
+            # quantized-serving families render only once a --serveDtype
+            # run published (f32 serves must not carry zero-valued
+            # quantization series)
+            lines += ["# TYPE cocoa_serve_dtype_fallbacks_total counter",
+                      f"cocoa_serve_dtype_fallbacks_total "
+                      f"{self.serve_dtype_fallbacks_total}"]
+            if self.serve_margin_error_bound is not None:
+                lines += ["# TYPE cocoa_serve_margin_error_bound gauge",
+                          f"cocoa_serve_margin_error_bound "
+                          f"{self.serve_margin_error_bound!r}"]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]

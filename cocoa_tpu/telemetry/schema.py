@@ -170,6 +170,19 @@ EVENT_FIELDS = {
     "model_swap": {"algorithm": (str,), "round": (int, type(None)),
                    "path": (str,), "birth_ts": _NUM, "gap": _OPT_NUM,
                    "gap_age_s": _NUM, "swap_seq": (int,)},
+    # one --serveDtype publish decision (serving/scorer.ModelSlots):
+    # served == serve_dtype when the generation certified, "f32" on a
+    # certificate fallback (fallback=1); bound is the measured
+    # f32-vs-quantized margin-error bound over calib_n calibration
+    # queries (None when no calibration source is wired), flips how
+    # many calibration margins actually changed sign, scale the int8
+    # symmetric per-model scale (None for bf16).  swap_seq mirrors
+    # model_swap ("seq" would collide with the record envelope)
+    "model_quantize": {"algorithm": (str,), "serve_dtype": (str,),
+                       "served": (str,), "round": (int, type(None)),
+                       "swap_seq": (int,), "bound": _OPT_NUM,
+                       "calib_n": (int,), "flips": (int,),
+                       "fallback": (int,), "scale": _OPT_NUM},
 }
 
 # --fleet manifest dialect (data/fleet.py): a ``fleet_manifest`` header
@@ -259,6 +272,17 @@ RESULTS_FIELDS = {
     "qps": _NUM, "p50_ms": _NUM, "p99_ms": _NUM, "sla_ms": _NUM,
     "gap_age_s": _NUM, "buckets": (str,), "queries": (int,),
     "swaps": (int,), "fill": _NUM, "threads": (int,),
+    # the low-precision serving A/B rows (--serveDtype,
+    # benchmarks/serve_bench.py): compiled-path throughput of the
+    # packed bf16/int8 model vs the SAME-harness f32 control at a
+    # geometry where the f32 model spills the cache level the packed
+    # form fits (the honest mechanism: the gather stream halves);
+    # margin_err_bound is the per-swap certificate, flips the sign
+    # flips observed beyond it (gated == 0), calib_n the calibration
+    # batch size the bound was measured over
+    "serve_dtype": (str,), "f32_qps": _NUM, "qps_ratio": _NUM,
+    "margin_err_bound": _NUM, "flips": (int,), "flip_checked": (int,),
+    "calib_n": (int,),
 }
 
 

@@ -60,14 +60,39 @@ def main(argv=None) -> int:
         print(f"serve-smoke FAIL: training exited {rc}")
         return 1
 
-    print("serve-smoke: starting the server (--serve=0, buckets 8/64)",
-          flush=True)
-    server = subprocess.Popen(
-        [sys.executable, "-m", "cocoa_tpu.cli", "--serve=0",
-         f"--chkptDir={ck}", f"--numFeatures={D}", "--serveBatch=8,64",
-         "--serveSlaMs=50", f"--events={events_path}",
-         f"--metrics={metrics_path}"],
-        cwd=ROOT, env=env, stdout=subprocess.PIPE, text=True)
+    for serve_dtype in (None, "bf16"):
+        failures += serve_phase(ck, outdir, env, serve_dtype)
+    if failures:
+        for msg in failures:
+            print(f"serve-smoke FAIL: {msg}")
+        return 1
+    print(f"serve-smoke: OK — trained, served (f32 + bf16 variants), "
+          f"hot-swapped, schema valid, gauges present "
+          f"(artifacts in {outdir})")
+    return 0
+
+
+def serve_phase(ck: str, outdir: str, env: dict,
+                serve_dtype=None) -> list:
+    """One full serve/score/inject/swap/shutdown cycle against the real
+    CLI; ``serve_dtype`` None runs the canonical f32 path, "bf16" the
+    low-precision variant (same checks, plus the model_quantize event
+    stream, the certificate gauges, and the per-answer dtype field).
+    Returns the failure strings (empty = the phase held)."""
+    tag = serve_dtype or "f32"
+    events_path = os.path.join(outdir, f"serve-events-{tag}.jsonl")
+    metrics_path = os.path.join(outdir, f"serve-metrics-{tag}.prom")
+    failures = []
+    flags = [sys.executable, "-m", "cocoa_tpu.cli", "--serve=0",
+             f"--chkptDir={ck}", f"--numFeatures={D}",
+             "--serveBatch=8,64", "--serveSlaMs=50",
+             f"--events={events_path}", f"--metrics={metrics_path}"]
+    if serve_dtype:
+        flags.append(f"--serveDtype={serve_dtype}")
+    print(f"serve-smoke: starting the {tag} server (--serve=0, "
+          f"buckets 8/64)", flush=True)
+    server = subprocess.Popen(flags, cwd=ROOT, env=env,
+                              stdout=subprocess.PIPE, text=True)
     try:
         port = None
         deadline = time.monotonic() + 120
@@ -81,8 +106,7 @@ def main(argv=None) -> int:
                            .split()[0].rsplit(":", 1)[1])
                 break
         if port is None:
-            print("serve-smoke FAIL: server never announced its port")
-            return 1
+            return [f"{tag} server never announced its port"]
 
         s = socket.create_connection(("127.0.0.1", port), timeout=30)
         f = s.makefile("rwb")
@@ -96,6 +120,16 @@ def main(argv=None) -> int:
         if not (isinstance(first, list) and len(first) == 3
                 and all("margin" in r for r in first)):
             failures.append(f"bad batch response: {first}")
+        # every answer declares the model form that produced it — the
+        # client-visible face of the certificate (bf16 when certified,
+        # f32 after a fallback publish; the plain server always f32)
+        want_dtypes = {"f32"} if serve_dtype is None \
+            else {serve_dtype, "f32"}
+        if not all(r.get("dtype") in want_dtypes for r in first):
+            failures.append(
+                f"answers carry dtype "
+                f"{[r.get('dtype') for r in first]}, expected one of "
+                f"{sorted(want_dtypes)}")
         r0 = first[0].get("round") if first else None
         print(f"serve-smoke: scored a 3-query batch on model r{r0}",
               flush=True)
@@ -124,11 +158,17 @@ def main(argv=None) -> int:
             failures.append("the server never served the injected "
                             "generation (no hot-swap observed)")
         else:
+            # bf16(0.5*w) == 0.5*bf16(w) exactly, but the certificate
+            # may legitimately decide differently across publishes
+            # (the calibration ring grows with real traffic), so the
+            # quantized phase allows one bound's worth of slack between
+            # the quantized and f32 forms
+            tol = 1e-4 if serve_dtype is None else 2e-2
             for old, new in zip(first, swapped):
                 if "margin" not in old or "margin" not in new:
                     continue
                 want = old["margin"] * 0.5
-                if abs(new["margin"] - want) > 1e-4 + abs(want) * 1e-4:
+                if abs(new["margin"] - want) > tol + abs(want) * tol:
                     failures.append(
                         f"post-swap margin {new['margin']} != half the "
                         f"pre-swap {old['margin']} — the swap did not "
@@ -154,32 +194,45 @@ def main(argv=None) -> int:
 
     errs = tele_schema.check_file(events_path)
     if errs:
-        failures.append(f"events schema violations: {errs[:5]}")
+        failures.append(f"{tag} events schema violations: {errs[:5]}")
     recs = [json.loads(ln) for ln in open(events_path)]
     swaps = [r for r in recs if r["event"] == "model_swap"]
-    if not any(r.get("round", -1) > 40 for r in swaps):
-        failures.append("no model_swap event for the injected "
-                        "generation in the stream")
+    if not any(r.get("round", -1) == new_round for r in swaps):
+        failures.append(f"no model_swap event for the injected "
+                        f"generation r{new_round} in the {tag} stream")
     if not any(r["event"] == "serve_request" for r in recs):
-        failures.append("no serve_request events in the stream")
+        failures.append(f"no serve_request events in the {tag} stream")
+    needles = ["cocoa_serve_qps", "cocoa_serve_requests_total",
+               "cocoa_serve_latency_seconds_count",
+               "cocoa_serve_batch_fill_ratio",
+               "cocoa_model_swaps_total",
+               "cocoa_model_gap_age_seconds"]
+    if serve_dtype:
+        # the quantize stream: one model_quantize per publish (initial
+        # load + the injected swap), and the certificate families
+        quant = [r for r in recs if r["event"] == "model_quantize"]
+        if len(quant) < 2:
+            failures.append(
+                f"expected a model_quantize event per publish in the "
+                f"{tag} stream, got {len(quant)}")
+        elif not all(r["serve_dtype"] == serve_dtype
+                     and r["served"] in (serve_dtype, "f32")
+                     and r["calib_n"] > 0 and r["bound"] is not None
+                     for r in quant):
+            failures.append(f"malformed model_quantize events: "
+                            f"{quant[:2]}")
+        needles += ["cocoa_serve_margin_error_bound",
+                    "cocoa_serve_dtype_fallbacks_total"]
     metrics_text = open(metrics_path).read()
-    for needle in ("cocoa_serve_qps", "cocoa_serve_requests_total",
-                   "cocoa_serve_latency_seconds_count",
-                   "cocoa_serve_batch_fill_ratio",
-                   "cocoa_model_swaps_total",
-                   "cocoa_model_gap_age_seconds"):
+    for needle in needles:
         if needle not in metrics_text:
-            failures.append(f"{needle} missing from the metrics "
+            failures.append(f"{needle} missing from the {tag} metrics "
                             f"textfile")
-
-    if failures:
-        for msg in failures:
-            print(f"serve-smoke FAIL: {msg}")
-        return 1
-    print(f"serve-smoke: OK — trained, served, hot-swapped, "
-          f"{len(swaps)} swap event(s), schema valid, gauges present "
-          f"(artifacts in {outdir})")
-    return 0
+    if not failures:
+        print(f"serve-smoke: {tag} phase OK — served, hot-swapped, "
+              f"{len(swaps)} swap event(s), schema valid, gauges "
+              f"present", flush=True)
+    return failures
 
 
 if __name__ == "__main__":

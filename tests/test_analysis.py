@@ -978,6 +978,55 @@ def test_serve_hygiene_scoped_to_serving(tmp_path):
     assert found == []
 
 
+SERVE_QUANT_IN_TRACED = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def serve_margins(w, idx, val):
+    scale = jnp.abs(w).max() / 127.0
+    wq = (w / scale).astype(jnp.int8)
+    return (wq[idx].astype(jnp.float32) * scale * val).sum(-1)
+"""
+
+SERVE_QUANT_ON_HOST = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def quantize(w):
+    # host-side swap-time quantization: abs-max scale and a narrowing
+    # cast are exactly where they belong (no jit anywhere near)
+    scale = np.abs(w).max() / 127.0
+    return (w / scale).astype(np.int8), scale
+
+@jax.jit
+def serve_margins(wq, scale, idx, val):
+    # widening back to f32 on the gathered rows is the legal direction
+    return (wq[idx].astype(jnp.float32) * scale * val).sum(-1)
+"""
+
+
+def test_serve_hygiene_quantize_in_traced_caught(tmp_path):
+    found = lint(tmp_path, SERVE_QUANT_IN_TRACED,
+                 relpath="cocoa_tpu/serving/fixture.py",
+                 rule="serve-hygiene")
+    # one finding per half of the in-graph quantize: the abs-max scale
+    # and the narrowing cast (the widening astype(float32) stays clean)
+    assert len(found) == 2, [(f.line, f.message) for f in found]
+    assert any("max-of-abs" in f.message for f in found)
+    assert any("astype(int8)" in f.message
+               and "quantize ONCE on the host" in f.message
+               for f in found)
+
+
+def test_serve_hygiene_host_quantize_and_widening_clean(tmp_path):
+    found = lint(tmp_path, SERVE_QUANT_ON_HOST,
+                 relpath="cocoa_tpu/serving/fixture.py",
+                 rule="serve-hygiene")
+    assert found == [], [(f.line, f.message) for f in found]
+
+
 def test_serve_hygiene_full_serving_tree_clean():
     """The shipped serving subsystem passes its own rule (and every
     other rule) with zero new findings."""

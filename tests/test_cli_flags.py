@@ -355,6 +355,10 @@ def test_cli_serve_flag_hardening(tmp_path, capsys):
         (["--profile=/tmp/t"], "background trainer process"),
         (["--mesh=4"], "background trainer process"),
         (["--hotCols=auto"], "needs --trainFile"),
+        # --dtype is the TRAINING precision: serving quantizes at swap
+        # time behind --serveDtype, so the training flag is rejected
+        # with the redirect instead of silently picking a serve form
+        (["--dtype=bfloat16"], "--serveDtype"),
     ]
     for extra_flags, needle in bad:
         assert main(base + extra_flags) == 2, extra_flags
@@ -371,6 +375,9 @@ def test_cli_serve_flag_hardening(tmp_path, capsys):
     assert main(["--serveMaxNnz=64", f"--chkptDir={ck}",
                  "--numFeatures=16", "--trainFile=x"]) == 2
     assert "needs --serve" in capsys.readouterr().err
+    assert main(["--serveDtype=bf16", f"--chkptDir={ck}",
+                 "--numFeatures=16", "--trainFile=x"]) == 2
+    assert "needs --serve" in capsys.readouterr().err
     for bad_flag, needle in [("--serve=notaport", "TCP port"),
                              ("--serve=70000", "TCP port")]:
         assert main([bad_flag, f"--chkptDir={ck}",
@@ -381,7 +388,9 @@ def test_cli_serve_flag_hardening(tmp_path, capsys):
                              ("--serveSlaMs=-1", "positive latency"),
                              ("--serveSlaMs=oops", "positive latency"),
                              ("--serveMaxNnz=0", "nonzero budget"),
-                             ("--serveMaxNnz=oops", "nonzero budget")]:
+                             ("--serveMaxNnz=oops", "nonzero budget"),
+                             ("--serveDtype=fp8", "f32"),
+                             ("--serveDtype=float64", "f32")]:
         assert main(base + [bad_flag]) == 2, bad_flag
         assert needle in capsys.readouterr().err
     # --serve without --chkptDir: no model source to watch

@@ -645,3 +645,308 @@ def test_serving_survives_elastic_shrink_of_trainer(tmp_path,
     np.testing.assert_array_equal(got, np.float32(expect))
     watcher.stop()
     batcher.stop()
+
+
+# --- low-precision serving (--serveDtype, docs/DESIGN.md §20) ----------------
+
+
+def _quant_stack(ck, serve_dtype, calibration=None, flip_guard=None,
+                 hot_ids=None, buckets=(4, 16)):
+    w, info = serving.load_model(ckpt_lib.latest(str(ck), "CoCoA+"))
+    slots = serving.ModelSlots(w, info, dtype=serve_dtype,
+                               calibration=calibration,
+                               flip_guard=flip_guard)
+    scorer = serving.BatchScorer(D, dtype=serve_dtype, buckets=buckets,
+                                 max_nnz=8, hot_ids=hot_ids)
+    w_dev, scale, _ = slots.current()
+    scorer.warmup(w_dev, scale)
+    return slots, scorer
+
+
+def test_quantize_round_trip_bounds():
+    """Packed-form round trips: bf16 dequantizes EXACTLY to the bf16
+    image of w (truncation is the only loss), int8 stays within half a
+    scale step, and the zero model takes the guard scale instead of a
+    divide-by-zero."""
+    import ml_dtypes
+
+    from cocoa_tpu.serving import quantize
+
+    rng = np.random.default_rng(5)
+    w = (rng.standard_normal(101) * 3.0).astype(np.float32)  # odd: padding
+    qm = quantize.quantize(w, "bf16")
+    assert qm.scale is None and qm.packed.dtype == np.uint32
+    assert qm.packed.shape == (51,)
+    deq = quantize.dequantize(qm, 101)
+    np.testing.assert_array_equal(
+        deq, w.astype(ml_dtypes.bfloat16).astype(np.float32))
+    assert np.all(np.abs(deq - w) <= np.abs(w) * 2.0 ** -8)
+    qm8 = quantize.quantize(w, "int8")
+    assert qm8.packed.dtype == np.int32 and qm8.packed.shape == (26,)
+    assert np.isclose(qm8.scale, np.abs(w).max() / 127.0, rtol=1e-6)
+    deq8 = quantize.dequantize(qm8, 101)
+    assert np.all(np.abs(deq8 - w) <= qm8.scale / 2 + 1e-7)
+    qz = quantize.quantize(np.zeros(8, np.float32), "int8")
+    assert qz.scale == 1.0
+    np.testing.assert_array_equal(quantize.dequantize(qz, 8), 0.0)
+
+
+def test_quantized_scorer_matches_dequantized_model(tmp_path):
+    """bf16/int8 compiled margins equal the margins of the DEQUANTIZED
+    model through the f64 reference — quantization is weights-only, the
+    query side never narrows."""
+    from cocoa_tpu.serving import quantize
+
+    rng = np.random.default_rng(6)
+    w32 = rng.standard_normal(D).astype(np.float32)
+    _save_model(tmp_path, w32, 10)
+    queries = _rand_queries(rng, 5)
+    for sd in ("bf16", "int8"):
+        slots, scorer = _quant_stack(tmp_path, sd)
+        assert slots.served_dtype == sd   # no calibration -> no fallback
+        wq = quantize.dequantize(quantize.quantize(w32, sd), D)
+        w_dev, scale, _ = slots.current()
+        idx, val, hot = scorer.assemble(queries, 8)
+        out = np.asarray(scorer.score(w_dev, idx, val, hot, scale))
+        for r, (qi, qv) in enumerate(queries):
+            _assert_margin(out[r], wq, qi, qv)
+
+
+def test_forced_fallback_bit_exact_to_f32_control(tmp_path):
+    """flip_guard=0.0 forces the certificate to cross on every publish:
+    the stack serves the f32 form, and its margins are BITWISE equal to
+    a --serveDtype=f32 control — fallback is a normal f32 publish
+    through the same warmed executable, not a degraded mode."""
+    rng = np.random.default_rng(7)
+    w32 = rng.standard_normal(D).astype(np.float32)
+    _save_model(tmp_path, w32, 10)
+    calib = serving.CalibrationBuffer(D, max_nnz=8, seed=3)
+    slots, scorer = _quant_stack(tmp_path, "bf16", calibration=calib,
+                                 flip_guard=0.0)
+    assert slots.served_dtype == "f32"
+    assert slots.fallbacks_total == 1
+    assert slots.last_bound is not None and slots.last_bound >= 0.0
+    ctrl_slots, ctrl_scorer, ctrl_batcher = _serving_stack(tmp_path)
+    queries = _rand_queries(rng, 6)
+    idx, val, hot = scorer.assemble(queries, 16)
+    w_dev, scale, _ = slots.current()
+    assert scale is None and w_dev.dtype == np.dtype(np.float32)
+    out = np.asarray(scorer.score(w_dev, idx, val, hot))
+    ctrl = np.asarray(ctrl_scorer.score(ctrl_slots.current()[0],
+                                        idx, val, hot))
+    np.testing.assert_array_equal(out, ctrl)
+    ctrl_batcher.stop()
+
+
+def test_quantized_hot_panel_duplicate_ids(tmp_path):
+    """The np.add.at duplicate-accumulation pin holds on the QUANTIZED
+    hot panel: a --hotCols bf16 server answers the same margins as a
+    plain bf16 one, both equal to the dequantized-model reference."""
+    from cocoa_tpu.serving import quantize
+
+    w32 = np.linspace(-1, 1, D).astype(np.float32)
+    wq = quantize.dequantize(quantize.quantize(w32, "bf16"), D)
+    _save_model(tmp_path, w32, 10)
+    qi, qv = serving.parse_query("3:1.0 3:2.0 7:1.0", D, 8)
+    outs = []
+    for ids in (None, np.array([2, 5], np.int64)):
+        slots, scorer = _quant_stack(tmp_path, "bf16", hot_ids=ids,
+                                     buckets=(4,))
+        w_dev, scale, _ = slots.current()
+        idx, val, hot = scorer.assemble([(qi, qv)], 4)
+        outs.append(np.asarray(scorer.score(w_dev, idx, val, hot,
+                                            scale))[0])
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+    _assert_margin(outs[0], wq, qi, qv)   # duplicates summed, not last
+
+
+def test_quantized_swaps_never_recompile_and_fallback_publishes(
+        tmp_path, bus):
+    """Three int8 generations: certified publish, certified swap, then
+    a certificate-crossing swap that falls back to the f32 form — ZERO
+    compiles after warmup (the fallback form is warmed up front), and
+    every publish emits a schema-valid model_quantize event."""
+    rng = np.random.default_rng(8)
+    w1 = (rng.standard_normal(D) + 2.0).astype(np.float32)
+    _save_model(tmp_path, w1, 10, gap=1e-3)
+    # capacity 8: the 8 recorded queries displace the synthetic warmup
+    # seeds, so the certificate is bound over exactly these margins
+    calib = serving.CalibrationBuffer(D, max_nnz=8, capacity=8, seed=4)
+    # single-feature unit queries: every calibrated |margin| is |w_j|
+    # (about 2), far above an int8 bound of a few centi-units
+    for j in range(8):
+        calib.record(np.array([j], np.int32),
+                     np.array([1.0], np.float32))
+    with sanitize.watch_compiles() as compiles:
+        slots, scorer = _quant_stack(tmp_path, "int8",
+                                     calibration=calib)
+        n_warm = len([c for c in compiles
+                      if "serve_margins" in c.name])
+        # two forms (int8-packed + f32 fallback) per bucket
+        assert n_warm == 2 * len(scorer.buckets)
+        assert slots.served_dtype == "int8"
+        watcher = serving.SwapWatcher(slots, str(tmp_path), "CoCoA+")
+        _save_model(tmp_path, (w1 * 0.9).astype(np.float32), 20,
+                    gap=1e-4)
+        assert watcher.poll_once()
+        assert slots.served_dtype == "int8"
+        w_dev, scale, _ = slots.current()
+        idx, val, hot = scorer.assemble(_rand_queries(rng, 3), 4)
+        np.asarray(scorer.score(w_dev, idx, val, hot, scale))
+        # a near-zero-margin calibration query drops the weakest
+        # calibrated |margin| under the bound: the next publish must
+        # fall back to f32 WITHOUT compiling anything
+        calib.record(np.array([0], np.int32),
+                     np.array([1e-6], np.float32))
+        _save_model(tmp_path, (w1 * 0.8).astype(np.float32), 30,
+                    gap=1e-5)
+        assert watcher.poll_once()
+        assert slots.served_dtype == "f32"
+        assert slots.fallbacks_total == 1
+        w_dev, scale, _ = slots.current()
+        assert scale is None
+        np.asarray(scorer.score(w_dev, idx, val, hot))
+        total = len([c for c in compiles
+                     if "serve_margins" in c.name])
+    assert total == n_warm, (
+        f"quantized swaps recompiled: {total} vs warmup {n_warm}")
+    assert watcher.swaps_total == 2
+    evs = [e for e in _read_events(bus)
+           if e["event"] == "model_quantize"]
+    assert [e["served"] for e in evs] == ["int8", "int8", "f32"]
+    assert [e["fallback"] for e in evs] == [0, 0, 1]
+    assert evs[-1]["serve_dtype"] == "int8"
+    assert all(e["calib_n"] > 0 and e["bound"] is not None
+               for e in evs)
+    assert tele_schema.check_file(str(bus)) == []
+
+
+def test_scorer_and_batcher_reject_form_mismatch(tmp_path):
+    """Direct construction with mismatched dtypes is rejected with the
+    numbers at every seam: batcher ctor, score() form check, and the
+    int8 scale-pairing check."""
+    _save_model(tmp_path, np.linspace(-1, 1, D).astype(np.float32), 10)
+    w, info = serving.load_model(ckpt_lib.latest(str(tmp_path),
+                                                 "CoCoA+"))
+    slots_bf16 = serving.ModelSlots(w, info, dtype="bf16")
+    scorer_f32 = serving.BatchScorer(D, dtype="f32", buckets=(4,),
+                                     max_nnz=8)
+    with pytest.raises(ValueError, match="serve dtype mismatch"):
+        serving.MicroBatcher(scorer_f32, slots_bf16)
+    idx, val, hot = scorer_f32.assemble([], 4)
+    with pytest.raises(serving.QueryError,
+                       match=r"model form mismatch.*uint32"):
+        scorer_f32.score(slots_bf16.current()[0], idx, val, hot)
+    slots_i8 = serving.ModelSlots(w, info, dtype="int8")
+    scorer_i8 = serving.BatchScorer(D, dtype="int8", buckets=(4,),
+                                    max_nnz=8)
+    w_dev, scale, _ = slots_i8.current()
+    with pytest.raises(serving.QueryError, match="scale mismatch"):
+        scorer_i8.score(w_dev, idx, val, hot)       # dropped the scale
+    # the f32 fallback form must NOT carry a scale
+    import jax
+
+    w_f32_dev = jax.device_put(np.asarray(w, np.float32))
+    with pytest.raises(serving.QueryError, match="scale mismatch"):
+        scorer_i8.score(w_f32_dev, idx, val, hot,
+                        scale=np.float32(1.0))
+
+
+@pytest.mark.slow
+def test_quantized_swap_under_sustained_traffic(tmp_path, bus):
+    """The PR-13 drops-nothing guarantee holds under --serveDtype:
+    sustained traffic through the micro-batcher while generations swap
+    (quantize + certify in the publish path), zero failed queries, and
+    the final answers match the dequantized final model."""
+    from cocoa_tpu.serving import quantize
+
+    rng = np.random.default_rng(9)
+    w = (rng.standard_normal(D) + 1.5).astype(np.float32)
+    _save_model(tmp_path, w, 10, gap=1e-3)
+    calib = serving.CalibrationBuffer(D, max_nnz=8, seed=5)
+    w0, info = serving.load_model(ckpt_lib.latest(str(tmp_path),
+                                                  "CoCoA+"))
+    # flip_guard=1.0 pins the certificate OPEN for this test: client
+    # queries are random, and a chance near-zero margin in the
+    # calibration ring would trigger a legitimate fallback — correct
+    # behavior, but this test pins the quantized traffic path, not the
+    # certificate policy (covered above)
+    slots = serving.ModelSlots(w0, info, dtype="bf16",
+                               calibration=calib, flip_guard=1.0)
+    scorer = serving.BatchScorer(D, dtype="bf16", buckets=(4, 16),
+                                 max_nnz=8)
+    w_dev, scale, _ = slots.current()
+    scorer.warmup(w_dev, scale)
+    batcher = serving.MicroBatcher(scorer, slots, sla_s=0.02,
+                                   calibration=calib)
+    watcher = serving.SwapWatcher(slots, str(tmp_path), "CoCoA+",
+                                  poll_s=0.02).start()
+    stop = threading.Event()
+    failures = []
+    answered = [0]
+
+    def client(seed):
+        crng = np.random.default_rng(seed)
+        while not stop.is_set():
+            n = int(crng.integers(1, 9))
+            qi = np.sort(crng.choice(D, size=n,
+                                     replace=False)).astype(np.int32)
+            qv = crng.standard_normal(n)
+            try:
+                batcher.score_sync(qi, qv, timeout=10.0)
+                answered[0] += 1
+            except Exception as e:   # noqa: BLE001 - recorded, asserted
+                failures.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(s,), daemon=True)
+               for s in range(3)]
+    for t in threads:
+        t.start()
+    w_gen = w
+    for gen in range(3):
+        time.sleep(0.3)
+        w_gen = (w_gen * 0.9).astype(np.float32)
+        _save_model(tmp_path, w_gen, 20 + 10 * gen, gap=1e-4)
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and slots.info.round != 40:
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    watcher.stop()
+    assert failures == [], f"queries failed: {failures[:3]}"
+    assert answered[0] > 10
+    assert slots.info.round == 40
+    assert slots.served_dtype == "bf16"
+    wq = quantize.dequantize(quantize.quantize(w_gen, "bf16"), D)
+    qi, qv = serving.parse_query("1:1.0 5:-2.0", D, 8)
+    got = batcher.score_sync(qi, qv, timeout=10.0)
+    _assert_margin(got, wq, qi, qv)
+    batcher.stop()
+
+
+def test_quantize_metrics_families_rendered(tmp_path):
+    """model_quantize events drive the two certificate families; runs
+    that never quantize must not render them."""
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    path = str(tmp_path / "m.prom")
+    wtr = MetricsWriter(path)
+    base = {"seq": 1, "pid": 1, "ts": 1000.0, "algorithm": "serve",
+            "serve_dtype": "bf16", "calib_n": 64, "scale": None,
+            "event": "model_quantize"}
+    wtr({**base, "served": "bf16", "round": 10, "swap_seq": 1,
+         "bound": 0.01, "flips": 0, "fallback": 0})
+    text = open(path).read()
+    assert "cocoa_serve_margin_error_bound 0.01" in text
+    assert "cocoa_serve_dtype_fallbacks_total 0" in text
+    wtr({**base, "served": "f32", "round": 11, "swap_seq": 2,
+         "bound": 0.5, "flips": 3, "fallback": 1})
+    text = open(path).read()
+    assert "cocoa_serve_dtype_fallbacks_total 1" in text
+    assert "cocoa_serve_margin_error_bound 0.5" in text
+    # training-only runs never render the quantization families
+    clean = str(tmp_path / "clean.prom")
+    MetricsWriter(clean)
+    assert "cocoa_serve_margin_error_bound" not in open(clean).read()
+    assert "cocoa_serve_dtype_fallbacks" not in open(clean).read()
