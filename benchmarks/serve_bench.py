@@ -53,6 +53,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue
+import signal
+import socket
 import sys
 import tempfile
 import threading
@@ -86,6 +89,16 @@ CALIB_N = 64        # calibration queries the certificate is bound over
 # control scorer compiles its one form; the quantized scorer compiles
 # its packed form plus the f32 certificate-fallback form
 EXPECTED_COMPILES_Q = 3
+
+# the --serveReplicas fleet mode (docs/DESIGN.md §21): R real CLI
+# replica processes serving a (T, d) tenant catalogue behind the
+# in-bench router, hammered over real sockets with tenant-tagged
+# multi-query lines; the headline is aggregate answered queries/s vs
+# the SAME-harness 1-replica control (scaling_eff), plus the open-loop
+# overload window's shed accounting and the SIGKILL recovery drill
+T_FLEET = 4
+Q_PER_LINE = 16     # ';'-separated queries per protocol line
+FLEET_LINES = 64    # distinct preassembled lines cycled per client
 
 
 def train_checkpoints(ck: str):
@@ -361,6 +374,352 @@ def measure_quant(serve_dtype: str, duration_s: float, sla_ms: float):
     }
 
 
+def _fleet_lines(rng, n_lines):
+    """Preassembled tenant-tagged protocol lines: each carries
+    ``Q_PER_LINE`` nnz-12 queries for one tenant, tenants round-robin
+    across lines so every window is cross-tenant traffic."""
+    import numpy as np
+
+    lines = []
+    for j in range(n_lines):
+        qs = []
+        for _ in range(Q_PER_LINE):
+            idx = np.sort(rng.choice(D, size=QUERY_NNZ, replace=False))
+            val = rng.standard_normal(QUERY_NNZ)
+            qs.append(" ".join(f"{int(i)}:{float(v):.5f}"
+                               for i, v in zip(idx, val)))
+        lines.append((f"tenant={j % T_FLEET};" + ";".join(qs)
+                      + "\n").encode())
+    return lines
+
+
+class _ClientStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.answered = 0    # queries (lines x Q_PER_LINE)
+        self.shed = 0        # lines refused at admission
+        self.failed = 0      # lines that got an error / dead socket
+        self.lats = []       # per-line seconds, answered lines only
+
+    def record(self, resp, dt):
+        with self.lock:
+            if isinstance(resp, list):
+                self.answered += len(resp)
+                self.lats.append(dt)
+            elif isinstance(resp, dict) and resp.get("shed"):
+                self.shed += 1
+            else:
+                self.failed += 1
+
+
+def _ask_lines(addr, lines, stats, stop_ev, stride, offset):
+    """One closed-loop client connection: send, read, classify, repeat
+    until stopped."""
+    try:
+        s = socket.create_connection(addr, timeout=30)
+        s.settimeout(60)
+    except OSError:
+        with stats.lock:
+            stats.failed += 1
+        return
+    f = s.makefile("rwb")
+    n = offset
+    while not stop_ev.is_set():
+        line = lines[n % len(lines)]
+        n += stride
+        t0 = time.monotonic()
+        try:
+            f.write(line)
+            f.flush()
+            resp = json.loads(f.readline())
+        except (OSError, ValueError):
+            with stats.lock:
+                stats.failed += 1
+            break
+        stats.record(resp, time.monotonic() - t0)
+    try:
+        s.close()
+    except OSError:
+        pass
+
+
+def _closed_window(addr, lines, n_conn, duration_s, midpoint=None):
+    """Closed-loop capacity window: ``n_conn`` connections back to
+    back; ``midpoint`` (if given) runs at the half mark — the mid-bench
+    catalogue hot-swap rides it."""
+    stats = _ClientStats()
+    stop_ev = threading.Event()
+    workers = [threading.Thread(target=_ask_lines,
+                                args=(addr, lines, stats, stop_ev,
+                                      n_conn, c), daemon=True)
+               for c in range(n_conn)]
+    t0 = time.monotonic()
+    for t in workers:
+        t.start()
+    time.sleep(duration_s / 2)
+    if midpoint is not None:
+        midpoint()
+    time.sleep(duration_s / 2)
+    stop_ev.set()
+    for t in workers:
+        t.join(30)
+    return stats, time.monotonic() - t0
+
+
+def _open_window(addr, lines, n_senders, duration_s, rate_qps):
+    """Open-loop overload window: a pacer enqueues line tickets at the
+    offered rate regardless of completions (no coordinated omission);
+    senders drain against the router, whose admission control sheds
+    rather than queueing into an SLA violation."""
+    stats = _ClientStats()
+    stop_ev = threading.Event()
+    tickets: "queue.Queue" = queue.Queue()
+    offered = [0]
+
+    def pacer():
+        period = Q_PER_LINE / rate_qps
+        nxt = time.monotonic()
+        end = nxt + duration_s
+        while time.monotonic() < end:
+            tickets.put(offered[0])
+            offered[0] += 1
+            nxt += period
+            pause = nxt - time.monotonic()
+            if pause > 0:
+                time.sleep(pause)
+        stop_ev.set()
+
+    def sender():
+        try:
+            s = socket.create_connection(addr, timeout=30)
+            s.settimeout(60)
+        except OSError:
+            with stats.lock:
+                stats.failed += 1
+            return
+        f = s.makefile("rwb")
+        while True:
+            try:
+                i = tickets.get(timeout=0.2)
+            except queue.Empty:
+                if stop_ev.is_set():
+                    break
+                continue
+            t0 = time.monotonic()
+            try:
+                f.write(lines[i % len(lines)])
+                f.flush()
+                resp = json.loads(f.readline())
+            except (OSError, ValueError):
+                with stats.lock:
+                    stats.failed += 1
+                break
+            stats.record(resp, time.monotonic() - t0)
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    threads = [threading.Thread(target=pacer, daemon=True)]
+    threads += [threading.Thread(target=sender, daemon=True)
+                for _ in range(n_senders)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 120)
+    return stats, time.monotonic() - t0, offered[0] * Q_PER_LINE
+
+
+def _fleet_harness(ck, n_replicas, route, sla_ms, evdir, tag):
+    """Spawn ``n_replicas`` REAL CLI serve processes against the
+    catalogue and put a router in front (the same classes the CLI
+    fleet path composes)."""
+    from cocoa_tpu.serving.fleet import ServeFleet
+    from cocoa_tpu.serving.router import Router
+
+    fleet = ServeFleet(
+        [f"--chkptDir={ck}", f"--numFeatures={D}",
+         "--serveBatch=" + ",".join(str(b) for b in BUCKETS),
+         f"--serveSlaMs={sla_ms:g}", f"--serveMaxNnz={MAX_NNZ}",
+         "--quiet"],
+        n_replicas,
+        extra_argv_fn=lambda i: [f"--events={evdir}/{tag}{i}.jsonl"],
+        # the persistent XLA cache would hide warmup compiles from the
+        # one-compile-per-bucket accounting — count real compiles
+        env={"JAX_PLATFORMS": "cpu", "COCOA_NO_COMPILE_CACHE": "1"})
+    router = Router(fleet.start(), sla_s=sla_ms / 1000.0, route=route)
+    fleet.attach(router)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return fleet, router
+
+
+def _replica_stream_counts(path):
+    """(serve_margins compiles, injected-swap events) in one replica's
+    event stream."""
+    compiles = swaps = 0
+    if os.path.exists(path):
+        for ln in open(path):
+            r = json.loads(ln)
+            if (r.get("event") == "compile"
+                    and "serve_margins" in r.get("name", "")):
+                compiles += 1
+            elif (r.get("event") == "model_swap"
+                  and r.get("round") == 2):
+                swaps += 1
+    return compiles, swaps
+
+
+def measure_fleet(n_replicas, route, duration_s, threads, sla_ms,
+                  rate_qps):
+    """The ``--serveReplicas`` row: aggregate socket-path qps of R
+    replicas vs the same-harness 1-replica control, the open-loop
+    overload window's shed accounting, and the SIGKILL recovery drill
+    (requeue, respawn, zero failed queries)."""
+    import numpy as np
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+
+    rng = np.random.default_rng(23)
+    w_cat = (rng.standard_normal((T_FLEET, D)) * 0.05).astype(
+        np.float32)
+    ck = tempfile.mkdtemp(prefix="serve-bench-fleet-")
+    ckpt_lib.save(ck, "CoCoA+", 1, (w_cat * 0.95).astype(np.float32),
+                  None, gap=GAP_TARGET)
+    evdir = tempfile.mkdtemp(prefix="serve-bench-fleet-ev-")
+    lines = _fleet_lines(rng, FLEET_LINES)
+    n_conn = max(4, threads)
+    t_start = time.monotonic()
+
+    print(f"serve_bench: spawning {n_replicas} fleet replicas "
+          f"(catalogue {w_cat.shape}, route={route})", flush=True)
+    fleet, router = _fleet_harness(ck, n_replicas, route, sla_ms,
+                                   evdir, "rep")
+    try:
+        # --- capacity: closed loop, catalogue hot-swap at the half ---
+        cap, cap_wall = _closed_window(
+            router.address, lines, n_conn, duration_s,
+            midpoint=lambda: ckpt_lib.save(ck, "CoCoA+", 2, w_cat,
+                                           None, gap=GAP_TARGET))
+        qps = cap.answered / cap_wall
+        print(f"serve_bench: fleet capacity {qps:.0f} qps "
+              f"({cap.answered} answered / {cap_wall:.2f}s)",
+              flush=True)
+
+        # --- overload: open loop past capacity — shed, don't queue ---
+        if rate_qps <= 0:
+            rate_qps = round(4 * qps)
+        over, _, offered = _open_window(router.address, lines,
+                                        2 * n_conn, duration_s / 2,
+                                        rate_qps)
+        print(f"serve_bench: overload window offered {offered} "
+              f"queries at {rate_qps:g} qps — "
+              f"{over.answered} answered, {over.shed} lines shed",
+              flush=True)
+
+        # both replicas must observe the injected generation before
+        # the kill drill (the victim's swap event dies with it)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(_replica_stream_counts(
+                    f"{evdir}/rep{i}.jsonl")[1] >= 1
+                   for i in range(n_replicas)):
+                break
+            time.sleep(0.5)
+
+        # --- the SIGKILL drill: requeue + respawn, zero failures -----
+        # the connection is opened BEFORE the kill and the lines go out
+        # sequentially right after it, so the first ones race the fleet
+        # monitor to the dead replica — the requeue path, not just the
+        # rerouted one, is in the drill
+        drill = _ClientStats()
+        victim = fleet.replicas[0]
+        s = socket.create_connection(router.address, timeout=30)
+        s.settimeout(60)
+        sf = s.makefile("rwb")
+        os.kill(victim.pid, signal.SIGKILL)
+        print(f"serve_bench: SIGKILLed replica r0 (pid {victim.pid})",
+              flush=True)
+        for j in range(30):
+            t0 = time.monotonic()
+            sf.write(lines[j % len(lines)])
+            sf.flush()
+            drill.record(json.loads(sf.readline()),
+                         time.monotonic() - t0)
+        s.close()
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and (
+                victim.restarts < 1
+                or router.replicas_live() < n_replicas):
+            time.sleep(0.5)
+        respawned = (victim.restarts >= 1
+                     and router.replicas_live() == n_replicas)
+        tail, _ = _closed_window(router.address, lines, 2, 1.0)
+        print(f"serve_bench: kill window answered "
+              f"{drill.answered + tail.answered} queries, "
+              f"respawned={respawned}", flush=True)
+
+        processes = [1 + r.restarts for r in fleet.replicas]
+        shed_total = int(router.shed_total)
+        requeued = int(router.requeue_total)
+        failed = (int(router.failed_total) + cap.failed + over.failed
+                  + drill.failed + tail.failed)
+    finally:
+        router.stop()
+        fleet.stop()
+        router.close()
+
+    # --- same-harness 1-replica control --------------------------------
+    print("serve_bench: measuring the 1-replica control", flush=True)
+    ctl_fleet, ctl_router = _fleet_harness(ck, 1, "rr", sla_ms, evdir,
+                                           "ctl")
+    try:
+        ctl, ctl_wall = _closed_window(ctl_router.address, lines,
+                                       n_conn, duration_s)
+        failed += ctl.failed + int(ctl_router.failed_total)
+    finally:
+        ctl_router.stop()
+        ctl_fleet.stop()
+        ctl_router.close()
+    control_qps = ctl.answered / ctl_wall
+
+    counts = [_replica_stream_counts(f"{evdir}/rep{i}.jsonl")
+              for i in range(n_replicas)]
+    # each replica PROCESS compiles one executable per bucket; the
+    # respawned victim appends its own warmup to the same stream, so
+    # divide by the process count before comparing across replicas
+    per_proc = set()
+    for (c, _), p in zip(counts, processes):
+        per_proc.add(c // p if c % p == 0 else -1)
+    compiles = per_proc.pop() if len(per_proc) == 1 else -1
+    swaps = sum(1 for _, s in counts if s >= 1)
+
+    lats = sorted(cap.lats)
+
+    def pct(p):
+        return lats[min(len(lats) - 1, int(p * len(lats)))] * 1000.0
+
+    return {
+        "config": "serve-cpu-fleet", "type": "serve", "device": "cpu",
+        "d": D, "tenants": T_FLEET, "replicas": n_replicas,
+        "route": route, "threads": n_conn,
+        "queries": cap.answered,
+        "qps": round(qps, 1),
+        "control_qps": round(control_qps, 1),
+        "scaling_eff": round(qps / (n_replicas * control_qps), 3),
+        "rate_qps": float(rate_qps),
+        "shed": shed_total, "requeued": requeued, "failed": failed,
+        "p50_ms": round(pct(0.50), 3), "p99_ms": round(pct(0.99), 3),
+        "sla_ms": sla_ms,
+        "buckets": "/".join(str(b) for b in BUCKETS),
+        "compiles": compiles, "swaps": swaps, "killed": 1,
+        "wallclock_s": round(time.monotonic() - t_start, 3),
+        "stopped": ("target" if failed == 0 and respawned
+                    and swaps >= n_replicas
+                    and compiles == len(BUCKETS) else None),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--row", default=None,
@@ -378,7 +737,54 @@ def main(argv=None) -> int:
                          "the acceptance bar a COMMITTED row must hold; "
                          "CI fresh re-runs pass a catastrophic floor "
                          "instead (shared-runner wall-clock)")
+    ap.add_argument("--correctness-only", action="store_true",
+                    help="skip the qps_ratio bar and gate only the "
+                         "correctness axes (flips / compiles / swap): "
+                         "the int8 A/B row commits under this — XLA's "
+                         "CPU backend emulates int8 unpack, so its CPU "
+                         "throughput is not the claim, the certificate "
+                         "machinery is")
+    ap.add_argument("--serveReplicas", type=int, default=0,
+                    help="fleet mode: spawn this many REAL CLI scorer "
+                         "replicas behind the router and measure "
+                         "aggregate qps vs a 1-replica control "
+                         "(the serve-cpu-fleet row)")
+    ap.add_argument("--route", default="tenant",
+                    choices=("rr", "tenant"),
+                    help="fleet routing policy for the fleet row")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop offered rate (queries/s) for the "
+                         "fleet overload window; 0 = 4x the measured "
+                         "capacity")
     args = ap.parse_args(argv)
+
+    if args.serveReplicas >= 2:
+        row = measure_fleet(args.serveReplicas, args.route,
+                            args.duration, args.threads, args.sla_ms,
+                            args.rate)
+        print(json.dumps(row))
+        if args.row:
+            with open(args.row, "w") as f:
+                f.write(json.dumps(row) + "\n")
+        failures = []
+        if row["failed"] != 0:
+            failures.append(f"{row['failed']} failed queries — a dead "
+                            f"replica must requeue, never fail")
+        if row["compiles"] != len(BUCKETS):
+            failures.append(f"compiles per replica process "
+                            f"{row['compiles']} != {len(BUCKETS)} — "
+                            f"the catalogue or the fleet broke the "
+                            f"one-compile-per-(bucket, dtype) pin")
+        if row["swaps"] < args.serveReplicas:
+            failures.append(f"only {row['swaps']}/{args.serveReplicas} "
+                            f"replicas observed the injected catalogue "
+                            f"generation")
+        if row["stopped"] != "target":
+            failures.append("the SIGKILLed replica was not respawned "
+                            "and folded back into routing")
+        for msg in failures:
+            print(f"serve_bench FAIL: {msg}", file=sys.stderr)
+        return 1 if failures else 0
 
     if args.serveDtype != "f32":
         print(f"serve_bench: {args.serveDtype} A/B at d={D_Q} "
@@ -391,7 +797,8 @@ def main(argv=None) -> int:
             with open(args.row, "w") as f:
                 f.write(json.dumps(row) + "\n")
         failures = []
-        if row["qps_ratio"] < args.ratio_bar:
+        if (not args.correctness_only
+                and row["qps_ratio"] < args.ratio_bar):
             failures.append(f"qps_ratio {row['qps_ratio']} < "
                             f"{args.ratio_bar:g} — the packed "
                             f"{args.serveDtype} path lost its "

@@ -185,7 +185,8 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "metricsInterval", "overlapComm",
                 "staleRounds", "fleet", "fleetLanes",
                 "serve", "serveBatch", "serveSlaMs",
-                "serveMaxNnz", "serveDtype")  # run-level
+                "serveMaxNnz", "serveDtype", "serveReplicas",
+                "serveRoute")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -486,7 +487,10 @@ def main(argv=None) -> int:
                       ("serveSlaMs", "sets the p99 latency budget"),
                       ("serveMaxNnz", "sets the per-query nonzero "
                                       "budget"),
-                      ("serveDtype", "sets the serving precision")):
+                      ("serveDtype", "sets the serving precision"),
+                      ("serveReplicas", "scales the scorer fleet"),
+                      ("serveRoute", "selects the fleet routing "
+                                     "policy")):
         if extras[dep] and not serve_flag:
             print(f"error: --{dep} {what} of the serving loop and needs "
                   f"--serve", file=sys.stderr)
@@ -528,7 +532,7 @@ def main(argv=None) -> int:
             # serve flags, the model source, the query-side layout, and
             # the observability flags every mode shares
             "serve", "serveBatch", "serveSlaMs", "serveMaxNnz",
-            "serveDtype", "chkptDir",
+            "serveDtype", "serveReplicas", "serveRoute", "chkptDir",
             "numFeatures", "trainFile", "hotCols", "quiet",
             "metrics", "events", "trace", "flightRecorder",
             "eventsMaxMB", "metricsInterval", "seed",
@@ -553,6 +557,49 @@ def main(argv=None) -> int:
                   "hot panel is the TRAINED column split, resolved from "
                   "the training data's column histogram "
                   "(data/hybrid.py)", file=sys.stderr)
+            return 2
+        # --serveReplicas=N scales the scorer fleet behind a router
+        # front door (serving/fleet.py + router.py, docs/DESIGN.md
+        # §21); --serveRoute picks its routing policy.  Validated HERE
+        # (before any JAX work) so a typo fails in milliseconds
+        n_replicas = 1
+        if extras["serveReplicas"]:
+            import os
+            try:
+                n_replicas = int(extras["serveReplicas"])
+            except ValueError:
+                n_replicas = 0
+            if n_replicas < 1:
+                print(f"error: --serveReplicas takes a replica count "
+                      f">= 1, got {extras['serveReplicas']!r}",
+                      file=sys.stderr)
+                return 2
+            cores = os.cpu_count() or 1
+            if n_replicas > cores:
+                print(f"warning: --serveReplicas={n_replicas} "
+                      f"oversubscribes the {cores} detected core(s): "
+                      f"replicas time-share cores and per-replica "
+                      f"scaling efficiency degrades — measure before "
+                      f"trusting a fleet this wide", file=sys.stderr)
+        if extras["serveRoute"]:
+            from cocoa_tpu.serving.router import Router as _Router
+            if extras["serveRoute"] not in _Router.ROUTES:
+                print(f"error: --serveRoute takes one of "
+                      f"{'/'.join(_Router.ROUTES)}, got "
+                      f"{extras['serveRoute']!r}", file=sys.stderr)
+                return 2
+            if n_replicas < 2:
+                print("error: --serveRoute picks how the fleet router "
+                      "spreads queries and needs --serveReplicas>=2 "
+                      "(one replica has nothing to route between)",
+                      file=sys.stderr)
+                return 2
+        if n_replicas >= 2 and extras["hotCols"] is not None:
+            print("error: --hotCols does not combine with "
+                  "--serveReplicas>=2: per-replica hot panels are not "
+                  "in the fleet v1 surface — serve the hybrid layout "
+                  "from a single process, or drop --hotCols "
+                  "(docs/DESIGN.md §21)", file=sys.stderr)
             return 2
 
     # --profile=DIR traces the whole run; --profile=DIR,START,STOP traces
@@ -1983,6 +2030,90 @@ def _run_fleet_cli(cfg, extras, quiet, bus, cfg_manifest, fleet_lanes,
     return 0
 
 
+def _run_serve_fleet(cfg, extras, quiet, bus, port, buckets, sla_ms,
+                     max_nnz, serve_dtype, n_replicas, route,
+                     algorithm, n_tenants):
+    """The ``--serveReplicas>=2`` execution path (docs/DESIGN.md §21):
+    spawn N ordinary single-process serve replicas against the same
+    validated --chkptDir (each hot-swaps independently; slabs and
+    checkpoints share the host page cache, so RSS stays ~one copy),
+    put the router front door on the requested port, and relay the
+    line protocol until ``shutdown`` or SIGTERM.  The front door holds
+    no model and no JAX — replica death is a requeue, never a failed
+    query, and the monitor respawns the dead."""
+    import signal
+
+    from cocoa_tpu.serving.fleet import ServeFleet
+    from cocoa_tpu.serving.router import Router
+
+    rep_argv = [f"--chkptDir={cfg.chkpt_dir}",
+                f"--numFeatures={cfg.num_features}",
+                "--serveBatch=" + ",".join(str(b) for b in buckets),
+                f"--serveSlaMs={sla_ms:g}",
+                f"--serveMaxNnz={max_nnz}",
+                f"--serveDtype={serve_dtype}", "--quiet"]
+    # per-replica telemetry sinks ride the front door's --events path
+    # with an .r<i> suffix — how the smoke counts compiles per replica
+    extra_fn = None
+    if extras["events"]:
+        ev_path = extras["events"]
+        extra_fn = (lambda i: [f"--events={ev_path}.r{i}"])
+
+    def echo(s):
+        # replica pid/port notes are operational plumbing (the smoke
+        # parses them for the SIGKILL drill) — printed even under
+        # --quiet, like the announce line
+        print(f"serve: {s}", flush=True)
+
+    fleet = ServeFleet(rep_argv, n_replicas, extra_argv_fn=extra_fn,
+                       echo=echo)
+    try:
+        members = fleet.start()
+    except RuntimeError as e:
+        fleet.stop()
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    router = Router(members, sla_s=sla_ms / 1000.0, route=route,
+                    port=port, algorithm=algorithm)
+    fleet.attach(router)
+    router.emit_initial_state()
+    host, bound = router.address[0], router.address[1]
+    catalogue = ("" if n_tenants is None
+                 else f", tenants={n_tenants}")
+    print(f"serve: fleet listening on {host}:{bound} "
+          f"(replicas={n_replicas}, route={route}, "
+          f"buckets={','.join(str(b) for b in buckets)}, "
+          f"slaMs={sla_ms:g}, maxNnz={max_nnz}, dtype={serve_dtype}"
+          f"{catalogue})", flush=True)
+
+    writer = getattr(bus, "metrics_writer", None)
+    if writer is not None:
+        writer.start_heartbeat(5.0)
+
+    def _stop(signum, frame):
+        router.stop()
+
+    prev = [signal.signal(signal.SIGTERM, _stop),
+            signal.signal(signal.SIGINT, _stop)]
+    try:
+        router.serve_forever()
+    finally:
+        signal.signal(signal.SIGTERM, prev[0])
+        signal.signal(signal.SIGINT, prev[1])
+        if writer is not None:
+            writer.stop_heartbeat()
+        fleet.stop()
+        router.close()
+    if bus.active():
+        bus.emit("run_end", algorithm=algorithm, stopped="shutdown")
+    if not quiet:
+        print(f"serve: fleet shut down after {router.forwarded_total} "
+              f"forwarded line(s), {router.shed_total} shed, "
+              f"{router.requeue_total} requeued, "
+              f"{router.failed_total} failed")
+    return 0
+
+
 def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
     """The ``--serve`` execution path (cocoa_tpu/serving/,
     docs/DESIGN.md §17): wait for the first VALIDATED checkpoint
@@ -2042,6 +2173,12 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    # --serveReplicas/--serveRoute (validated in main(), parsed again
+    # here): >= 2 switches to the fleet branch — a router front door
+    # over N spawned single-process replicas (docs/DESIGN.md §21)
+    n_replicas = (int(extras["serveReplicas"])
+                  if extras["serveReplicas"] else 1)
+    route = extras["serveRoute"] or "rr"
 
     d = cfg.num_features
     dtype = jnp.dtype(cfg.dtype)
@@ -2101,13 +2238,33 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
     # the trained width may exceed --numFeatures by lane padding (the
     # loader pads d up; the pad columns carry no data, so their w slots
     # are inert) — queries only ever gather ids < numFeatures.  A model
-    # NARROWER than the query surface is a real mismatch.
-    if w.ndim != 1 or w.shape[0] < d:
+    # NARROWER than the query surface is a real mismatch.  A 2-D (T, d)
+    # checkpoint is a served CATALOGUE of T tenant models (the fleet
+    # trainer's stacked w, docs/DESIGN.md §21): queries then carry a
+    # tenant=<id>; prefix and the width rule applies to each row.
+    n_tenants = int(w.shape[0]) if w.ndim == 2 else None
+    if w.ndim not in (1, 2) or w.shape[-1] < d \
+            or (w.ndim == 2 and w.shape[0] < 1):
         print(f"error: the serving checkpoint {path} carries w of shape "
               f"{tuple(w.shape)} but --numFeatures={d} — the query "
-              f"width must fit inside the trained width (fix the flag "
+              f"width must fit inside the trained width, as a (d,) "
+              f"model or a (T, d) tenant catalogue (fix the flag "
               f"or point --chkptDir at the right model)",
               file=sys.stderr)
+        return 2
+    if n_tenants is not None and serve_dtype != "f32":
+        print(f"error: --serveDtype={serve_dtype} does not combine "
+              f"with a (T, d) tenant catalogue (this checkpoint: "
+              f"{tuple(w.shape)}): per-tenant quantization "
+              f"certificates are not in the fleet v1 surface — serve "
+              f"the catalogue at f32 (docs/DESIGN.md §21)",
+              file=sys.stderr)
+        return 2
+    if n_tenants is not None and hot_ids is not None:
+        print(f"error: --hotCols does not combine with a (T, d) tenant "
+              f"catalogue (this checkpoint: {tuple(w.shape)}): "
+              f"per-tenant hot panels are not in the fleet v1 surface "
+              f"(docs/DESIGN.md §21)", file=sys.stderr)
         return 2
 
     if bus.active():
@@ -2117,9 +2274,17 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
             "algorithm": algorithm, "buckets": list(buckets),
             "sla_ms": sla_ms, "max_nnz": max_nnz, "num_features": d,
             "hot_cols": 0 if hot_ids is None else int(len(hot_ids)),
-            "serve_dtype": serve_dtype,
+            "serve_dtype": serve_dtype, "replicas": n_replicas,
+            "route": route,
+            "tenants": 0 if n_tenants is None else n_tenants,
         }
         bus.emit("run_start", manifest=manifest)
+
+    if n_replicas >= 2:
+        return _run_serve_fleet(cfg, extras, quiet, bus, port, buckets,
+                                sla_ms, max_nnz, serve_dtype,
+                                n_replicas, route, algorithm,
+                                n_tenants)
 
     # the calibration ring the per-swap certificate is computed over:
     # warmup-seeded now, refilled by real traffic as it arrives
@@ -2130,7 +2295,8 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
                                calibration=calib, algorithm=algorithm)
     scorer = serving.BatchScorer(d, dtype=serve_dtype, buckets=buckets,
                                  max_nnz=max_nnz, hot_ids=hot_ids,
-                                 model_width=int(w.shape[0]))
+                                 model_width=int(w.shape[-1]),
+                                 n_tenants=n_tenants)
     serving.watcher.emit_model_swap(algorithm, info)   # the initial load
     with tracing.span("serve_warmup", buckets=len(buckets)):
         w_dev, scale, _ = slots.current()
@@ -2163,14 +2329,17 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
 
     watcher = serving.SwapWatcher(slots, cfg.chkpt_dir, algorithm,
                                   poll_s=0.25, on_swap=note_swap).start()
-    server = serving.MarginServer(batcher, d, max_nnz, port=port)
+    server = serving.MarginServer(batcher, d, max_nnz, port=port,
+                                  n_tenants=n_tenants)
     host, bound = server.address[0], server.address[1]
     # the announce line is operational plumbing (the smoke parses it),
     # not chatter — it prints even under --quiet
+    catalogue = ("" if n_tenants is None
+                 else f", tenants={n_tenants}")
     print(f"serve: listening on {host}:{bound} "
           f"(buckets={','.join(str(b) for b in buckets)}, "
-          f"slaMs={sla_ms:g}, maxNnz={max_nnz}, dtype={serve_dtype})",
-          flush=True)
+          f"slaMs={sla_ms:g}, maxNnz={max_nnz}, dtype={serve_dtype}"
+          f"{catalogue})", flush=True)
 
     # gap-age heartbeat: the freshness gauge renders `now - birth` at
     # WRITE time, and writes are otherwise event-driven — a dead trainer

@@ -147,11 +147,32 @@ def serve_margins(w: jax.Array, shard: dict, scale=None) -> jax.Array:
     (a new scale per swap never retraces); it multiplies the reduced
     margins once — the hot panel term gathers the same quantized model,
     so panel + residual share the one scale.
+
+    **Catalogue mode** (the multi-tenant fleet, docs/DESIGN.md §21): a
+    2-D ``w`` of shape ``(T, d)`` is a served catalogue of T tenant
+    models, and the shard carries a per-row ``"tenant"`` vector
+    (``(bucket,)`` int32).  Row r then scores against ``w[tenant[r]]``
+    via ONE flat gather — ``w.reshape(-1)[tenant*d + idx]`` with the
+    static row stride ``d`` — so a cross-tenant batch shares the same
+    single compiled executable per bucket, and each row's gathered
+    values and reduction order are IDENTICAL to the 1-D gather-sum a
+    single-tenant server runs on ``w[tenant[r]]``: per-tenant answers
+    are bit-identical to T independent servers (pinned,
+    tests/test_serving.py).  Padded slots (tenant 0, index 0, value 0)
+    contribute exactly 0, the unchanged padding convention.
     """
-    m = (gather_dequant(w, shard["sp_indices"])
-         * shard["sp_values"]).sum(-1)
-    if "X_hot" in shard:
-        m = m + shard["X_hot"] @ gather_dequant(w, shard["hot_cols"])
+    if w.ndim == 2:
+        stride = w.shape[1]
+        flat_idx = (shard["tenant"][:, None] * stride
+                    + shard["sp_indices"])
+        m = (gather_dequant(w.reshape(-1), flat_idx)
+             * shard["sp_values"]).sum(-1)
+    else:
+        m = (gather_dequant(w, shard["sp_indices"])
+             * shard["sp_values"]).sum(-1)
+        if "X_hot" in shard:
+            m = m + shard["X_hot"] @ gather_dequant(w,
+                                                    shard["hot_cols"])
     if scale is not None:
         m = m * scale
     return m

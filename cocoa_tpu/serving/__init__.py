@@ -15,9 +15,14 @@ bench:
 - server.py   — MarginServer (the TCP line protocol)
 - quantize.py — swap-time bf16/int8 packing + the per-swap margin-error
                 certificate (``--serveDtype``, docs/DESIGN.md §20)
+- router.py   — Router / Replica (fleet front door: tenant routing,
+                admission shedding, requeue-on-death, §21)
+- fleet.py    — ServeFleet (replica subprocess lifecycle + respawn)
 """
 
 from cocoa_tpu.serving.batcher import MicroBatcher, PendingQuery
+from cocoa_tpu.serving.fleet import ReplicaProc, ServeFleet
+from cocoa_tpu.serving.router import Replica, Router
 from cocoa_tpu.serving.quantize import (SERVE_DTYPES, CalibrationBuffer,
                                         resolve_serve_dtype)
 from cocoa_tpu.serving.scorer import (DEFAULT_BUCKETS, DEFAULT_MAX_NNZ,
@@ -33,5 +38,6 @@ __all__ = [
     "ModelSlots", "QueryError", "parse_query", "pick_bucket",
     "MicroBatcher", "PendingQuery", "MarginServer", "SwapWatcher",
     "load_model", "wait_for_model", "SERVE_DTYPES", "CalibrationBuffer",
-    "resolve_serve_dtype",
+    "resolve_serve_dtype", "Router", "Replica", "ServeFleet",
+    "ReplicaProc",
 ]

@@ -52,12 +52,13 @@ _IDLE_GAP_S = 0.002
 class PendingQuery:
     """One in-flight request: parsed arrays in, margin (or error) out."""
 
-    __slots__ = ("idx", "val", "t_enq", "done", "margin", "error",
-                 "model_round", "served_dtype")
+    __slots__ = ("idx", "val", "tenant", "t_enq", "done", "margin",
+                 "error", "model_round", "served_dtype")
 
-    def __init__(self, idx, val):
+    def __init__(self, idx, val, tenant=None):
         self.idx = idx
         self.val = val
+        self.tenant = tenant
         self.t_enq = time.monotonic()
         self.done = threading.Event()
         self.margin = None
@@ -106,17 +107,21 @@ class MicroBatcher:
                                         name="cocoa-serve-batcher")
         self._thread.start()
 
-    def submit(self, idx, val) -> PendingQuery:
-        """Enqueue one parsed query; returns its pending handle."""
+    def submit(self, idx, val, tenant=None) -> PendingQuery:
+        """Enqueue one parsed query; returns its pending handle.
+
+        ``tenant`` is the catalogue row the query scores against (fleet
+        serving, docs/DESIGN.md §21) — None on a single-model scorer."""
         if self._calibration is not None:
             self._calibration.record(idx, val)
-        pend = PendingQuery(idx, val)
+        pend = PendingQuery(idx, val, tenant)
         self._q.put(pend)
         return pend
 
-    def score_sync(self, idx, val, timeout: Optional[float] = None):
+    def score_sync(self, idx, val, timeout: Optional[float] = None,
+                   tenant=None):
         """Submit + wait: the in-process client the bench and tests use."""
-        return self.submit(idx, val).result(timeout)
+        return self.submit(idx, val, tenant=tenant).result(timeout)
 
     def stop(self, timeout: float = 5.0):
         self._stop.set()
@@ -175,8 +180,17 @@ class MicroBatcher:
                                   n=len(batch)):
                     idx, val, hot = self.scorer.assemble(
                         [(p.idx, p.val) for p in batch], bucket)
+                    # catalogue scorer: every query carries its tenant
+                    # row (server.py validated the range at parse time);
+                    # padded slots gather tenant 0 against all-zero
+                    # values, contributing exactly 0
+                    tenant = None
+                    if getattr(self.scorer, "n_tenants", None) \
+                            is not None:
+                        tenant = self.scorer.assemble_tenants(
+                            [p.tenant or 0 for p in batch], bucket)
                     out = self.scorer.score(w_dev, idx, val, hot,
-                                            scale)
+                                            scale, tenant)
                     # the ONE sanctioned device→host crossing per batch
                     # (the zero-unintended-transfers contract)
                     with sanitize.intended_fetch("serve_fetch"):

@@ -151,8 +151,22 @@ class ModelSlots:
         # bound reaches the weakest calibrated |margin| (default), or an
         # explicit absolute threshold (tests force the crossing with it)
         self._flip_guard = flip_guard
-        w = np.asarray(w, np.float32).reshape(-1)
-        self._d = int(w.shape[0])
+        w = np.asarray(w, np.float32)
+        # a 2-D (T, d) w is a served CATALOGUE: T tenant models scored
+        # through the one flat-gather executable (docs/DESIGN.md §21);
+        # anything else flattens to the classic single-model vector
+        if w.ndim != 2:
+            w = w.reshape(-1)
+        self.n_tenants = int(w.shape[0]) if w.ndim == 2 else None
+        if self.n_tenants is not None and self.serve_dtype != "f32":
+            raise QueryError(
+                f"a served catalogue ({self.n_tenants} tenants x "
+                f"{w.shape[1]} features) only supports "
+                f"--serveDtype=f32: per-tenant quantization "
+                f"certificates are not in the fleet v1 surface "
+                f"(docs/DESIGN.md §21)")
+        self._shape = tuple(int(s) for s in w.shape)
+        self._d = self._shape[-1]
         self.served_dtype = "f32"       # form of the LIVE slot
         self.last_bound: Optional[float] = None
         self.fallbacks_total = 0
@@ -232,15 +246,16 @@ class ModelSlots:
         publish atomically.
 
         A shape change is rejected with the numbers — static shapes are
-        what make a swap compile-free, so a width change is a different
-        MODEL, not a fresh generation of this one."""
+        what make a swap compile-free, so a width change (or a tenant-
+        count change on a served catalogue) is a different MODEL, not a
+        fresh generation of this one."""
         with self._lock:
             w = np.asarray(w)
-            if tuple(w.shape) != (self._d,):
+            if tuple(w.shape) != self._shape:
                 raise QueryError(
                     f"refusing hot-swap: incoming w has shape "
                     f"{tuple(w.shape)} but the serving executable is "
-                    f"compiled for ({self._d},) — a width change is a "
+                    f"compiled for {self._shape} — a shape change is a "
                     f"new model (restart the server)")
             self._publish(np.asarray(w, np.float32), info)
         return info
@@ -259,7 +274,7 @@ class BatchScorer:
     def __init__(self, num_features: int, dtype=None,
                  buckets: tuple = DEFAULT_BUCKETS,
                  max_nnz: int = DEFAULT_MAX_NNZ,
-                 hot_ids=None, model_width=None):
+                 hot_ids=None, model_width=None, n_tenants=None):
         import jax
         import jax.numpy as jnp
 
@@ -273,6 +288,14 @@ class BatchScorer:
         if buckets[0] < 1:
             raise ValueError(f"buckets must be >= 1, got {buckets!r}")
         self.num_features = int(num_features)
+        # catalogue mode (docs/DESIGN.md §21): score against a (T, d)
+        # tenant catalogue — every batch carries a per-row tenant vector
+        # and the model gathers flat with a static row stride, so
+        # cross-tenant batches still compile ONCE per bucket
+        self.n_tenants = int(n_tenants) if n_tenants is not None else None
+        if self.n_tenants is not None and self.n_tenants < 1:
+            raise ValueError(f"n_tenants must be >= 1, "
+                             f"got {n_tenants!r}")
         # the trained width may exceed the query width by lane padding
         # (the CLI passes the checkpoint's w width); the packed model
         # forms are sized from THIS, so the warmed executables match
@@ -290,15 +313,29 @@ class BatchScorer:
         # the request side never narrows
         self.serve_dtype = quantize_mod.resolve_serve_dtype(dtype)
         self.dtype = jnp.dtype(jnp.float32)
+        if self.n_tenants is not None and self.serve_dtype != "f32":
+            raise ValueError(
+                f"a catalogue scorer ({self.n_tenants} tenants) only "
+                f"supports serve dtype f32 — per-tenant quantization "
+                f"certificates are not in the fleet v1 surface "
+                f"(docs/DESIGN.md §21)")
+        if self.n_tenants is not None and hot_ids is not None \
+                and len(hot_ids):
+            raise ValueError(
+                "a catalogue scorer does not combine with a hot-column "
+                "panel: the hot split is a single-model layout "
+                "(per-tenant panels are not in the fleet v1 surface)")
         # model forms this scorer serves: the configured form plus the
         # f32 certificate-fallback form — keyed by (device dtype,
-        # packed length), the numbers a mismatch is rejected with
-        self._forms = {"f32": (np.dtype(np.float32), self.model_width)}
+        # full shape), the numbers a mismatch is rejected with
+        model_shape = ((self.model_width,) if self.n_tenants is None
+                       else (self.n_tenants, self.model_width))
+        self._forms = {"f32": (np.dtype(np.float32), model_shape)}
         if self.serve_dtype != "f32":
             self._forms[self.serve_dtype] = (
                 quantize_mod.PACKED_DTYPE[self.serve_dtype],
-                quantize_mod.packed_len(self.model_width,
-                                        self.serve_dtype))
+                (quantize_mod.packed_len(self.model_width,
+                                         self.serve_dtype),))
         self.buckets = tuple(int(b) for b in buckets)
         self.max_nnz = int(min(max_nnz, num_features))
         self.hot_rank = None
@@ -316,11 +353,13 @@ class BatchScorer:
 
         hot_cols = self._hot_cols_dev
 
-        def serve_margins(w, idx, val, hot, scale):
+        def serve_margins(w, idx, val, hot, scale, tenant):
             shard = {"sp_indices": idx, "sp_values": val}
             if hot is not None:
                 shard["X_hot"] = hot
                 shard["hot_cols"] = hot_cols
+            if tenant is not None:
+                shard["tenant"] = tenant
             return rows_mod.serve_margins(w, shard, scale)
 
         # built ONCE at construction (the serve-hygiene rule pins this
@@ -361,7 +400,17 @@ class BatchScorer:
                 val[r, :len(cv)] = cv
         return idx, val, hot
 
-    def score(self, w_dev, idx, val, hot=None, scale=None):
+    def assemble_tenants(self, tenants: list, bucket: int):
+        """The catalogue batch's per-row tenant vector, padded to
+        ``bucket`` rows (padded slots carry tenant 0 — their values are
+        all 0, so whichever tenant row they gather contributes nothing
+        and the padded margins are never read)."""
+        out = np.zeros((bucket,), np.int32)
+        for r, t in enumerate(tenants):
+            out[r] = t
+        return out
+
+    def score(self, w_dev, idx, val, hot=None, scale=None, tenant=None):
         """Dispatch one padded bucket; returns the DEVICE margins array
         (the caller fetches once, under ``intended_fetch`` — the
         zero-unintended-transfers contract).
@@ -370,16 +419,17 @@ class BatchScorer:
         (its ``--serveDtype`` form or the f32 certificate fallback) —
         anything else would silently compile a new executable per
         publish, so it is rejected with the numbers instead."""
-        wd, wl = np.dtype(w_dev.dtype), int(w_dev.shape[0])
-        if not any(wd == fd and wl == fl
-                   for fd, fl in self._forms.values()):
+        wd = np.dtype(w_dev.dtype)
+        ws = tuple(int(s) for s in w_dev.shape)
+        if not any(wd == fd and ws == fs
+                   for fd, fs in self._forms.values()):
             raise QueryError(
                 f"model form mismatch: got w dtype={wd.name} shape="
-                f"({wl},) but this scorer (serve dtype "
+                f"{ws} but this scorer (serve dtype "
                 f"{self.serve_dtype}, num_features="
                 f"{self.num_features}) compiles only "
-                + " or ".join(f"{sd}:{fd.name}({fl},)"
-                              for sd, (fd, fl) in self._forms.items())
+                + " or ".join(f"{sd}:{fd.name}{fs}"
+                              for sd, (fd, fs) in self._forms.items())
                 + " — construct ModelSlots and BatchScorer with the "
                   "same dtype= (the CLI wires --serveDtype into both)")
         needs_scale = wd == np.dtype(np.int32)
@@ -390,7 +440,18 @@ class BatchScorer:
                 f"form carries None — got w dtype={wd.name} with "
                 f"scale={scale!r}; a stray scale would silently "
                 f"compile a new specialization per publish")
-        return self._jit(w_dev, idx, val, hot, scale)
+        if (tenant is None) != (self.n_tenants is None):
+            if self.n_tenants is not None:
+                what = (f"serves a catalogue of {self.n_tenants} "
+                        f"tenants and every batch must carry a "
+                        f"tenant vector")
+            else:
+                what = ("serves a single model and takes no tenant "
+                        "vector")
+            raise QueryError(
+                f"tenant mismatch: this scorer {what} — got "
+                f"tenant={tenant!r}")
+        return self._jit(w_dev, idx, val, hot, scale, tenant)
 
     def warmup(self, w_dev, scale=None):
         """Compile every (bucket, model form) pair up front so no
@@ -404,13 +465,15 @@ class BatchScorer:
 
         wd = np.dtype(w_dev.dtype)
         forms = [(w_dev, scale)]
-        for sd, (fd, fl) in self._forms.items():
+        for sd, (fd, fs) in self._forms.items():
             if fd == wd:
                 continue
-            forms.append((jax.device_put(np.zeros((fl,), fd)),
+            forms.append((jax.device_put(np.zeros(fs, fd)),
                           np.float32(1.0) if sd == "int8" else None))
         for b in self.buckets:
             idx, val, hot = self.assemble([], b)
+            tenant = (None if self.n_tenants is None
+                      else self.assemble_tenants([], b))
             for wv, sv in forms:
-                np.asarray(self.score(wv, idx, val, hot, sv))
+                np.asarray(self.score(wv, idx, val, hot, sv, tenant))
         return len(self.buckets) * len(forms)

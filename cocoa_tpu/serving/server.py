@@ -6,6 +6,9 @@ Protocol (one JSON response line per request line):
   (``idx:val idx:val ...``, 1-based ids), or several queries joined
   with ``;`` — a client-side batch, which the micro-batcher scores as
   one padded bucket;
+- a CATALOGUE server (fleet serving, docs/DESIGN.md §21) additionally
+  requires a ``tenant=<id>;`` prefix selecting the catalogue row the
+  line's queries score against; responses then carry ``"tenant"``;
 - the response is ``{"margin": m, "round": r, "dtype": d}`` per query
   (``round`` = the training round of the model generation that answered
   — how a client observes a hot-swap; ``dtype`` = the model form that
@@ -74,10 +77,14 @@ class MarginServer:
     """Glue: sockets in front, the micro-batcher behind."""
 
     def __init__(self, batcher, num_features: int, max_nnz: int,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 n_tenants=None):
         self.batcher = batcher
         self.num_features = int(num_features)
         self.max_nnz = int(max_nnz)
+        # catalogue mode (fleet serving, docs/DESIGN.md §21): queries
+        # carry a ``tenant=<id>;`` prefix selecting their catalogue row
+        self.n_tenants = None if n_tenants is None else int(n_tenants)
         self._tcp = _TCPServer((host, port), _Handler,
                                bind_and_activate=True)
         self._tcp.margin_server = self
@@ -87,9 +94,53 @@ class MarginServer:
         """(host, port) actually bound — port 0 resolves here."""
         return self._tcp.server_address
 
+    def _peel_tenant(self, line: str):
+        """Split the optional ``tenant=<id>;`` prefix off a request
+        line; returns (tenant_or_None, rest) or raises QueryError with
+        the numbers.  The prefix applies to EVERY ``;``-joined query on
+        the line (a client batch is one tenant's batch — the router
+        groups by tenant, so cross-tenant mixing happens server-side in
+        the bucket, not in the protocol)."""
+        tenant = None
+        if line.startswith("tenant="):
+            head, sep, rest = line.partition(";")
+            if not sep:
+                raise QueryError(
+                    "tenant prefix without a query: expected "
+                    "'tenant=<id>;<query>[;<query>...]', got "
+                    f"{line!r}")
+            try:
+                tenant = int(head[len("tenant="):])
+            except ValueError:
+                raise QueryError(
+                    f"malformed tenant prefix {head!r}: expected "
+                    f"'tenant=<id>' with an integer id")
+            line = rest
+        if tenant is None and self.n_tenants is not None:
+            raise QueryError(
+                f"this server serves a catalogue of "
+                f"{self.n_tenants} tenant models — prefix queries "
+                f"with 'tenant=<id>;' (id in [0, {self.n_tenants}))")
+        if tenant is not None and self.n_tenants is None:
+            raise QueryError(
+                "tenant prefix on a single-model server: this server "
+                "serves one model, not a catalogue — drop the "
+                "'tenant=' prefix (catalogue serving needs a (T, d) "
+                "checkpoint, docs/DESIGN.md §21)")
+        if tenant is not None and not 0 <= tenant < self.n_tenants:
+            raise QueryError(
+                f"tenant {tenant} out of range: this catalogue "
+                f"serves {self.n_tenants} tenants (ids 0.."
+                f"{self.n_tenants - 1})")
+        return tenant, line
+
     def answer_line(self, line: str):
         """Parse one request line, submit through the batcher, wait for
         the batch, shape the JSON-able response."""
+        try:
+            tenant, line = self._peel_tenant(line)
+        except QueryError as e:
+            return {"error": str(e)}
         texts = [t for t in line.split(";") if t.strip()]
         pendings = []
         for text in texts:
@@ -99,7 +150,8 @@ class MarginServer:
             except QueryError as e:
                 pendings.append({"error": str(e)})
                 continue
-            pendings.append(self.batcher.submit(idx, val))
+            pendings.append(self.batcher.submit(idx, val,
+                                                tenant=tenant))
         out = []
         for p in pendings:
             if isinstance(p, dict):
@@ -107,8 +159,11 @@ class MarginServer:
                 continue
             try:
                 margin = p.result(timeout=30.0)
-                out.append({"margin": margin, "round": p.model_round,
-                            "dtype": p.served_dtype})
+                resp = {"margin": margin, "round": p.model_round,
+                        "dtype": p.served_dtype}
+                if tenant is not None:
+                    resp["tenant"] = tenant
+                out.append(resp)
             except Exception as e:
                 out.append({"error": f"{type(e).__name__}: {e}"})
         return out if len(texts) > 1 else out[0] if out \

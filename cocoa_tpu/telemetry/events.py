@@ -118,6 +118,19 @@ EVENT_TYPES = (
                         # scale — what feeds
                         # cocoa_serve_margin_error_bound /
                         # cocoa_serve_dtype_fallbacks_total
+    "serve_shed",       # the fleet router refused one request line at
+                        # admission (serving/router.py): routing
+                        # policy, the tenant (None when untagged), the
+                        # best live replica's inflight depth and
+                        # projected wait vs the SLA — what feeds
+                        # cocoa_serve_shed_total
+    "replica_state",    # one fleet replica liveness transition
+                        # (serving/router.py / fleet.py): replica name,
+                        # state (live / dead / requeue), live count
+                        # after the transition, and whether a request
+                        # line was requeued by it — what feeds
+                        # cocoa_serve_replicas_live /
+                        # cocoa_serve_requeue_total
 )
 
 

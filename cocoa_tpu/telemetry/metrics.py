@@ -98,6 +98,13 @@ otherwise one opaque device dispatch:
   margin's sign, so the swap served f32 instead; a steadily climbing
   value means the trained models stopped surviving quantization and
   the serve dtype should be revisited
+- ``cocoa_serve_replicas_live`` gauge — fleet replicas currently
+  routable (the ``replica_state`` events, serving/router.py); present
+  only once a fleet router ran.  ``cocoa_serve_shed_total`` counter —
+  request lines refused at admission because every live replica
+  projected past the shed budget; ``cocoa_serve_requeue_total``
+  counter — request lines replayed off a dead replica onto a live one
+  (the requeue-never-fail recovery path, docs/DESIGN.md §21)
 - ``cocoa_model_gap_age_seconds`` gauge — freshness of the SERVING
   model: seconds (at render time) since the live model's certificate —
   its checkpoint — was produced.  A healthy background trainer keeps
@@ -208,6 +215,10 @@ class MetricsWriter:
         self.serve_quantize_seen = False
         self.serve_margin_error_bound = None
         self.serve_dtype_fallbacks_total = 0
+        self.fleet_serve_seen = False   # any router event arrived
+        self.serve_replicas_live = None
+        self.serve_shed_total = 0
+        self.serve_requeue_total = 0
         self.last_gap = None
         self.bucket_counts = [0] * (len(BUCKETS) + 1)  # +Inf tail
         self.hist_sum = 0.0
@@ -369,6 +380,14 @@ class MetricsWriter:
                 self.serve_margin_error_bound = float(rec["bound"])
             if rec.get("fallback"):
                 self.serve_dtype_fallbacks_total += 1
+        elif ev == "serve_shed":
+            self.fleet_serve_seen = True
+            self.serve_shed_total += 1
+        elif ev == "replica_state":
+            self.fleet_serve_seen = True
+            if rec.get("replicas_live") is not None:
+                self.serve_replicas_live = int(rec["replicas_live"])
+            self.serve_requeue_total += int(rec.get("requeued") or 0)
 
     def _maybe_write(self, ev):
         """The write debounce (caller holds the lock): flush-now events
@@ -569,6 +588,19 @@ class MetricsWriter:
                 lines += ["# TYPE cocoa_serve_margin_error_bound gauge",
                           f"cocoa_serve_margin_error_bound "
                           f"{self.serve_margin_error_bound!r}"]
+        if self.fleet_serve_seen:
+            # fleet-serving families render only once a router event
+            # arrived (single-process serves must not carry zero-valued
+            # fleet series)
+            lines += ["# TYPE cocoa_serve_shed_total counter",
+                      f"cocoa_serve_shed_total {self.serve_shed_total}",
+                      "# TYPE cocoa_serve_requeue_total counter",
+                      f"cocoa_serve_requeue_total "
+                      f"{self.serve_requeue_total}"]
+            if self.serve_replicas_live is not None:
+                lines += ["# TYPE cocoa_serve_replicas_live gauge",
+                          f"cocoa_serve_replicas_live "
+                          f"{self.serve_replicas_live}"]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]

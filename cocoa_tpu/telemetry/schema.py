@@ -183,6 +183,24 @@ EVENT_FIELDS = {
                        "swap_seq": (int,), "bound": _OPT_NUM,
                        "calib_n": (int,), "flips": (int,),
                        "fallback": (int,), "scale": _OPT_NUM},
+    # the fleet router refused one request line at admission
+    # (serving/router.py): the best live replica still projected past
+    # the shed budget, so the line was refused instead of queued into
+    # an SLA violation.  tenant None = an untagged line; inflight /
+    # est_s describe the BEST live replica at the decision — what feeds
+    # cocoa_serve_shed_total
+    "serve_shed": {"algorithm": (str,), "route": (str,),
+                   "tenant": (int, type(None)), "inflight": (int,),
+                   "est_s": _NUM, "sla_s": _NUM},
+    # one fleet replica liveness transition (serving/router.py /
+    # fleet.py): state "dead" (connection or process died), "requeue"
+    # (a request line replayed off the dead replica, requeued=1), or
+    # "live" (the monitor respawned it).  replicas_live is the live
+    # count AFTER the transition — what feeds
+    # cocoa_serve_replicas_live / cocoa_serve_requeue_total
+    "replica_state": {"algorithm": (str,), "replica": (str,),
+                      "state": (str,), "replicas_live": (int,),
+                      "requeued": (int,)},
 }
 
 # --fleet manifest dialect (data/fleet.py): a ``fleet_manifest`` header
@@ -283,6 +301,16 @@ RESULTS_FIELDS = {
     "serve_dtype": (str,), "f32_qps": _NUM, "qps_ratio": _NUM,
     "margin_err_bound": _NUM, "flips": (int,), "flip_checked": (int,),
     "calib_n": (int,),
+    # the fleet-serving rows (--serveReplicas,
+    # benchmarks/serve_bench.py): aggregate open-loop qps of R replicas
+    # behind the router vs the SAME-harness 1-replica control
+    # (control_qps), scaling_eff = qps / (replicas × control_qps);
+    # shed / requeued / failed are the router's admission + recovery
+    # accounting (failed is pinned 0 — a SIGKILLed replica requeues,
+    # never fails), rate_qps the open-loop offered rate
+    "replicas": (int,), "route": (str,), "rate_qps": _NUM,
+    "control_qps": _NUM, "scaling_eff": _NUM, "shed": (int,),
+    "requeued": (int,), "failed": (int,), "killed": (int,),
 }
 
 

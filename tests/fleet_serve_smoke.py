@@ -1,0 +1,351 @@
+"""CI fleet-serving smoke: train a model, stack it into a (T, d)
+tenant catalogue, serve it through the REAL CLI fleet path
+(``--serveReplicas=2 --serveRoute=tenant``), and drive the three fleet
+guarantees end to end over plain sockets:
+
+- **per-tenant routing correctness**: every tenant's margins scale
+  exactly with that tenant's catalogue row (power-of-two tenant scales
+  make the check bit-exact), including across a mid-run catalogue
+  hot-swap that both replicas must pick up;
+- **zero failed queries under replica death**: one replica is
+  SIGKILLed mid-traffic and every subsequent line must still answer
+  (requeue, never fail), with the fleet monitor respawning the dead
+  replica and the router folding it back in;
+- **one compile per (bucket, dtype) per replica process**: each
+  replica's event stream carries exactly two ``serve_margins`` compile
+  records per process lifetime, whatever T is.
+
+Not a pytest file (no ``test_`` prefix): run it directly —
+
+    PYTHONPATH=. python tests/fleet_serve_smoke.py <artifact-dir>
+
+The front door's ``replica_state``/``serve_shed`` stream and the
+per-replica ``--events`` streams are schema-validated, and the fleet
+gauges (``cocoa_serve_replicas_live``, ``cocoa_serve_requeue_total``)
+are grepped out of the metrics textfile.  Exit code 0 = every check
+held.  The same mechanics are pinned in-process as tests
+(tests/test_serving.py); this script keeps the spawn/SIGKILL/respawn
+path — real processes, real sockets, real signals — visible as its own
+CI signal with uploadable artifacts.
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+D = 9947
+# power-of-two per-tenant scales: (w * s) @ x == s * (w @ x) EXACTLY in
+# float, so cross-tenant answers are checkable to the last bit
+SCALES = (1.0, 0.5, 0.25, 2.0)
+_PID_RE = re.compile(r"replica (r\d+) pid=(\d+) port=(\d+)")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    outdir = argv[0] if argv else tempfile.mkdtemp(prefix="fleet-smoke-")
+    os.makedirs(outdir, exist_ok=True)
+    ck = os.path.join(outdir, "ck-train")
+    cat = os.path.join(outdir, "ck-catalogue")
+    events_path = os.path.join(outdir, "fleet-events.jsonl")
+    metrics_path = os.path.join(outdir, "fleet-metrics.prom")
+    # the persistent XLA cache would satisfy a replica's warmup from
+    # disk and log no compile — opt out so the one-compile-per-bucket
+    # pin counts real compiles deterministically
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "COCOA_NO_COMPILE_CACHE": "1"}
+
+    print("fleet-smoke: training the base model (CoCoA+, 40 rounds)",
+          flush=True)
+    rc = subprocess.run(
+        [sys.executable, "-m", "cocoa_tpu.cli",
+         "--trainFile=data/small_train.dat", f"--numFeatures={D}",
+         "--numSplits=4", "--numRounds=40", "--debugIter=10",
+         "--chkptIter=20", f"--chkptDir={ck}", "--localIterFrac=0.1",
+         "--lambda=0.001", "--layout=dense", "--math=fast",
+         "--gapTarget=1e-4", "--justCoCoA=true", "--quiet"],
+        cwd=ROOT, env=env, timeout=600).returncode
+    if rc != 0:
+        print(f"fleet-smoke FAIL: training exited {rc}")
+        return 1
+
+    # stack the trained w into a (T, d) catalogue — the PR-12 fleet's
+    # stacked checkpoint shape, written through the production writer
+    from cocoa_tpu import checkpoint as ckpt_lib
+
+    meta, w, _ = ckpt_lib.load(ckpt_lib.latest(ck, "CoCoA+"))
+    w = np.asarray(w, np.float32)
+    w_cat = np.stack([w * s for s in SCALES])
+    round0 = int(meta["round"])
+    ckpt_lib.save(cat, "CoCoA+", round0, w_cat, None, gap=1e-4)
+    print(f"fleet-smoke: catalogue saved — {len(SCALES)} tenants, "
+          f"shape {w_cat.shape}, r{round0}", flush=True)
+
+    failures = fleet_phase(cat, round0, events_path, metrics_path, env)
+    if failures:
+        for msg in failures:
+            print(f"fleet-smoke FAIL: {msg}")
+        return 1
+    print(f"fleet-smoke: OK — routed {len(SCALES)} tenants "
+          f"bit-exactly, hot-swapped, survived a replica SIGKILL with "
+          f"zero failed queries, schema valid, gauges present "
+          f"(artifacts in {outdir})")
+    return 0
+
+
+def fleet_phase(cat, round0, events_path, metrics_path, env) -> list:
+    failures = []
+    server = subprocess.Popen(
+        [sys.executable, "-m", "cocoa_tpu.cli", "--serve=0",
+         "--serveReplicas=2", "--serveRoute=tenant",
+         f"--chkptDir={cat}", f"--numFeatures={D}",
+         "--serveBatch=8,64", "--serveSlaMs=200",
+         f"--events={events_path}", f"--metrics={metrics_path}"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    lines, pids = [], {}   # pids: replica name -> [pid, pid-after-respawn, ...]
+    lock = threading.Lock()
+
+    def drain():
+        for line in server.stdout:
+            print(f"fleet-smoke: server: {line.rstrip()}", flush=True)
+            with lock:
+                lines.append(line)
+                m = _PID_RE.search(line)
+                if m:
+                    pids.setdefault(m.group(1), []).append(
+                        int(m.group(2)))
+    threading.Thread(target=drain, daemon=True).start()
+
+    def wait_for(pred, what, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with lock:
+                got = pred()
+            if got:
+                return got
+            if server.poll() is not None:
+                failures.append(f"server exited {server.poll()} "
+                                f"while waiting for {what}")
+                return None
+            time.sleep(0.2)
+        failures.append(f"timed out waiting for {what}")
+        return None
+
+    try:
+        announce = wait_for(
+            lambda: next((ln for ln in lines
+                          if "fleet listening on" in ln), None),
+            "the fleet announce", timeout=600)
+        if announce is None:
+            return failures
+        port = int(announce.split("fleet listening on ")[1]
+                   .split()[0].rstrip("(").rsplit(":", 1)[1])
+        if "tenants=4" not in announce:
+            failures.append(f"announce does not declare the catalogue: "
+                            f"{announce.rstrip()}")
+
+        s = socket.create_connection(("127.0.0.1", port), timeout=60)
+        f = s.makefile("rwb")
+
+        def score(tenant):
+            f.write(f"tenant={tenant};3:1.0;5:2.5 "
+                    f"7:-1.0;10:0.5\n".encode())
+            f.flush()
+            return json.loads(f.readline())
+
+        # --- per-tenant routing: margins scale bit-exactly -----------
+        base = score(0)   # the line carries 3 ';'-separated queries
+        if not (isinstance(base, list) and len(base) == 3
+                and all("margin" in r for r in base)):
+            return failures + [f"bad tenant-0 response: {base}"]
+        for t, scale in enumerate(SCALES):
+            resp = score(t)
+            if not isinstance(resp, list):
+                failures.append(f"tenant {t} got {resp}")
+                continue
+            for b, r in zip(base, resp):
+                if r.get("tenant") != t:
+                    failures.append(f"tenant {t} answer tagged "
+                                    f"{r.get('tenant')}")
+                if r["margin"] != b["margin"] * scale:
+                    failures.append(
+                        f"tenant {t} margin {r['margin']} != "
+                        f"{b['margin']} * {scale} — routing served the "
+                        f"wrong catalogue row")
+        print("fleet-smoke: all tenants answer bit-exactly against "
+              "their catalogue rows", flush=True)
+
+        # --- catalogue hot-swap: both replicas must pick it up -------
+        from cocoa_tpu import checkpoint as ckpt_lib
+
+        _, w_cat, _ = ckpt_lib.load(ckpt_lib.latest(cat, "CoCoA+"))
+        new_round = round0 + 10
+        ckpt_lib.save(cat, "CoCoA+", new_round,
+                      np.asarray(w_cat) * 0.5, None, gap=1e-5)
+        print(f"fleet-smoke: injected catalogue generation "
+              f"r{new_round}", flush=True)
+        swapped = {}
+        deadline = time.monotonic() + 120
+        # tenant 0 homes on r0 and tenant 1 on r1, so seeing the new
+        # round on both proves BOTH replicas swapped
+        while time.monotonic() < deadline and len(swapped) < 2:
+            for t in (0, 1):
+                resp = score(t)
+                if (isinstance(resp, list) and resp
+                        and resp[0].get("round") == new_round):
+                    swapped[t] = resp
+            time.sleep(0.1)
+        if len(swapped) < 2:
+            failures.append(f"hot-swap r{new_round} reached only "
+                            f"replicas {sorted(swapped)} within 120s")
+        elif swapped[0][0]["margin"] != base[0]["margin"] * 0.5:
+            failures.append(
+                f"post-swap tenant-0 margin {swapped[0][0]['margin']} "
+                f"!= half the pre-swap {base[0]['margin']}")
+        else:
+            print(f"fleet-smoke: both replicas serve r{new_round}, "
+                  f"answers halved exactly", flush=True)
+
+        # --- SIGKILL one replica mid-traffic: requeue, never fail ----
+        with lock:
+            r0_pids = list(pids.get("r0", []))
+        if not r0_pids:
+            return failures + ["no pid note for replica r0"]
+        os.kill(r0_pids[0], signal.SIGKILL)
+        print(f"fleet-smoke: SIGKILLed replica r0 (pid "
+              f"{r0_pids[0]})", flush=True)
+        answered = 0
+        for i in range(30):
+            resp = score(i % len(SCALES))
+            if isinstance(resp, list) and all("margin" in r
+                                              for r in resp):
+                answered += 1
+            else:
+                failures.append(f"query {i} after the SIGKILL got "
+                                f"{resp} — a dead replica must cost "
+                                f"latency, never a failed query")
+        print(f"fleet-smoke: {answered}/30 queries answered through "
+              f"the kill window", flush=True)
+
+        # the monitor must respawn r0 (a second pid note) and the
+        # respawned replica must serve the LATEST generation
+        if wait_for(lambda: len(pids.get("r0", [])) >= 2,
+                    "the r0 respawn", timeout=600):
+            resp = wait_for(
+                lambda: (lambda r: r if isinstance(r, list) and r
+                         and r[0].get("round") == new_round
+                         else None)(score(0)),
+                "the respawned r0 to serve the catalogue",
+                timeout=120)
+            if resp and resp[0]["margin"] != base[0]["margin"] * 0.5:
+                failures.append(
+                    f"respawned r0 serves margin {resp[0]['margin']}, "
+                    f"expected {base[0]['margin'] * 0.5}")
+            else:
+                print("fleet-smoke: respawned r0 rejoined routing on "
+                      "the injected generation", flush=True)
+
+        f.write(b"shutdown\n")
+        f.flush()
+        ack = json.loads(f.readline())
+        if ack.get("ok") != "shutting down":
+            failures.append(f"bad shutdown ack: {ack}")
+        s.close()
+        rc = server.wait(timeout=120)
+        if rc != 0:
+            failures.append(f"fleet exited {rc} after shutdown")
+    finally:
+        if server.poll() is None:
+            server.kill()
+            server.wait(timeout=10)
+
+    failures += stream_checks(events_path, metrics_path, new_round)
+    return failures
+
+
+def stream_checks(events_path, metrics_path, new_round) -> list:
+    """Validate every emitted stream: the front door's router events,
+    both replicas' serve streams, and the fleet gauges."""
+    from cocoa_tpu.telemetry import schema as tele_schema
+
+    failures = []
+    streams = [events_path] + [f"{events_path}.r{i}" for i in (0, 1)]
+    for path in streams:
+        if not os.path.exists(path):
+            failures.append(f"missing event stream {path}")
+            continue
+        errs = tele_schema.check_file(path)
+        if errs:
+            failures.append(f"{os.path.basename(path)} schema "
+                            f"violations: {errs[:5]}")
+    if failures:
+        return failures
+
+    # front door: initial live states, the death, the requeue, the
+    # respawn, and a clean shutdown
+    recs = [json.loads(ln) for ln in open(events_path)]
+    states = [r for r in recs if r["event"] == "replica_state"]
+    by_state = {}
+    for r in states:
+        by_state.setdefault(r["state"], []).append(r)
+    if len(by_state.get("live", [])) < 3:
+        failures.append(f"expected >=3 live replica_state events "
+                        f"(2 initial + the respawn), got "
+                        f"{len(by_state.get('live', []))}")
+    if not by_state.get("dead"):
+        failures.append("no dead replica_state event for the SIGKILL")
+    requeues = by_state.get("requeue", [])
+    if not requeues or not all(r["requeued"] == 1 for r in requeues):
+        failures.append(f"expected requeue events with requeued=1, "
+                        f"got {requeues}")
+    if not any(r["event"] == "run_end"
+               and r.get("stopped") == "shutdown" for r in recs):
+        failures.append("no run_end stopped=shutdown on the front door")
+
+    # replicas: ONE compile per (bucket, dtype) per process — two
+    # buckets, so 2 for r1 and 4 for r0 (original process + respawn,
+    # both appending to the same .r0 stream); plus the injected swap
+    for i, want in ((0, 4), (1, 2)):
+        rrecs = [json.loads(ln) for ln in open(f"{events_path}.r{i}")]
+        compiles = [r for r in rrecs if r["event"] == "compile"
+                    and "serve_margins" in r["name"]]
+        if len(compiles) != want:
+            failures.append(
+                f"replica r{i} stream has {len(compiles)} "
+                f"serve_margins compiles, expected {want} (one per "
+                f"bucket per process — the catalogue must not add "
+                f"specializations)")
+        if not any(r["event"] == "model_swap"
+                   and r.get("round") == new_round for r in rrecs):
+            failures.append(f"replica r{i} never emitted a model_swap "
+                            f"for the injected r{new_round}")
+
+    metrics_text = open(metrics_path).read()
+    for needle in ("cocoa_serve_replicas_live 2",
+                   "cocoa_serve_shed_total",
+                   "cocoa_serve_requeue_total"):
+        if needle not in metrics_text:
+            failures.append(f"{needle!r} missing from the fleet "
+                            f"metrics textfile")
+    m = re.search(r"cocoa_serve_requeue_total (\d+)", metrics_text)
+    if m and int(m.group(1)) < 1:
+        failures.append("cocoa_serve_requeue_total is 0 after a "
+                        "SIGKILL under traffic")
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(main())

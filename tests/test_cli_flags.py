@@ -403,3 +403,68 @@ def test_cli_serve_flag_hardening(tmp_path, capsys):
     assert main(base) == 2
     err = capsys.readouterr().err
     assert "(8,)" in err and "--numFeatures=16" in err
+
+
+def test_cli_fleet_serve_flag_hardening(tmp_path, capsys):
+    """--serveReplicas/--serveRoute join the serve whitelist with the
+    same loud-rejection convention: malformed values fail in
+    milliseconds, the routing policy needs a fleet to route between,
+    fleet-incompatible flags point at the v1 surface, and a replica
+    count past the detected cores warns with the numbers."""
+    import os
+
+    import numpy as np
+
+    from cocoa_tpu import checkpoint as ckpt_lib
+    from cocoa_tpu.cli import main
+
+    ck = str(tmp_path / "ck")
+    base = ["--serve=0", f"--chkptDir={ck}", "--numFeatures=16"]
+
+    # the fleet flags need --serve, like every serve flag
+    assert main(["--serveReplicas=2", f"--chkptDir={ck}",
+                 "--numFeatures=16", "--trainFile=x"]) == 2
+    assert "needs --serve" in capsys.readouterr().err
+    assert main(["--serveRoute=rr", f"--chkptDir={ck}",
+                 "--numFeatures=16", "--trainFile=x"]) == 2
+    assert "needs --serve" in capsys.readouterr().err
+
+    # malformed replica counts fail before any JAX work
+    for bad_flag in ("--serveReplicas=0", "--serveReplicas=-3",
+                     "--serveReplicas=oops"):
+        assert main(base + [bad_flag]) == 2, bad_flag
+        assert "replica count" in capsys.readouterr().err
+
+    # the route policy is an enum...
+    assert main(base + ["--serveReplicas=2",
+                        "--serveRoute=hash"]) == 2
+    err = capsys.readouterr().err
+    assert "rr/tenant" in err and "'hash'" in err
+    # ...and needs a fleet to route between
+    for route_only in (["--serveRoute=tenant"],
+                       ["--serveReplicas=1", "--serveRoute=tenant"]):
+        assert main(base + route_only) == 2, route_only
+        assert "--serveReplicas>=2" in capsys.readouterr().err
+
+    # per-replica hot panels are not in the fleet v1 surface
+    assert main(base + ["--serveReplicas=2", "--hotCols=auto",
+                        "--trainFile=x"]) == 2
+    assert "fleet v1 surface" in capsys.readouterr().err
+
+    # oversubscribing the detected cores warns WITH the numbers (paired
+    # with a route typo so main exits before spawning anything)
+    cores = os.cpu_count() or 1
+    assert main(base + [f"--serveReplicas={cores + 1}",
+                        "--serveRoute=bogus"]) == 2
+    err = capsys.readouterr().err
+    assert "oversubscribes" in err
+    assert f"--serveReplicas={cores + 1}" in err
+    assert f"{cores} detected core(s)" in err
+
+    # a (T, d) catalogue serves f32 only in v1: quantized serving of a
+    # catalogue is rejected with the shape and the pointer
+    ckpt_lib.save(ck, "CoCoA+", 10, np.zeros((2, 16), np.float32),
+                  None)
+    assert main(base + ["--serveDtype=int8"]) == 2
+    err = capsys.readouterr().err
+    assert "(2, 16)" in err and "fleet v1 surface" in err

@@ -950,3 +950,264 @@ def test_quantize_metrics_families_rendered(tmp_path):
     MetricsWriter(clean)
     assert "cocoa_serve_margin_error_bound" not in open(clean).read()
     assert "cocoa_serve_dtype_fallbacks" not in open(clean).read()
+
+
+# --- fleet serving: catalogue scoring, routing, shedding (§21) ---------------
+
+
+def _catalogue_stack(ck, n_tenants, buckets=(4, 16), max_nnz=8,
+                     sla_s=0.05, algorithm="CoCoA+"):
+    """A served (T, d) catalogue: one compiled scorer, tenant rows
+    gathered per query — the fleet replica's in-process core."""
+    w, info = serving.load_model(ckpt_lib.latest(str(ck), algorithm))
+    slots = serving.ModelSlots(w, info, dtype=np.float32)
+    scorer = serving.BatchScorer(D, dtype=np.float32, buckets=buckets,
+                                 max_nnz=max_nnz, n_tenants=n_tenants)
+    scorer.warmup(slots.current()[0])
+    batcher = serving.MicroBatcher(scorer, slots, sla_s=sla_s,
+                                   algorithm=algorithm)
+    return slots, scorer, batcher
+
+
+def _start_server(batcher, n_tenants=None):
+    srv = serving.MarginServer(batcher, D, 8, port=0,
+                               n_tenants=n_tenants)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _ask(addr, line):
+    with socket.create_connection(addr, timeout=10) as s:
+        s.sendall((line + "\n").encode())
+        return json.loads(s.makefile("rb").readline())
+
+
+def test_catalogue_bit_identical_to_single_tenant_servers(tmp_path):
+    """The fleet correctness pin: a (T, d) catalogue answers every
+    tenant BIT-identically to T independent single-tenant servers —
+    the flat tenant-gather reads the same f32 values in the same
+    reduction order as the 1-D gather — including across a mid-run
+    catalogue hot-swap, with one compile per bucket regardless of T."""
+    T = 3
+    rng = np.random.default_rng(5)
+    W1 = rng.standard_normal((T, D)).astype(np.float32)
+    cat = tmp_path / "cat"
+    cat.mkdir()
+    _save_model(cat, W1, 10, gap=1e-3)
+    solo_dirs = []
+    for t in range(T):
+        dt = tmp_path / f"solo{t}"
+        dt.mkdir()
+        _save_model(dt, W1[t], 10, gap=1e-3)
+        solo_dirs.append(dt)
+    with sanitize.watch_compiles() as compiles:
+        cat_slots, cat_scorer, cat_batcher = _catalogue_stack(cat, T)
+        n_warm = len([c for c in compiles
+                      if "serve_margins" in c.name])
+        # the tenant dim rides the SAME bucket executables: T models,
+        # still one compile per (bucket, dtype)
+        assert n_warm == len(cat_scorer.buckets) == 2
+        controls = [_serving_stack(dt) for dt in solo_dirs]
+        queries = _rand_queries(rng, 6)
+
+        def compare_all():
+            for t in range(T):
+                for qi, qv in queries:
+                    a = cat_batcher.score_sync(qi, qv, timeout=10.0,
+                                               tenant=t)
+                    b = controls[t][2].score_sync(qi, qv, timeout=10.0)
+                    assert a == b, (t, a, b)
+
+        compare_all()
+        # mid-run catalogue hot-swap: one (T, d) generation vs T solo
+        # swaps — still bit-identical, still zero new compiles
+        W2 = (W1 * 0.7 + 1.0).astype(np.float32)
+        _save_model(cat, W2, 20, gap=1e-4)
+        assert serving.SwapWatcher(cat_slots, str(cat),
+                                   "CoCoA+").poll_once()
+        for t in range(T):
+            _save_model(solo_dirs[t], W2[t], 20, gap=1e-4)
+            assert serving.SwapWatcher(controls[t][0],
+                                       str(solo_dirs[t]),
+                                       "CoCoA+").poll_once()
+        compare_all()
+        cat_total = len([c for c in compiles
+                         if "serve_margins" in c.name])
+    # the controls compiled their own 1-D executables (2 buckets × T
+    # would be 6 more); the CATALOGUE added none after warmup
+    assert cat_total == n_warm + 2 * T
+    cat_batcher.stop()
+    for c in controls:
+        c[2].stop()
+
+
+def test_catalogue_tenant_protocol_rejections(tmp_path):
+    """Every tenant-prefix misuse is rejected with the numbers, per
+    line, without touching the batcher."""
+    T = 3
+    W = np.arange(T * D, dtype=np.float32).reshape(T, D)
+    _save_model(tmp_path, W, 7)
+    slots, scorer, batcher = _catalogue_stack(tmp_path, T)
+    srv = serving.MarginServer(batcher, D, 8, port=0, n_tenants=T)
+    try:
+        ok = srv.answer_line("tenant=1;2:1.0")
+        assert ok["tenant"] == 1 and ok["round"] == 7
+        _assert_margin(ok["margin"], W[1], [1], [1.0])
+        # no prefix on a catalogue server
+        r = srv.answer_line("2:1.0")
+        assert "catalogue of 3 tenant models" in r["error"]
+        # out-of-range id, with the numbers
+        r = srv.answer_line("tenant=3;2:1.0")
+        assert "tenant 3 out of range" in r["error"]
+        assert "3 tenants" in r["error"]
+        # malformed id
+        r = srv.answer_line("tenant=x;2:1.0")
+        assert "malformed tenant prefix" in r["error"]
+        # prefix without a query
+        r = srv.answer_line("tenant=1")
+        assert "without a query" in r["error"]
+        # a per-query parse error inside a tenant batch fails only
+        # itself, and every answer carries the tenant
+        rs = srv.answer_line("tenant=2;2:1.0;99:1.0")
+        assert rs[0]["tenant"] == 2 and "feature id 99" in \
+            rs[1]["error"]
+    finally:
+        srv.close()
+        batcher.stop()
+    # the prefix on a SINGLE-model server points at the catalogue docs
+    _save_model(tmp_path / "solo", np.zeros(D, np.float32), 7)
+    slots1, scorer1, batcher1 = _serving_stack(tmp_path / "solo")
+    srv1 = serving.MarginServer(batcher1, D, 8, port=0)
+    try:
+        r = srv1.answer_line("tenant=0;2:1.0")
+        assert "single-model server" in r["error"]
+    finally:
+        srv1.close()
+        batcher1.stop()
+
+
+def test_scorer_tenant_vector_mismatch_rejected(tmp_path):
+    """A catalogue scorer without a tenant vector (and vice versa) is a
+    dispatch-shape bug — rejected with the numbers, not compiled."""
+    T = 2
+    _save_model(tmp_path, np.zeros((T, D), np.float32), 7)
+    slots, scorer, batcher = _catalogue_stack(tmp_path, T)
+    idx, val, hot = scorer.assemble([], 4)
+    with pytest.raises(serving.QueryError, match="catalogue of 2"):
+        scorer.score(slots.current()[0], idx, val, hot, None, None)
+    batcher.stop()
+    _save_model(tmp_path / "solo", np.zeros(D, np.float32), 7)
+    slots1, scorer1, batcher1 = _serving_stack(tmp_path / "solo")
+    idx, val, hot = scorer1.assemble([], 4)
+    with pytest.raises(serving.QueryError, match="single model"):
+        scorer1.score(slots1.current()[0], idx, val, hot, None,
+                      np.zeros(4, np.int32))
+    batcher1.stop()
+
+
+def test_fleet_router_routes_requeues_and_sheds(tmp_path, bus):
+    """The fleet chaos pin, in-process: two catalogue replicas behind
+    the router; a killed replica's lines requeue (zero failed), a
+    respawned one rejoins, overload sheds with a typed event — and the
+    gauges render."""
+    from cocoa_tpu.serving.router import Router
+
+    T = 4
+    rng = np.random.default_rng(11)
+    W = rng.standard_normal((T, D)).astype(np.float32)
+    cat = tmp_path / "cat"
+    cat.mkdir()
+    _save_model(cat, W, 10, gap=1e-3)
+    stacks = [_catalogue_stack(cat, T) for _ in range(2)]
+    servers = [_start_server(s[2], n_tenants=T) for s in stacks]
+    router = Router([(f"r{i}", srv.address)
+                     for i, srv in enumerate(servers)],
+                    sla_s=0.5, route="tenant")
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    router.emit_initial_state()
+    revive = None
+    try:
+        queries = _rand_queries(rng, 4)
+        for t in range(T):
+            for qi, qv in queries:
+                line = (f"tenant={t};"
+                        + " ".join(f"{int(i) + 1}:{float(v)!r}"
+                                   for i, v in zip(qi, qv)))
+                got = _ask(router.address, line)
+                want = stacks[0][2].score_sync(qi, qv, timeout=10.0,
+                                               tenant=t)
+                assert got["margin"] == want and got["tenant"] == t
+        assert router.replicas_live() == 2
+        # kill r0 the way a SIGKILL looks from the router: listener
+        # gone, pooled connections broken
+        servers[0]._tcp.shutdown()
+        servers[0]._tcp.server_close()
+        router.replicas[0].close_all()
+        for t in range(T):   # tenant-affine homes to r0 for t%2==0
+            r = _ask(router.address, f"tenant={t};2:1.0")
+            assert "margin" in r, r
+        assert router.requeue_total >= 1
+        assert router.failed_total == 0
+        assert router.replicas_live() == 1
+        # revive under the old name on a new port (the fleet monitor's
+        # respawn path)
+        revive = _catalogue_stack(cat, T)
+        srv_new = _start_server(revive[2], n_tenants=T)
+        servers.append(srv_new)
+        router.mark_live("r0", srv_new.address)
+        assert router.replicas_live() == 2
+        assert "margin" in _ask(router.address, "tenant=0;2:1.0")
+        # overload: every live replica projects past the shed budget
+        for rep in router.replicas:
+            rep.ewma_s, rep.inflight = 10.0, 9
+        shed = _ask(router.address, "tenant=1;2:1.0")
+        assert shed.get("shed") is True and "shed:" in shed["error"]
+        for rep in router.replicas:
+            rep.ewma_s, rep.inflight = 0.0, 0
+    finally:
+        router.stop()
+        router.close()
+        for srv in servers:
+            srv.close()
+        for s in stacks + ([revive] if revive else []):
+            s[2].stop()
+    events = _read_events(bus)
+    assert tele_schema.check_file(str(bus)) == []
+    kinds = [e["event"] for e in events]
+    assert "serve_shed" in kinds and "replica_state" in kinds
+    dead = [e for e in events if e["event"] == "replica_state"
+            and e["state"] == "dead"]
+    requeues = [e for e in events if e["event"] == "replica_state"
+                and e["state"] == "requeue"]
+    lives = [e for e in events if e["event"] == "replica_state"
+             and e["state"] == "live"]
+    assert dead and requeues and len(lives) >= 3   # 2 initial + revive
+    assert all(e["requeued"] == 1 for e in requeues)
+    shed_ev = [e for e in events if e["event"] == "serve_shed"][0]
+    assert shed_ev["route"] == "tenant" and shed_ev["tenant"] == 1
+    assert shed_ev["est_s"] > shed_ev["sla_s"]
+
+
+def test_fleet_metrics_families_rendered(tmp_path):
+    """serve_shed / replica_state drive the three fleet families;
+    single-process serves must not render them."""
+    from cocoa_tpu.telemetry.metrics import MetricsWriter
+
+    path = str(tmp_path / "m.prom")
+    wtr = MetricsWriter(path)
+    base = {"seq": 1, "pid": 1, "ts": 1000.0, "algorithm": "serve"}
+    wtr({**base, "event": "replica_state", "replica": "r0",
+         "state": "live", "replicas_live": 2, "requeued": 0})
+    wtr({**base, "event": "replica_state", "replica": "r0",
+         "state": "requeue", "replicas_live": 1, "requeued": 1})
+    wtr({**base, "event": "serve_shed", "route": "rr", "tenant": None,
+         "inflight": 9, "est_s": 1.0, "sla_s": 0.05})
+    text = open(path).read()
+    for needle in ("cocoa_serve_replicas_live 1",
+                   "cocoa_serve_shed_total 1",
+                   "cocoa_serve_requeue_total 1"):
+        assert needle in text, f"{needle} missing from:\n{text}"
+    clean = str(tmp_path / "clean.prom")
+    MetricsWriter(clean)
+    assert "cocoa_serve_replicas_live" not in open(clean).read()
+    assert "cocoa_serve_shed_total" not in open(clean).read()

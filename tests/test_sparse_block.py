@@ -52,8 +52,13 @@ def _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, n, mode, sigma,
                                    rtol=rtol, atol=atol)
 
 
-@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
-                                        ("frozen", 1.0)])
+@pytest.mark.parametrize("mode,sigma", [
+    ("cocoa", 1.0),
+    # tier-1 budget: one arm keeps the fast-sweep parity signal; the
+    # plus/frozen arms run under -m slow and in the dedicated CI parity
+    # step (which runs this file unfiltered)
+    pytest.param("plus", 4.0, marks=pytest.mark.slow),
+    pytest.param("frozen", 1.0, marks=pytest.mark.slow)])
 def test_sparse_block_kernel_matches_fast(tiny_data, mode, sigma):
     """f32 interpret-mode parity against the sequential fast path — masked
     tail (H=37 vs B=128) and within-block duplicate draws included (37
@@ -98,8 +103,13 @@ def test_sparse_block_kernel_f64(tiny_data):
                        "plus", 4.0, rtol=1e-9, atol=1e-12)
 
 
-@pytest.mark.parametrize("mode,sigma", [("cocoa", 1.0), ("plus", 4.0),
-                                        ("frozen", 1.0)])
+@pytest.mark.parametrize("mode,sigma", [
+    ("cocoa", 1.0),
+    # tier-1 budget: one arm keeps the fast-sweep parity signal; the
+    # plus/frozen arms run under -m slow and in the dedicated CI parity
+    # step (which runs this file unfiltered)
+    pytest.param("plus", 4.0, marks=pytest.mark.slow),
+    pytest.param("frozen", 1.0, marks=pytest.mark.slow)])
 def test_sparse_block_segmented_smem(tiny_data, monkeypatch, mode, sigma):
     """The SMEM row-segment tiling (the rcv1 regime, where a whole block's
     streams exceed the budget): shrink the budget so B=128 splits into
