@@ -196,6 +196,14 @@ GATES = (
     # (zero failed queries through a SIGKILL, one compile per bucket
     # per replica process, every replica hot-swapped, the victim
     # respawned) plus a catastrophic throughput floor.
+    # The tracing A/B rides the fleet row (ISSUE 19, docs/DESIGN.md
+    # §22): the COMMITTED row's tracing-on window must stay within
+    # max_trace_overhead_committed of its untraced twin (serve_bench's
+    # own 5% self-gate produced it); the fresh re-run — two more
+    # wall-clock windows on a shared runner — is held to a
+    # catastrophic bound only, plus the environment-robust axes: the
+    # sampled query_trace stream is schema-clean and assembled into a
+    # waterfall that names a dominant hop (tracing never goes dark).
     {
         "config": "serve-cpu-fleet",
         "runner": "serve",
@@ -205,7 +213,10 @@ GATES = (
         "baseline_config": "serve-cpu-synth",
         "qps_floor_frac": 0.25,
         "expected_compiles": 2,
-        "flags": ["--serveReplicas=2", "--duration=3"],
+        "max_trace_overhead_committed": 5.0,
+        "fresh_trace_overhead_bar": 25.0,
+        "flags": ["--serveReplicas=2", "--duration=3",
+                  "--trace-bar=25"],
     },
     # The warm-ingest row (ISSUE 15, docs/DESIGN.md §18): --ingestCache
     # serves device-ready shard slabs from memmap-able artifacts with
@@ -595,6 +606,15 @@ def serve_fleet_failures(gate: dict, fresh: dict,
                 f"{cfg}: COMMITTED ROW CARRIES {base.get('failed')} "
                 f"failed queries — a dead replica must requeue, never "
                 f"fail")
+        if (base.get("trace_overhead_pct") is not None
+                and base["trace_overhead_pct"]
+                > gate["max_trace_overhead_committed"]):
+            failures.append(
+                f"{cfg}: COMMITTED ROW OVER THE TRACING BAR — "
+                f"{base['trace_overhead_pct']:g}% qps overhead with "
+                f"sampled tracing on (bar "
+                f"{gate['max_trace_overhead_committed']:g}%); regen on "
+                f"a quiet machine, never commit one over the bar")
         floor = (base.get("qps") or 0) * gate["qps_floor_frac"]
         if (fresh.get("qps") or 0) < floor:
             failures.append(
@@ -622,6 +642,25 @@ def serve_fleet_failures(gate: dict, fresh: dict,
             f"(stopped={fresh.get('stopped')!r}: needs zero failures, "
             f"every replica swapped, the compile pin, and the "
             f"SIGKILLed replica respawned into routing)")
+    if fresh.get("trace_schema_errors"):
+        failures.append(
+            f"{cfg}: {fresh['trace_schema_errors']} schema violations "
+            f"in the sampled query_trace stream — the trace artifact "
+            f"stopped being machine-readable")
+    if "trace_overhead_pct" in fresh and fresh.get("dominant_hop") \
+            is None:
+        failures.append(
+            f"{cfg}: no sampled query_trace assembled into a "
+            f"waterfall — tracing went dark under the committed "
+            f"sampling rate")
+    if (fresh.get("trace_overhead_pct") or 0) \
+            > gate["fresh_trace_overhead_bar"]:
+        failures.append(
+            f"{cfg}: TRACING OVERHEAD COLLAPSE — fresh "
+            f"{fresh['trace_overhead_pct']:g}% qps overhead with "
+            f"sampled tracing on, over the "
+            f"{gate['fresh_trace_overhead_bar']:g}% catastrophic "
+            f"bound; the peel/stamp path got hot, not just the runner")
     return failures
 
 

@@ -99,6 +99,14 @@ EXPECTED_COMPILES_Q = 3
 T_FLEET = 4
 Q_PER_LINE = 16     # ';'-separated queries per protocol line
 FLEET_LINES = 64    # distinct preassembled lines cycled per client
+# the tracing A/B (docs/DESIGN.md §22): the committed fleet row carries
+# a tracing-on closed-loop window (every line trace=-prefixed, the
+# router samples 1 in TRACE_SAMPLE into query_trace events) against a
+# back-to-back untraced window of the same shape; the overhead of the
+# always-paid prefix peel + the sampled stamp/emit path must stay
+# under the --trace-bar (default 5%)
+TRACE_SAMPLE = 64
+TRACE_BAR_PCT = 5.0
 
 
 def train_checkpoints(ck: str):
@@ -393,6 +401,14 @@ def _fleet_lines(rng, n_lines):
     return lines
 
 
+def _traced_lines(lines):
+    """The tracing-on A/B variant: the SAME preassembled lines with a
+    client-chosen ``trace=<hex>;`` id prefixed (docs/DESIGN.md §22) —
+    the router peels every prefix and samples 1 in ``TRACE_SAMPLE``
+    into ``query_trace`` events."""
+    return [b"trace=%08x;" % j + ln for j, ln in enumerate(lines)]
+
+
 class _ClientStats:
     def __init__(self):
         self.lock = threading.Lock()
@@ -531,7 +547,62 @@ def _open_window(addr, lines, n_senders, duration_s, rate_qps):
     return stats, time.monotonic() - t0, offered[0] * Q_PER_LINE
 
 
-def _fleet_harness(ck, n_replicas, route, sla_ms, evdir, tag):
+def _paired_window(addr, lines_a, lines_b, n_conn, duration_s):
+    """The A/B inside ONE window: every connection strictly alternates
+    an A line and a B line, so both arms sample identical machine
+    conditions — scheduler drift, background compiles, and neighbor
+    load cancel exactly instead of landing on one arm (the interleaved
+    back-to-back form showed ±10% between identical windows on a busy
+    runner).  Per-arm closed-loop throughput is reconstructed from the
+    per-arm service time (the sum of that arm's own latencies)."""
+    stats = (_ClientStats(), _ClientStats())
+    stop_ev = threading.Event()
+
+    def worker(offset):
+        try:
+            s = socket.create_connection(addr, timeout=30)
+            s.settimeout(60)
+        except OSError:
+            with stats[0].lock:
+                stats[0].failed += 1
+            return
+        f = s.makefile("rwb")
+        arms = (lines_a, lines_b)
+        n, k = offset, 0
+        while not stop_ev.is_set():
+            arm = k % 2
+            k += 1
+            line = arms[arm][n % len(arms[arm])]
+            if arm == 1:
+                n += n_conn
+            t0 = time.monotonic()
+            try:
+                f.write(line)
+                f.flush()
+                resp = json.loads(f.readline())
+            except (OSError, ValueError):
+                with stats[arm].lock:
+                    stats[arm].failed += 1
+                break
+            stats[arm].record(resp, time.monotonic() - t0)
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    workers = [threading.Thread(target=worker, args=(c,), daemon=True)
+               for c in range(n_conn)]
+    for t in workers:
+        t.start()
+    time.sleep(duration_s)
+    stop_ev.set()
+    for t in workers:
+        t.join(30)
+    return stats
+
+
+def _fleet_harness(ck, n_replicas, route, sla_ms, evdir, tag,
+                   trace_sample=0):
     """Spawn ``n_replicas`` REAL CLI serve processes against the
     catalogue and put a router in front (the same classes the CLI
     fleet path composes)."""
@@ -548,7 +619,8 @@ def _fleet_harness(ck, n_replicas, route, sla_ms, evdir, tag):
         # the persistent XLA cache would hide warmup compiles from the
         # one-compile-per-bucket accounting — count real compiles
         env={"JAX_PLATFORMS": "cpu", "COCOA_NO_COMPILE_CACHE": "1"})
-    router = Router(fleet.start(), sla_s=sla_ms / 1000.0, route=route)
+    router = Router(fleet.start(), sla_s=sla_ms / 1000.0, route=route,
+                    trace_sample=trace_sample)
     fleet.attach(router)
     threading.Thread(target=router.serve_forever, daemon=True).start()
     return fleet, router
@@ -580,31 +652,65 @@ def measure_fleet(n_replicas, route, duration_s, threads, sla_ms,
 
     from cocoa_tpu import checkpoint as ckpt_lib
 
+    from cocoa_tpu.telemetry import events as tele_events
+
     rng = np.random.default_rng(23)
     w_cat = (rng.standard_normal((T_FLEET, D)) * 0.05).astype(
         np.float32)
     ck = tempfile.mkdtemp(prefix="serve-bench-fleet-")
+    # per-tenant certification metadata rides the catalogue checkpoint
+    # (docs/DESIGN.md §22): the replicas' tenant-labelled gap-age
+    # gauges are fed from it, so the bench writes what a fleet trainer
+    # would
     ckpt_lib.save(ck, "CoCoA+", 1, (w_cat * 0.95).astype(np.float32),
-                  None, gap=GAP_TARGET)
+                  None, gap=GAP_TARGET,
+                  tenant_gaps=[GAP_TARGET] * T_FLEET,
+                  tenant_cert_ts=[time.time()] * T_FLEET)
     evdir = tempfile.mkdtemp(prefix="serve-bench-fleet-ev-")
+    # the in-bench router emits the fleet-side query_trace events; give
+    # its bus a stream so the traces are a real artifact
+    router_ev = f"{evdir}/router.jsonl"
+    tele_events.get_bus().configure(jsonl_path=router_ev)
     lines = _fleet_lines(rng, FLEET_LINES)
+    traced = _traced_lines(lines)
     n_conn = max(4, threads)
     t_start = time.monotonic()
 
     print(f"serve_bench: spawning {n_replicas} fleet replicas "
           f"(catalogue {w_cat.shape}, route={route})", flush=True)
     fleet, router = _fleet_harness(ck, n_replicas, route, sla_ms,
-                                   evdir, "rep")
+                                   evdir, "rep",
+                                   trace_sample=TRACE_SAMPLE)
     try:
         # --- capacity: closed loop, catalogue hot-swap at the half ---
         cap, cap_wall = _closed_window(
             router.address, lines, n_conn, duration_s,
-            midpoint=lambda: ckpt_lib.save(ck, "CoCoA+", 2, w_cat,
-                                           None, gap=GAP_TARGET))
+            midpoint=lambda: ckpt_lib.save(
+                ck, "CoCoA+", 2, w_cat, None, gap=GAP_TARGET,
+                tenant_gaps=[GAP_TARGET] * T_FLEET,
+                tenant_cert_ts=[time.time()] * T_FLEET))
         qps = cap.answered / cap_wall
         print(f"serve_bench: fleet capacity {qps:.0f} qps "
               f"({cap.answered} answered / {cap_wall:.2f}s)",
               flush=True)
+
+        # --- tracing A/B: trace=-prefixed lines vs the same window ---
+        # one paired window, lines alternating per connection; the
+        # traced arm pays the per-line prefix peel on every line and
+        # the stamp/emit path on the sampled 1-in-TRACE_SAMPLE.  The
+        # overhead is the per-line mean-latency ratio of the two arms
+        trc, ab = _paired_window(router.address, traced, lines,
+                                 n_conn, duration_s)
+        trc_failed, ab_failed = trc.failed, ab.failed
+        t_mean = sum(trc.lats) / max(1, len(trc.lats))
+        u_mean = sum(ab.lats) / max(1, len(ab.lats))
+        traced_qps = trc.answered / max(1e-9, sum(trc.lats) / n_conn)
+        trace_overhead_pct = round(
+            max(0.0, 100.0 * (t_mean / max(1e-9, u_mean) - 1.0)), 2)
+        print(f"serve_bench: tracing A/B {traced_qps:.0f} qps traced, "
+              f"per-line {t_mean * 1e3:.3f}ms traced vs "
+              f"{u_mean * 1e3:.3f}ms untraced "
+              f"({trace_overhead_pct:g}% overhead)", flush=True)
 
         # --- overload: open loop past capacity — shed, don't queue ---
         if rate_qps <= 0:
@@ -663,6 +769,7 @@ def measure_fleet(n_replicas, route, duration_s, threads, sla_ms,
         shed_total = int(router.shed_total)
         requeued = int(router.requeue_total)
         failed = (int(router.failed_total) + cap.failed + over.failed
+                  + trc_failed + ab_failed
                   + drill.failed + tail.failed)
     finally:
         router.stop()
@@ -681,7 +788,28 @@ def measure_fleet(n_replicas, route, duration_s, threads, sla_ms,
         ctl_router.stop()
         ctl_fleet.stop()
         ctl_router.close()
+        tele_events.get_bus().reset()
     control_qps = ctl.answered / ctl_wall
+
+    # --- the sampled-trace artifact: schema-valid, assemblable -------
+    # the row commits the waterfall's verdict (the dominant hop), so a
+    # regression that stops traces from assembling fails the gate, not
+    # just the dashboard
+    from cocoa_tpu.telemetry import schema as tele_schema
+    from cocoa_tpu.telemetry import trace_report
+
+    trace_errs = tele_schema.check_file(router_ev)
+    if trace_errs:
+        print(f"serve_bench: trace stream schema violations: "
+              f"{trace_errs[:3]}", file=sys.stderr)
+    qts = trace_report.load_query_traces([router_ev])
+    wf = trace_report.query_waterfall(qts) if qts else None
+    dominant = wf["dominant_hop"] if wf else None
+    if wf:
+        print(f"serve_bench: {len(qts)} sampled traces — dominant hop "
+              f"{dominant} (p99 "
+              f"{wf['hops'][dominant]['p99_s'] * 1000.0:.3f}ms)",
+              flush=True)
 
     counts = [_replica_stream_counts(f"{evdir}/rep{i}.jsonl")
               for i in range(n_replicas)]
@@ -708,6 +836,11 @@ def measure_fleet(n_replicas, route, duration_s, threads, sla_ms,
         "control_qps": round(control_qps, 1),
         "scaling_eff": round(qps / (n_replicas * control_qps), 3),
         "rate_qps": float(rate_qps),
+        "traced_qps": round(traced_qps, 1),
+        "trace_overhead_pct": trace_overhead_pct,
+        "trace_sampled": len(qts),
+        "trace_schema_errors": len(trace_errs),
+        "dominant_hop": dominant,
         "shed": shed_total, "requeued": requeued, "failed": failed,
         "p50_ms": round(pct(0.50), 3), "p99_ms": round(pct(0.99), 3),
         "sla_ms": sla_ms,
@@ -756,6 +889,12 @@ def main(argv=None) -> int:
                     help="open-loop offered rate (queries/s) for the "
                          "fleet overload window; 0 = 4x the measured "
                          "capacity")
+    ap.add_argument("--trace-bar", type=float, default=TRACE_BAR_PCT,
+                    help="max tracing-on qps overhead (%%) the fleet "
+                         "A/B may show: the committed row holds the "
+                         "default 5%% acceptance bar; CI fresh re-runs "
+                         "pass a looser catastrophic bound "
+                         "(shared-runner wall-clock)")
     args = ap.parse_args(argv)
 
     if args.serveReplicas >= 2:
@@ -782,6 +921,21 @@ def main(argv=None) -> int:
         if row["stopped"] != "target":
             failures.append("the SIGKILLed replica was not respawned "
                             "and folded back into routing")
+        if row["trace_overhead_pct"] > args.trace_bar:
+            failures.append(f"tracing overhead "
+                            f"{row['trace_overhead_pct']:g}% over the "
+                            f"{args.trace_bar:g}% bar — the per-line "
+                            f"prefix peel or the sampled stamp/emit "
+                            f"path got expensive")
+        if row["trace_schema_errors"]:
+            failures.append(f"{row['trace_schema_errors']} schema "
+                            f"violations in the sampled query_trace "
+                            f"stream")
+        if row["dominant_hop"] is None:
+            failures.append("no sampled query_trace assembled into a "
+                            "waterfall — tracing went dark under the "
+                            "committed 1-in-"
+                            f"{TRACE_SAMPLE} sampling")
         for msg in failures:
             print(f"serve_bench FAIL: {msg}", file=sys.stderr)
         return 1 if failures else 0

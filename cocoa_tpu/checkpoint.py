@@ -50,6 +50,8 @@ def save(
     sched: Optional[jax.Array] = None,
     hist: Optional[jax.Array] = None,
     gap: Optional[float] = None,
+    tenant_gaps=None,
+    tenant_cert_ts=None,
 ) -> str:
     """Write checkpoint for ``round_t``; returns the file path.
 
@@ -75,6 +77,14 @@ def save(
     joins the ``.npz`` array set so an accelerated run's mid-momentum
     resume is bit-identical too.
 
+    ``tenant_gaps`` / ``tenant_cert_ts`` are the per-tenant
+    certification metadata of a stacked ``(T, d)`` catalogue: one
+    certified duality gap and one certification wall-clock timestamp
+    per tenant row (docs/DESIGN.md §22).  They ride the meta JSON like
+    ``sched`` (floats round-trip exactly), so the serving side can
+    export a ``tenant=``-labeled gap-age series without touching the
+    array set; single-model checkpoints simply omit them.
+
     Crash-safe: both files are written to temp names and renamed in, the
     ``.npz`` LAST — :func:`latest` discovers checkpoints by the ``.npz``,
     so a process killed mid-save (the exact scenario checkpoints exist
@@ -86,17 +96,38 @@ def save(
     with _tracing.span("checkpoint_save", algorithm=algorithm,
                        round=int(round_t)):
         return _save(directory, algorithm, round_t, w, alpha=alpha,
-                     seed=seed, sched=sched, hist=hist, gap=gap)
+                     seed=seed, sched=sched, hist=hist, gap=gap,
+                     tenant_gaps=tenant_gaps,
+                     tenant_cert_ts=tenant_cert_ts)
 
 
 def _save(directory, algorithm, round_t, w, alpha=None, seed=0,
-          sched=None, hist=None, gap=None) -> str:
+          sched=None, hist=None, gap=None, tenant_gaps=None,
+          tenant_cert_ts=None) -> str:
     os.makedirs(directory, exist_ok=True)
     algorithm = algorithm.replace(" ", "_")
     path = os.path.join(directory, f"{algorithm}-r{round_t:06d}.npz")
     meta = {"algorithm": algorithm, "round": round_t, "seed": seed}
     if gap is not None:
         meta["gap"] = float(gap)
+    if tenant_gaps is not None or tenant_cert_ts is not None:
+        # per-tenant certification metadata of a stacked catalogue:
+        # both lists or neither, and each must cover every tenant row —
+        # a partial list would silently mislabel the gap-age series
+        n_rows = int(np.shape(w)[0]) if len(np.shape(w)) == 2 else None
+        if n_rows is None:
+            raise ValueError(
+                "tenant_gaps/tenant_cert_ts only ride a stacked (T, d) "
+                f"catalogue checkpoint — w has shape {np.shape(w)}")
+        for name, vals in (("tenant_gaps", tenant_gaps),
+                           ("tenant_cert_ts", tenant_cert_ts)):
+            if vals is None or len(vals) != n_rows:
+                raise ValueError(
+                    f"{name} must carry one entry per tenant row: got "
+                    f"{None if vals is None else len(vals)} entries "
+                    f"for a {n_rows}-tenant catalogue")
+        meta["tenant_gaps"] = [float(v) for v in tenant_gaps]
+        meta["tenant_cert_ts"] = [float(v) for v in tenant_cert_ts]
     # array shapes recorded in the meta give :func:`validate` a
     # self-contained integrity check: a torn or bit-rotted archive whose
     # zip structure still opens is caught by the shape (or the member

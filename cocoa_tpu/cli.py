@@ -186,7 +186,7 @@ _EXTRA_FLAGS = ("mesh", "fp", "trajOut", "gapTarget", "resume", "scanChunk",
                 "staleRounds", "fleet", "fleetLanes",
                 "serve", "serveBatch", "serveSlaMs",
                 "serveMaxNnz", "serveDtype", "serveReplicas",
-                "serveRoute")  # run-level
+                "serveRoute", "traceSample", "statusPort")  # run-level
 
 _BOOL_FIELDS = {"just_cocoa"}
 _INT_FIELDS = {"num_features", "num_splits", "chkpt_iter", "num_rounds",
@@ -490,7 +490,10 @@ def main(argv=None) -> int:
                       ("serveDtype", "sets the serving precision"),
                       ("serveReplicas", "scales the scorer fleet"),
                       ("serveRoute", "selects the fleet routing "
-                                     "policy")):
+                                     "policy"),
+                      ("traceSample", "samples per-query distributed "
+                                      "traces"),
+                      ("statusPort", "serves the live ops plane")):
         if extras[dep] and not serve_flag:
             print(f"error: --{dep} {what} of the serving loop and needs "
                   f"--serve", file=sys.stderr)
@@ -536,6 +539,7 @@ def main(argv=None) -> int:
             "numFeatures", "trainFile", "hotCols", "quiet",
             "metrics", "events", "trace", "flightRecorder",
             "eventsMaxMB", "metricsInterval", "seed",
+            "traceSample", "statusPort",
         }
         explicit = getattr(cfg, "_explicit", frozenset())
         for key in sorted(explicit - allowed):
@@ -2032,7 +2036,8 @@ def _run_fleet_cli(cfg, extras, quiet, bus, cfg_manifest, fleet_lanes,
 
 def _run_serve_fleet(cfg, extras, quiet, bus, port, buckets, sla_ms,
                      max_nnz, serve_dtype, n_replicas, route,
-                     algorithm, n_tenants):
+                     algorithm, n_tenants, trace_sample=0,
+                     status_port=None):
     """The ``--serveReplicas>=2`` execution path (docs/DESIGN.md §21):
     spawn N ordinary single-process serve replicas against the same
     validated --chkptDir (each hot-swaps independently; slabs and
@@ -2040,7 +2045,13 @@ def _run_serve_fleet(cfg, extras, quiet, bus, port, buckets, sla_ms,
     put the router front door on the requested port, and relay the
     line protocol until ``shutdown`` or SIGTERM.  The front door holds
     no model and no JAX — replica death is a requeue, never a failed
-    query, and the monitor respawns the dead."""
+    query, and the monitor respawns the dead.
+
+    Tracing and the ops plane (docs/DESIGN.md §22) both live at the
+    front door: the ROUTER samples ``trace=``-prefixed lines (it sees
+    the whole lifecycle — queue, forward, requeues), and the
+    ``--statusPort`` plane scrapes the front door's textfile plus every
+    replica's ``.r<i>`` slot file with the router's own liveness map."""
     import signal
 
     from cocoa_tpu.serving.fleet import ServeFleet
@@ -2052,12 +2063,23 @@ def _run_serve_fleet(cfg, extras, quiet, bus, port, buckets, sla_ms,
                 f"--serveSlaMs={sla_ms:g}",
                 f"--serveMaxNnz={max_nnz}",
                 f"--serveDtype={serve_dtype}", "--quiet"]
-    # per-replica telemetry sinks ride the front door's --events path
-    # with an .r<i> suffix — how the smoke counts compiles per replica
+    # per-replica telemetry sinks ride the front door's --events and
+    # --metrics paths with an .r<i> suffix — how the smoke counts
+    # compiles per replica, and how the ops plane attributes merged
+    # /metrics samples.  The suffix is the replica's SLOT: a respawn
+    # reuses index i, so the new process inherits (atomically
+    # overwrites) the dead one's files — two writers never interleave
+    ev_path = extras["events"]
+    metrics_path = extras["metrics"]
     extra_fn = None
-    if extras["events"]:
-        ev_path = extras["events"]
-        extra_fn = (lambda i: [f"--events={ev_path}.r{i}"])
+    if ev_path or metrics_path:
+        def extra_fn(i):
+            argv = []
+            if ev_path:
+                argv.append(f"--events={ev_path}.r{i}")
+            if metrics_path:
+                argv.append(f"--metrics={metrics_path}.r{i}")
+            return argv
 
     def echo(s):
         # replica pid/port notes are operational plumbing (the smoke
@@ -2074,7 +2096,8 @@ def _run_serve_fleet(cfg, extras, quiet, bus, port, buckets, sla_ms,
         print(f"error: {e}", file=sys.stderr)
         return 1
     router = Router(members, sla_s=sla_ms / 1000.0, route=route,
-                    port=port, algorithm=algorithm)
+                    port=port, algorithm=algorithm,
+                    trace_sample=trace_sample)
     fleet.attach(router)
     router.emit_initial_state()
     host, bound = router.address[0], router.address[1]
@@ -2090,6 +2113,28 @@ def _run_serve_fleet(cfg, extras, quiet, bus, port, buckets, sla_ms,
     if writer is not None:
         writer.start_heartbeat(5.0)
 
+    # --statusPort: the fleet ops plane — scrape the front door's own
+    # textfile plus every replica's .r<i> slot file, with the router's
+    # live map driving /healthz (a SIGKILLed replica shows live=false
+    # until the monitor's respawn re-registers it)
+    status = None
+    if status_port is not None:
+        from cocoa_tpu.telemetry.aggregate import StatusServer
+
+        def _sources():
+            out = {"router": metrics_path}
+            for i in range(n_replicas):
+                out[f"r{i}"] = f"{metrics_path}.r{i}"
+            return out
+
+        status = StatusServer(
+            _sources, sla_s=sla_ms / 1000.0, port=status_port,
+            algorithm=algorithm,
+            liveness_fn=lambda: {r.name: r.live
+                                 for r in router.replicas}).start()
+        print(f"serve: status listening on "
+              f"{status.address[0]}:{status.address[1]}", flush=True)
+
     def _stop(signum, frame):
         router.stop()
 
@@ -2100,6 +2145,8 @@ def _run_serve_fleet(cfg, extras, quiet, bus, port, buckets, sla_ms,
     finally:
         signal.signal(signal.SIGTERM, prev[0])
         signal.signal(signal.SIGINT, prev[1])
+        if status is not None:
+            status.stop()
         if writer is not None:
             writer.stop_heartbeat()
         fleet.stop()
@@ -2179,6 +2226,42 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
     n_replicas = (int(extras["serveReplicas"])
                   if extras["serveReplicas"] else 1)
     route = extras["serveRoute"] or "rr"
+    # --traceSample=N: 1 in N trace=-prefixed lines gets a sampled
+    # query trace (docs/DESIGN.md §22); 0 disarms — the prefix is
+    # peeled and answers stay byte-identical.  Bare --traceSample is
+    # the documented default of 64.
+    trace_sample = 0
+    if extras["traceSample"]:
+        raw = str(extras["traceSample"])
+        try:
+            trace_sample = 64 if raw.lower() == "true" else int(raw)
+        except ValueError:
+            trace_sample = -1
+        if trace_sample < 0:
+            print(f"error: --traceSample takes a sampling divisor "
+                  f">= 0 (1 in N traced; 0 = off; bare flag = 64), "
+                  f"got {extras['traceSample']!r}", file=sys.stderr)
+            return 2
+    # --statusPort=PORT (0/bare = ephemeral): the live ops plane
+    # (telemetry/aggregate.py, docs/DESIGN.md §22) — /metrics /healthz
+    # /slo over the metrics textfiles the serve processes write
+    status_port = None
+    if extras["statusPort"] is not None:
+        raw = str(extras["statusPort"])
+        try:
+            status_port = 0 if raw.lower() == "true" else int(raw)
+        except ValueError:
+            status_port = -1
+        if status_port < 0 or status_port > 65535:
+            print(f"error: --statusPort takes a TCP port (0 = "
+                  f"ephemeral), got {extras['statusPort']!r}",
+                  file=sys.stderr)
+            return 2
+        if not extras["metrics"]:
+            print("error: --statusPort serves the ops plane by "
+                  "scraping the metrics textfile(s) and needs "
+                  "--metrics", file=sys.stderr)
+            return 2
 
     d = cfg.num_features
     dtype = jnp.dtype(cfg.dtype)
@@ -2284,7 +2367,7 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
         return _run_serve_fleet(cfg, extras, quiet, bus, port, buckets,
                                 sla_ms, max_nnz, serve_dtype,
                                 n_replicas, route, algorithm,
-                                n_tenants)
+                                n_tenants, trace_sample, status_port)
 
     # the calibration ring the per-swap certificate is computed over:
     # warmup-seeded now, refilled by real traffic as it arrives
@@ -2330,7 +2413,9 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
     watcher = serving.SwapWatcher(slots, cfg.chkpt_dir, algorithm,
                                   poll_s=0.25, on_swap=note_swap).start()
     server = serving.MarginServer(batcher, d, max_nnz, port=port,
-                                  n_tenants=n_tenants)
+                                  n_tenants=n_tenants,
+                                  trace_sample=trace_sample,
+                                  algorithm=algorithm)
     host, bound = server.address[0], server.address[1]
     # the announce line is operational plumbing (the smoke parses it),
     # not chatter — it prints even under --quiet
@@ -2349,6 +2434,19 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
     if writer is not None:
         writer.start_heartbeat(5.0)
 
+    # --statusPort: the solo ops plane — one source (this process's
+    # own textfile), no router liveness to merge
+    status = None
+    if status_port is not None:
+        from cocoa_tpu.telemetry.aggregate import StatusServer
+
+        metrics_path = extras["metrics"]
+        status = StatusServer(lambda: {"server": metrics_path},
+                              sla_s=sla_ms / 1000.0, port=status_port,
+                              algorithm=algorithm).start()
+        print(f"serve: status listening on "
+              f"{status.address[0]}:{status.address[1]}", flush=True)
+
     def _stop(signum, frame):
         server.stop()
 
@@ -2359,6 +2457,8 @@ def _run_serve_cli(cfg, extras, quiet, bus, cfg_manifest, serve_flag):
     finally:
         signal.signal(signal.SIGTERM, prev[0])
         signal.signal(signal.SIGINT, prev[1])
+        if status is not None:
+            status.stop()
         if writer is not None:
             writer.stop_heartbeat()
         watcher.stop()

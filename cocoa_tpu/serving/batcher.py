@@ -53,9 +53,10 @@ class PendingQuery:
     """One in-flight request: parsed arrays in, margin (or error) out."""
 
     __slots__ = ("idx", "val", "tenant", "t_enq", "done", "margin",
-                 "error", "model_round", "served_dtype")
+                 "error", "model_round", "served_dtype", "traced",
+                 "queue_s", "device_s", "bucket", "gap_age_s")
 
-    def __init__(self, idx, val, tenant=None):
+    def __init__(self, idx, val, tenant=None, traced=False):
         self.idx = idx
         self.val = val
         self.tenant = tenant
@@ -65,6 +66,15 @@ class PendingQuery:
         self.error = None
         self.model_round = None
         self.served_dtype = None
+        # sampled query tracing (docs/DESIGN.md §22): a traced query
+        # gets its batch's hop breakdown stamped at completion — the
+        # untraced hot path pays one boolean test per query, nothing
+        # else (the bit-identity / ≤5%-overhead contract)
+        self.traced = traced
+        self.queue_s = None
+        self.device_s = None
+        self.bucket = None
+        self.gap_age_s = None
 
     def result(self, timeout: Optional[float] = None) -> float:
         if not self.done.wait(timeout):
@@ -107,14 +117,16 @@ class MicroBatcher:
                                         name="cocoa-serve-batcher")
         self._thread.start()
 
-    def submit(self, idx, val, tenant=None) -> PendingQuery:
+    def submit(self, idx, val, tenant=None, traced=False) -> PendingQuery:
         """Enqueue one parsed query; returns its pending handle.
 
         ``tenant`` is the catalogue row the query scores against (fleet
-        serving, docs/DESIGN.md §21) — None on a single-model scorer."""
+        serving, docs/DESIGN.md §21) — None on a single-model scorer.
+        ``traced`` marks a sampled query (--traceSample): its batch's
+        hop breakdown is stamped onto the handle at completion."""
         if self._calibration is not None:
             self._calibration.record(idx, val)
-        pend = PendingQuery(idx, val, tenant)
+        pend = PendingQuery(idx, val, tenant, traced=traced)
         self._q.put(pend)
         return pend
 
@@ -214,10 +226,23 @@ class MicroBatcher:
             # hot-swap
             served = {"uint32": "bf16", "int32": "int8"} \
                 .get(str(np.dtype(w_dev.dtype)), "f32")
+            gap_age = None   # computed once per batch, only if traced
             for r, p in enumerate(batch):
                 p.margin = float(margins[r])
                 p.model_round = info.round
                 p.served_dtype = served
+                if p.traced:
+                    # the per-query hop breakdown a sampled trace
+                    # reads back (server.py): admission queue vs this
+                    # batch's device dispatch, the bucket it padded
+                    # into, and the answering certificate's age
+                    if gap_age is None:
+                        gap_age = max(0.0, time.time()
+                                      - info.birth_ts)
+                    p.queue_s = t_score - p.t_enq
+                    p.device_s = device_s
+                    p.bucket = bucket
+                    p.gap_age_s = gap_age
                 p.done.set()
             self.batches_total += 1
             self.requests_total += len(batch)

@@ -36,12 +36,19 @@ spawned CLI replicas (:mod:`cocoa_tpu.serving.fleet`) identically.
 
 from __future__ import annotations
 
+import itertools
 import json
+import re
 import socket
 import socketserver
 import threading
 import time
 from typing import Optional
+
+# client-chosen trace ids (docs/DESIGN.md §22) — same grammar the
+# replica enforces (serving/server.py); a prefix that fails it is left
+# on the line so the replica rejects it with the numbers
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{1,32}$")
 
 # fraction of the SLA the projected wait may consume before the router
 # sheds; the remainder absorbs estimate error + the hop itself
@@ -149,7 +156,8 @@ class Router:
 
     def __init__(self, replicas, sla_s: float = 0.05,
                  route: str = "rr", host: str = "127.0.0.1",
-                 port: int = 0, algorithm: str = "serve"):
+                 port: int = 0, algorithm: str = "serve",
+                 trace_sample: int = 0):
         if route not in self.ROUTES:
             raise ValueError(f"unknown route policy {route!r}: "
                              f"expected one of {self.ROUTES}")
@@ -160,6 +168,14 @@ class Router:
         self.sla_s = float(sla_s)
         self.route = route
         self.algorithm = algorithm
+        # sampled query tracing (--traceSample, docs/DESIGN.md §22):
+        # 1 in N ``trace=``-prefixed lines is traced end to end — the
+        # router strips the prefix from the rest (the replica then does
+        # zero trace work and answers byte-identically to an untraced
+        # line) and re-stamps sampled lines with its own queue time so
+        # the replica knows the line is already sampled upstream
+        self.trace_sample = int(trace_sample)
+        self._trace_seen = itertools.count()
         self._rr = 0
         self._lock = threading.Lock()
         self.forwarded_total = 0
@@ -211,7 +227,8 @@ class Router:
             if rep.live:
                 self._emit_replica(rep, "live")
 
-    def _emit_replica(self, rep, state, requeued: int = 0):
+    def _emit_replica(self, rep, state, requeued: int = 0,
+                      trace_id: Optional[str] = None):
         from cocoa_tpu.telemetry import events as tele_events
 
         bus = tele_events.get_bus()
@@ -219,9 +236,33 @@ class Router:
             bus.emit("replica_state", algorithm=self.algorithm,
                      replica=rep.name, state=state,
                      replicas_live=self.replicas_live(),
-                     requeued=requeued)
+                     requeued=requeued, trace_id=trace_id)
 
     # --- routing -----------------------------------------------------------
+
+    def _peel_trace(self, line: str):
+        """Strip the optional ``trace=<id>;`` prefix (docs/DESIGN.md
+        §22); returns ``(trace_id_or_None, rest)``.  A prefix that
+        fails the id grammar is left on the line untouched — the
+        replica rejects it with the numbers, keeping the router a pure
+        relay for malformed input."""
+        if not line.startswith("trace="):
+            return None, line
+        head, sep, rest = line.partition(";")
+        tid = head[len("trace="):]
+        if not sep or not _TRACE_ID_RE.match(tid):
+            return None, line
+        return tid, rest
+
+    def _sample(self) -> bool:
+        """Deterministic 1-in-N gate over trace-prefixed lines (the
+        first is always sampled); 0 disarms tracing.  The counter is an
+        ``itertools.count`` — atomic in CPython without taking the
+        router lock, so the gate costs the hot path nothing."""
+        n = self.trace_sample
+        if n <= 0:
+            return False
+        return next(self._trace_seen) % n == 0
 
     def _peel_tenant(self, line: str) -> Optional[int]:
         if not line.startswith("tenant="):
@@ -254,16 +295,19 @@ class Router:
             start = self._rr
         return live[start % len(live)]
 
-    def _shed(self, line, tenant, est_s, inflight):
+    def _shed(self, line, tenant, est_s, inflight,
+              trace_id: Optional[str] = None):
         self.shed_total += 1
         from cocoa_tpu.telemetry import events as tele_events
 
         bus = tele_events.get_bus()
         if bus.active():
+            # trace_id: the exemplar — a shed spike in the counter now
+            # names concrete refused queries to go look at
             bus.emit("serve_shed", algorithm=self.algorithm,
                      route=self.route, tenant=tenant,
                      inflight=inflight, est_s=est_s,
-                     sla_s=self.sla_s)
+                     sla_s=self.sla_s, trace_id=trace_id)
         return {"error": f"shed: projected wait {est_s * 1e3:.1f} ms "
                          f"exceeds the shed budget "
                          f"{self.sla_s * _SHED_HEADROOM * 1e3:.1f} ms "
@@ -274,6 +318,9 @@ class Router:
     def answer_line(self, line: str):
         """Route one request line; returns the replica's raw response
         bytes (relayed verbatim) or a router-level JSON object."""
+        t_recv = time.monotonic()
+        trace_id, line = self._peel_trace(line)
+        sampled = trace_id is not None and self._sample()
         tenant = self._peel_tenant(line)
         # --- admission: shed only if EVERY live replica projects past
         # the budget (an unmeasured replica projects 0.0 → admits).
@@ -288,11 +335,12 @@ class Router:
             best = min(self._live(), key=Replica.projected_wait_s)
             if best.projected_wait_s() > budget and best.inflight > 0:
                 return self._shed(line, tenant, best.projected_wait_s(),
-                                  best.inflight)
+                                  best.inflight, trace_id=trace_id)
             rep = best
         # --- admitted: forward, requeueing past dead replicas; never
         # fail while a live replica exists or can still come back
         tried = set()
+        requeues = 0
         deadline = time.monotonic() + _REVIVE_WAIT_S
         while True:
             if rep is None:
@@ -306,17 +354,72 @@ class Router:
                 tried.clear()   # a respawn may reuse the name
                 rep = self._pick(tenant, exclude=tried)
                 continue
-            resp = self._forward(rep, line)
+            t_fwd = time.monotonic()
+            fwd_line = line
+            if sampled:
+                # re-stamp per attempt: the prefix carries THIS line's
+                # accumulated router queue (admission + revive waits)
+                # in microseconds, and its colon form tells the replica
+                # the line is already sampled — the replica stamps its
+                # hops into the response and emits nothing
+                fwd_line = (f"trace={trace_id}:"
+                            f"{int((t_fwd - t_recv) * 1e6)};{line}")
+            resp = self._forward(rep, fwd_line)
             if resp is not None:
                 self.forwarded_total += 1
+                if sampled:
+                    self._emit_trace(trace_id, tenant, rep, resp,
+                                     t_recv, t_fwd, requeues)
                 return resp
             # replica died under us: dead + requeue, stats first so
             # the gauges already show the requeue when the event lands
             self.mark_dead(rep)
             self.requeue_total += 1
-            self._emit_replica(rep, "requeue", requeued=1)
+            requeues += 1
+            self._emit_replica(rep, "requeue", requeued=1,
+                               trace_id=trace_id)
             tried.add(rep.name)
             rep = self._pick(tenant, exclude=tried)
+
+    def _emit_trace(self, trace_id, tenant, rep, resp, t_recv, t_fwd,
+                    requeues):
+        """The fleet-mode ``query_trace`` event: the router saw the
+        whole lifecycle, so it owns the emission.  Replica-side hops
+        ride back in the response's ``"trace"`` object (relayed to the
+        client verbatim); the forward hop is the wire + relay residual
+        once those are subtracted."""
+        from cocoa_tpu.telemetry import events as tele_events
+
+        bus = tele_events.get_bus()
+        if not bus.active():
+            return
+        t_reply = time.monotonic()
+        tobj = None
+        try:
+            reply = json.loads(resp.decode("utf-8", errors="replace"))
+            entries = reply if isinstance(reply, list) else [reply]
+            for entry in entries:
+                if isinstance(entry, dict) and "trace" in entry:
+                    tobj = entry["trace"]
+                    break
+        except (ValueError, AttributeError):
+            pass   # a malformed reply still gets its router-side hops
+        tobj = tobj if isinstance(tobj, dict) else {}
+        replica_total = sum(tobj.get(k) or 0.0
+                            for k in ("replica_queue_s", "device_s",
+                                      "serialize_s"))
+        bus.emit("query_trace", algorithm=self.algorithm,
+                 trace_id=trace_id, tenant=tenant, replica=rep.name,
+                 router_queue_s=t_fwd - t_recv,
+                 forward_s=max(0.0, (t_reply - t_fwd) - replica_total),
+                 replica_queue_s=tobj.get("replica_queue_s"),
+                 device_s=tobj.get("device_s"),
+                 serialize_s=tobj.get("serialize_s"),
+                 total_s=t_reply - t_recv,
+                 bucket=tobj.get("bucket"),
+                 model_round=tobj.get("round"),
+                 gap_age_s=tobj.get("gap_age_s"),
+                 dtype=tobj.get("dtype"), requeues=requeues)
 
     def _forward(self, rep: Replica, line: str):
         """One attempt against one replica; None means the replica is

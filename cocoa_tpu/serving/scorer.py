@@ -125,6 +125,13 @@ class ModelInfo(NamedTuple):
     gap: Optional[float]       # certified duality gap the checkpoint
                                # meta recorded (None on pre-gap metas)
     seq: int                   # swap sequence number (0 = initial load)
+    # per-tenant certification metadata of a stacked (T, d) catalogue
+    # (checkpoint meta tenant_gaps / tenant_cert_ts, docs/DESIGN.md
+    # §22): one certified gap and one certification wall-clock per
+    # tenant row — what the tenant-labeled gap-age gauge renders from.
+    # None on single-model checkpoints and pre-fleet metas
+    tenant_gaps: Optional[tuple] = None
+    tenant_cert_ts: Optional[tuple] = None
 
 
 class ModelSlots:

@@ -57,8 +57,15 @@ def load_model(path: str):
         birth = os.stat(path).st_mtime
     except OSError:
         birth = time.time()
+    # per-tenant certification metadata of a stacked catalogue rides
+    # the meta (checkpoint.save tenant_gaps/tenant_cert_ts) — tuples so
+    # the published ModelInfo stays immutable like every other field
+    tg = meta.get("tenant_gaps")
+    tc = meta.get("tenant_cert_ts")
     info = ModelInfo(round=meta.get("round"), path=path, birth_ts=birth,
-                     gap=meta.get("gap"), seq=0)
+                     gap=meta.get("gap"), seq=0,
+                     tenant_gaps=None if tg is None else tuple(tg),
+                     tenant_cert_ts=None if tc is None else tuple(tc))
     return arrays["w"], info
 
 
@@ -171,4 +178,8 @@ def emit_model_swap(algorithm: str, info: ModelInfo):
                         else None),
                  path=info.path, birth_ts=info.birth_ts, gap=info.gap,
                  gap_age_s=max(0.0, time.time() - info.birth_ts),
-                 swap_seq=info.seq)
+                 swap_seq=info.seq,
+                 tenant_gaps=(None if info.tenant_gaps is None
+                              else list(info.tenant_gaps)),
+                 tenant_cert_ts=(None if info.tenant_cert_ts is None
+                                 else list(info.tenant_cert_ts)))

@@ -131,6 +131,27 @@ EVENT_TYPES = (
                         # line was requeued by it — what feeds
                         # cocoa_serve_replicas_live /
                         # cocoa_serve_requeue_total
+    "query_trace",      # one sampled end-to-end query trace
+                        # (--traceSample, docs/DESIGN.md §22): the
+                        # client-chosen trace id plus per-hop seconds —
+                        # router queue, forward (network + relay),
+                        # replica admission queue, device dispatch,
+                        # protocol parse/serialize — stamped with the
+                        # answering model generation, its gap age, the
+                        # serving dtype, the bucket, and how many times
+                        # the line requeued.  Emitted by the router in
+                        # fleet mode (it sees the whole lifecycle) and
+                        # by the solo server otherwise — what feeds
+                        # cocoa_query_traces_total and what
+                        # trace_report --queries assembles into the
+                        # per-hop waterfall
+    "slo_status",       # one /slo evaluation (telemetry/aggregate.py):
+                        # rolling SLA attainment over the fleet-wide
+                        # latency histogram plus the fast/slow
+                        # multi-window burn rates against the
+                        # attainment objective — the ops plane's
+                        # machine-readable answer to "is the fleet
+                        # inside its SLA right now"
 )
 
 

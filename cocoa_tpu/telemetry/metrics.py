@@ -212,6 +212,13 @@ class MetricsWriter:
         self.serve_lat_count = 0
         self.model_swaps_total = 0
         self.model_birth_ts = None      # live model's certificate birth
+        self.model_round = None         # live model's training round
+        # per-tenant certification wall-clocks of a served catalogue
+        # (model_swap tenant_cert_ts): the tenant-labeled gap-age series
+        # renders from these at render time, like the unlabeled gauge
+        self.tenant_cert_ts = None
+        self.tenant_gaps = None
+        self.query_traces_total = 0     # sampled query_trace events
         self.serve_quantize_seen = False
         self.serve_margin_error_bound = None
         self.serve_dtype_fallbacks_total = 0
@@ -370,6 +377,14 @@ class MetricsWriter:
                 self.model_swaps_total += 1
             if rec.get("birth_ts") is not None:
                 self.model_birth_ts = float(rec["birth_ts"])
+            if rec.get("round") is not None:
+                self.model_round = int(rec["round"])
+            if rec.get("tenant_cert_ts") is not None:
+                self.tenant_cert_ts = [float(t) for t
+                                       in rec["tenant_cert_ts"]]
+            if rec.get("tenant_gaps") is not None:
+                self.tenant_gaps = [float(g) if g is not None else None
+                                    for g in rec["tenant_gaps"]]
         elif ev == "model_quantize":
             self.serve_quantize_seen = True
             if rec.get("bound") is not None:
@@ -383,6 +398,8 @@ class MetricsWriter:
         elif ev == "serve_shed":
             self.fleet_serve_seen = True
             self.serve_shed_total += 1
+        elif ev == "query_trace":
+            self.query_traces_total += 1
         elif ev == "replica_state":
             self.fleet_serve_seen = True
             if rec.get("replicas_live") is not None:
@@ -572,11 +589,23 @@ class MetricsWriter:
             lines.append(f"cocoa_serve_latency_seconds_count "
                          f"{self.serve_lat_count}")
         if self.model_birth_ts is not None:
-            age = max(0.0, time.time() - self.model_birth_ts)
+            now = time.time()
+            age = max(0.0, now - self.model_birth_ts)
             lines += ["# TYPE cocoa_model_swaps_total counter",
                       f"cocoa_model_swaps_total {self.model_swaps_total}",
                       "# TYPE cocoa_model_gap_age_seconds gauge",
                       f"cocoa_model_gap_age_seconds {age!r}"]
+            if self.tenant_cert_ts:
+                # the catalogue's per-tenant freshness (docs/DESIGN.md
+                # §22): seconds since EACH tenant row's certificate was
+                # produced — the labeled series sits under the same
+                # family as the whole-catalogue gauge above
+                lines += [f'cocoa_model_gap_age_seconds{{tenant="{t}"}} '
+                          f"{max(0.0, now - ts)!r}"
+                          for t, ts in enumerate(self.tenant_cert_ts)]
+            if self.model_round is not None:
+                lines += ["# TYPE cocoa_model_round gauge",
+                          f"cocoa_model_round {self.model_round}"]
         if self.serve_quantize_seen:
             # quantized-serving families render only once a --serveDtype
             # run published (f32 serves must not carry zero-valued
@@ -601,6 +630,12 @@ class MetricsWriter:
                 lines += ["# TYPE cocoa_serve_replicas_live gauge",
                           f"cocoa_serve_replicas_live "
                           f"{self.serve_replicas_live}"]
+        if self.query_traces_total:
+            # sampled tracing families render only once a --traceSample
+            # run emitted (untraced serves must not carry zero series)
+            lines += ["# TYPE cocoa_query_traces_total counter",
+                      f"cocoa_query_traces_total "
+                      f"{self.query_traces_total}"]
         if self.theta_stage is not None:
             lines += ["# TYPE cocoa_theta_stage gauge",
                       f"cocoa_theta_stage {self.theta_stage}"]

@@ -167,9 +167,16 @@ EVENT_FIELDS = {
     # cocoa_model_gap_age_seconds (birth_ts = the checkpoint's mtime =
     # when its certificate was produced); gap is the certified duality
     # gap the checkpoint meta recorded (None on pre-gap metas)
+    # tenant_gaps / tenant_cert_ts ride the stacked catalogue's
+    # per-tenant certification metadata (checkpoint meta, docs/DESIGN.md
+    # §21-22): one certified gap and one certification wall-clock per
+    # tenant row, None on single-model checkpoints — what feeds the
+    # tenant-labeled cocoa_model_gap_age_seconds series
     "model_swap": {"algorithm": (str,), "round": (int, type(None)),
                    "path": (str,), "birth_ts": _NUM, "gap": _OPT_NUM,
-                   "gap_age_s": _NUM, "swap_seq": (int,)},
+                   "gap_age_s": _NUM, "swap_seq": (int,),
+                   "tenant_gaps": (list, type(None)),
+                   "tenant_cert_ts": (list, type(None))},
     # one --serveDtype publish decision (serving/scorer.ModelSlots):
     # served == serve_dtype when the generation certified, "f32" on a
     # certificate fallback (fallback=1); bound is the measured
@@ -189,18 +196,60 @@ EVENT_FIELDS = {
     # an SLA violation.  tenant None = an untagged line; inflight /
     # est_s describe the BEST live replica at the decision — what feeds
     # cocoa_serve_shed_total
+    # trace_id: the exemplar — when the refused line carried a trace
+    # context, the shed counter names a concrete query to go look at
     "serve_shed": {"algorithm": (str,), "route": (str,),
                    "tenant": (int, type(None)), "inflight": (int,),
-                   "est_s": _NUM, "sla_s": _NUM},
+                   "est_s": _NUM, "sla_s": _NUM,
+                   "trace_id": (str, type(None))},
     # one fleet replica liveness transition (serving/router.py /
     # fleet.py): state "dead" (connection or process died), "requeue"
     # (a request line replayed off the dead replica, requeued=1), or
     # "live" (the monitor respawned it).  replicas_live is the live
     # count AFTER the transition — what feeds
     # cocoa_serve_replicas_live / cocoa_serve_requeue_total
+    # trace_id: the requeue exemplar — the trace context of the line
+    # that was replayed off the dead replica (None on live/dead
+    # transitions and untraced requeues)
     "replica_state": {"algorithm": (str,), "replica": (str,),
                       "state": (str,), "replicas_live": (int,),
-                      "requeued": (int,)},
+                      "requeued": (int,),
+                      "trace_id": (str, type(None))},
+    # one sampled end-to-end query trace (--traceSample, docs/DESIGN.md
+    # §22).  Hop seconds are None where the hop does not exist: a solo
+    # server has no router_queue/forward hop, a line the replica
+    # rejected at parse has no replica-side hops.  requeues counts how
+    # many dead replicas the line replayed past before answering;
+    # replica names the answerer (None solo).  The model stamp (round,
+    # gap age, dtype, bucket) is the generation that ANSWERED — how a
+    # trace correlates a slow query with a stale or quantized model
+    "query_trace": {"algorithm": (str,), "trace_id": (str,),
+                    "tenant": (int, type(None)),
+                    "replica": (str, type(None)),
+                    "router_queue_s": _OPT_NUM,
+                    "forward_s": _OPT_NUM,
+                    "replica_queue_s": _OPT_NUM,
+                    "device_s": _OPT_NUM,
+                    "serialize_s": _OPT_NUM,
+                    "total_s": _NUM,
+                    "bucket": (int, type(None)),
+                    "model_round": (int, type(None)),
+                    "gap_age_s": _OPT_NUM,
+                    "dtype": (str, type(None)),
+                    "requeues": (int,)},
+    # one /slo evaluation (telemetry/aggregate.py): attainment = the
+    # fraction of served lines inside the SLA over the rolling window
+    # (None until the histogram has data); burn_fast / burn_slow = the
+    # multi-window error-budget burn rates ((1 - attainment) / (1 -
+    # objective)) over the fast and slow windows — a burn > 1 on BOTH
+    # is the page-worthy signal (fast-only = a blip, slow-only = an
+    # old incident draining out)
+    "slo_status": {"algorithm": (str,), "sla_ms": _NUM,
+                   "objective": _NUM, "window_fast_s": _NUM,
+                   "window_slow_s": _NUM, "attainment": _OPT_NUM,
+                   "burn_fast": _OPT_NUM, "burn_slow": _OPT_NUM,
+                   "served_total": (int,), "over_sla_total": (int,),
+                   "replicas_live": (int, type(None))},
 }
 
 # --fleet manifest dialect (data/fleet.py): a ``fleet_manifest`` header
@@ -311,6 +360,16 @@ RESULTS_FIELDS = {
     "replicas": (int,), "route": (str,), "rate_qps": _NUM,
     "control_qps": _NUM, "scaling_eff": _NUM, "shed": (int,),
     "requeued": (int,), "failed": (int,), "killed": (int,),
+    # the per-query tracing A/B riding the fleet row (--serveReplicas,
+    # docs/DESIGN.md §22): closed-loop qps with every line
+    # trace=-prefixed (1-in-N sampled into query_trace events) vs the
+    # same-shape untraced window, the measured overhead percentage
+    # (gated ≤5% on the committed row), the sampled-trace count, the
+    # trace stream's schema-violation count (gated 0), and the
+    # waterfall's dominant hop over the run's sampled traces
+    "traced_qps": _NUM, "trace_overhead_pct": _NUM,
+    "trace_sampled": (int,), "trace_schema_errors": (int,),
+    "dominant_hop": (str, type(None)),
 }
 
 

@@ -33,6 +33,11 @@ the postmortem/analysis half:
   same numbers render as ``cocoa_phase_seconds{worker,phase}`` and
   ``cocoa_straggler_slack_seconds{worker,phase}`` gauges (``--metrics``)
   for dashboards that already scrape the run's textfiles.
+- **query waterfall** (``--queries``) — assemble the sampled
+  ``query_trace`` events (--traceSample, docs/DESIGN.md §22) into a
+  per-hop p50/p99 waterfall over the serving pipeline — router queue /
+  forward / replica queue / device / serialize — and name the DOMINANT
+  hop (largest p99): the one answer a latency incident needs first.
 """
 
 from __future__ import annotations
@@ -330,6 +335,114 @@ def metrics_text(spans) -> str:
     return "\n".join(lines) + "\n"
 
 
+# --- query waterfall (--queries) --------------------------------------------
+
+# the serving pipeline's hops, in traversal order (query_trace fields);
+# solo-server traces carry None for the router-side hops and simply
+# contribute nothing to those rows
+QUERY_HOPS = ("router_queue_s", "forward_s", "replica_queue_s",
+              "device_s", "serialize_s")
+
+
+def load_query_traces(paths) -> list:
+    """Every ``query_trace`` record from the given JSONL streams (same
+    torn-stream tolerance as :func:`load_spans`)."""
+    traces = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) \
+                        and obj.get("event") == "query_trace":
+                    traces.append(obj)
+    traces.sort(key=lambda t: (t.get("ts") or 0.0, t.get("pid") or 0,
+                               t.get("seq") or 0))
+    return traces
+
+
+def _percentile(values, q: float) -> float:
+    """Nearest-rank percentile over a non-empty list."""
+    vs = sorted(values)
+    k = max(0, min(len(vs) - 1, int(round(q * (len(vs) - 1)))))
+    return vs[k]
+
+
+def query_waterfall(traces) -> dict:
+    """The per-hop latency waterfall over sampled query traces:
+    ``{"traces", "hops": {hop: {n, p50_s, p99_s, mean_s}}, "total":
+    {...}, "dominant_hop", "requeued", "replicas"}``.  The dominant hop
+    is the largest p99 — the tail is what an SLA pages on, so the hop
+    that owns the tail is the hop to fix."""
+    hops = {}
+    for hop in QUERY_HOPS:
+        vals = [float(t[hop]) for t in traces
+                if t.get(hop) is not None]
+        if vals:
+            hops[hop] = {"n": len(vals),
+                         "p50_s": _percentile(vals, 0.50),
+                         "p99_s": _percentile(vals, 0.99),
+                         "mean_s": sum(vals) / len(vals)}
+    totals = [float(t["total_s"]) for t in traces
+              if t.get("total_s") is not None]
+    dominant = (max(hops, key=lambda h: hops[h]["p99_s"])
+                if hops else None)
+    replicas = {}
+    for t in traces:
+        rep = t.get("replica")
+        if rep is not None:
+            replicas[rep] = replicas.get(rep, 0) + 1
+    return {
+        "traces": len(traces),
+        "hops": hops,
+        "total": ({"n": len(totals),
+                   "p50_s": _percentile(totals, 0.50),
+                   "p99_s": _percentile(totals, 0.99),
+                   "mean_s": sum(totals) / len(totals)}
+                  if totals else None),
+        "dominant_hop": dominant,
+        "requeued": sum(int(t.get("requeues") or 0) for t in traces),
+        "replicas": replicas,
+    }
+
+
+def render_queries(wf: dict) -> str:
+    """The waterfall as a fixed-width table plus the dominant-hop
+    verdict — the human half of ``--queries`` (the dict itself is the
+    machine half serve_bench reads)."""
+    lines = [f"query traces: {wf['traces']} sampled"
+             + (f", {wf['requeued']} requeue(s) survived"
+                if wf["requeued"] else "")
+             + (", replicas " + ", ".join(
+                 f"{r}={n}" for r, n in sorted(wf["replicas"].items()))
+                if wf["replicas"] else "")]
+    header = f"  {'hop':<16} {'n':>6} {'p50':>10} {'p99':>10} {'mean':>10}"
+    lines.append(header)
+    for hop in QUERY_HOPS:
+        st = wf["hops"].get(hop)
+        if st is None:
+            continue
+        mark = "  <- dominant" if hop == wf["dominant_hop"] else ""
+        lines.append(
+            f"  {hop[:-2]:<16} {st['n']:>6} {st['p50_s']*1e3:>8.3f}ms "
+            f"{st['p99_s']*1e3:>8.3f}ms {st['mean_s']*1e3:>8.3f}ms"
+            f"{mark}")
+    if wf["total"]:
+        st = wf["total"]
+        lines.append(
+            f"  {'total':<16} {st['n']:>6} {st['p50_s']*1e3:>8.3f}ms "
+            f"{st['p99_s']*1e3:>8.3f}ms {st['mean_s']*1e3:>8.3f}ms")
+    if wf["dominant_hop"]:
+        lines.append(f"dominant hop: {wf['dominant_hop'][:-2]} "
+                     f"(p99 {wf['hops'][wf['dominant_hop']]['p99_s']*1e3:.3f}ms)")
+    return "\n".join(lines)
+
+
 # --- CLI --------------------------------------------------------------------
 
 
@@ -364,6 +477,7 @@ def render_report(spans, top: int = 10) -> str:
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     inputs, trace_out, metrics_out, top = [], None, None, 10
+    queries = False
     for a in argv:
         if a.startswith("--trace="):
             trace_out = a.split("=", 1)[1]
@@ -371,6 +485,8 @@ def main(argv=None) -> int:
             metrics_out = a.split("=", 1)[1]
         elif a.startswith("--top="):
             top = int(a.split("=", 1)[1])
+        elif a == "--queries":
+            queries = True
         elif a.startswith("-"):
             print(f"unknown flag {a!r}", file=sys.stderr)
             return 2
@@ -379,12 +495,22 @@ def main(argv=None) -> int:
     if not inputs:
         print("usage: python -m cocoa_tpu.telemetry.trace_report "
               "EVENTS.jsonl [EVENTS.jsonl.p1 ...] [--trace=OUT.json] "
-              "[--metrics=OUT.prom] [--top=N]", file=sys.stderr)
+              "[--metrics=OUT.prom] [--top=N] [--queries]",
+              file=sys.stderr)
         return 2
     missing = [p for p in inputs if not os.path.exists(p)]
     if missing:
         print(f"no such file(s): {missing}", file=sys.stderr)
         return 2
+    if queries:
+        traces = load_query_traces(inputs)
+        if not traces:
+            print("no query_trace events in the given streams (was the "
+                  "server run with --traceSample and trace=-prefixed "
+                  "queries?)", file=sys.stderr)
+            return 1
+        print(render_queries(query_waterfall(traces)))
+        return 0
     spans = load_spans(inputs)
     if not spans:
         print("no span events in the given streams (was the run traced? "

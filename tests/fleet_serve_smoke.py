@@ -13,7 +13,19 @@ guarantees end to end over plain sockets:
   replica and the router folding it back in;
 - **one compile per (bucket, dtype) per replica process**: each
   replica's event stream carries exactly two ``serve_margins`` compile
-  records per process lifetime, whatever T is.
+  records per process lifetime, whatever T is;
+- **sampled query tracing + the live ops plane** (docs/DESIGN.md §22):
+  clients prefix ``trace=<id>;`` and the router's ``--traceSample``
+  emits schema-valid ``query_trace`` events with router AND replica
+  hops filled; ``--statusPort`` answers ``/healthz`` (degraded while
+  the SIGKILLed replica is down, ok again after the respawn),
+  ``/metrics`` (merged exposition with ``replica="rN"`` labels and the
+  tenant-labeled gap-age gauge), and ``/slo`` (rolling attainment over
+  the fleet-wide latency histogram);
+- **per-replica metrics file ownership**: each replica owns a distinct
+  ``<metrics>.r<N>`` textfile; a respawn inherits the SLOT (the new
+  process atomically overwrites the dead one's file — its compile
+  counter restarts at 2), never interleaves.
 
 Not a pytest file (no ``test_`` prefix): run it directly —
 
@@ -89,7 +101,14 @@ def main(argv=None) -> int:
     w = np.asarray(w, np.float32)
     w_cat = np.stack([w * s for s in SCALES])
     round0 = int(meta["round"])
-    ckpt_lib.save(cat, "CoCoA+", round0, w_cat, None, gap=1e-4)
+    # per-tenant certification metadata rides the stacked checkpoint
+    # (docs/DESIGN.md §22) — what the tenant-labeled gap-age gauge and
+    # the /metrics plane render from
+    now = time.time()
+    ckpt_lib.save(cat, "CoCoA+", round0, w_cat, None, gap=1e-4,
+                  tenant_gaps=[1e-4] * len(SCALES),
+                  tenant_cert_ts=[now - 10.0 * t
+                                  for t in range(len(SCALES))])
     print(f"fleet-smoke: catalogue saved — {len(SCALES)} tenants, "
           f"shape {w_cat.shape}, r{round0}", flush=True)
 
@@ -112,6 +131,7 @@ def fleet_phase(cat, round0, events_path, metrics_path, env) -> list:
          "--serveReplicas=2", "--serveRoute=tenant",
          f"--chkptDir={cat}", f"--numFeatures={D}",
          "--serveBatch=8,64", "--serveSlaMs=200",
+         "--traceSample=4", "--statusPort=0",
          f"--events={events_path}", f"--metrics={metrics_path}"],
         cwd=ROOT, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
@@ -156,12 +176,28 @@ def fleet_phase(cat, round0, events_path, metrics_path, env) -> list:
         if "tenants=4" not in announce:
             failures.append(f"announce does not declare the catalogue: "
                             f"{announce.rstrip()}")
+        status_ln = wait_for(
+            lambda: next((ln for ln in lines
+                          if "status listening on" in ln), None),
+            "the status-plane announce", timeout=60)
+        if status_ln is None:
+            return failures
+        status_port = int(status_ln.split("status listening on ")[1]
+                          .strip().rsplit(":", 1)[1])
+
+        def ops(path):
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{status_port}{path}",
+                    timeout=30) as r:
+                return r.read().decode()
 
         s = socket.create_connection(("127.0.0.1", port), timeout=60)
         f = s.makefile("rwb")
 
-        def score(tenant):
-            f.write(f"tenant={tenant};3:1.0;5:2.5 "
+        def score(tenant, trace=None):
+            prefix = f"trace={trace};" if trace else ""
+            f.write(f"{prefix}tenant={tenant};3:1.0;5:2.5 "
                     f"7:-1.0;10:0.5\n".encode())
             f.flush()
             return json.loads(f.readline())
@@ -188,13 +224,53 @@ def fleet_phase(cat, round0, events_path, metrics_path, env) -> list:
         print("fleet-smoke: all tenants answer bit-exactly against "
               "their catalogue rows", flush=True)
 
+        # --- sampled tracing + the ops plane, pre-drill --------------
+        # --traceSample=4 with a deterministic counter: the first
+        # trace=-prefixed line is always sampled, so 8 traced lines
+        # yield >= 2 query_trace events at the front door
+        for k in range(8):
+            resp = score(k % len(SCALES), trace=f"{k:08x}")
+            if not (isinstance(resp, list)
+                    and all("margin" in r for r in resp)):
+                failures.append(f"traced query {k} got {resp}")
+        hz = json.loads(ops("/healthz"))
+        if hz.get("status") != "ok" or hz.get("replicas_live") != 2:
+            failures.append(f"pre-drill /healthz not ok: {hz}")
+        # the replicas' slot textfiles flush on a 5s heartbeat — wait
+        # for the merged exposition to carry both replicas + the
+        # tenant-labeled gap age before asserting
+        tenant_needle = ('cocoa_model_gap_age_seconds'
+                         '{replica="r0",tenant="0"}')
+        merged = ops("/metrics")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and (
+                'replica="r1"' not in merged
+                or tenant_needle not in merged):
+            time.sleep(1.0)
+            merged = ops("/metrics")
+        for needle in ('replica="r0"', 'replica="r1"', tenant_needle):
+            if needle not in merged:
+                failures.append(f"{needle!r} missing from the merged "
+                                f"/metrics exposition")
+        slo = json.loads(ops("/slo"))
+        for field in ("attainment", "burn_fast", "burn_slow",
+                      "served_total", "over_sla_total",
+                      "replicas_live"):
+            if field not in slo:
+                failures.append(f"/slo missing {field!r}: {slo}")
+        print(f"fleet-smoke: ops plane up — /healthz ok, /metrics "
+              f"merged with replica labels, /slo served_total="
+              f"{slo.get('served_total')}", flush=True)
+
         # --- catalogue hot-swap: both replicas must pick it up -------
         from cocoa_tpu import checkpoint as ckpt_lib
 
         _, w_cat, _ = ckpt_lib.load(ckpt_lib.latest(cat, "CoCoA+"))
         new_round = round0 + 10
         ckpt_lib.save(cat, "CoCoA+", new_round,
-                      np.asarray(w_cat) * 0.5, None, gap=1e-5)
+                      np.asarray(w_cat) * 0.5, None, gap=1e-5,
+                      tenant_gaps=[1e-5] * len(SCALES),
+                      tenant_cert_ts=[time.time()] * len(SCALES))
         print(f"fleet-smoke: injected catalogue generation "
               f"r{new_round}", flush=True)
         swapped = {}
@@ -240,6 +316,26 @@ def fleet_phase(cat, round0, events_path, metrics_path, env) -> list:
         print(f"fleet-smoke: {answered}/30 queries answered through "
               f"the kill window", flush=True)
 
+        # mid-drill /healthz: the router marked r0 dead at the first
+        # failed forward, so the plane must show it down (degraded)
+        # before the monitor's respawn re-registers it
+        hz = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            hz = json.loads(ops("/healthz"))
+            if hz.get("replicas_live") == 1:
+                break
+            time.sleep(0.5)
+        r0_row = (hz or {}).get("replicas", {}).get("r0", {})
+        if not hz or hz.get("replicas_live") != 1 \
+                or hz.get("status") != "degraded" \
+                or r0_row.get("live") is not False:
+            failures.append(f"/healthz never showed r0 down after the "
+                            f"SIGKILL: {hz}")
+        else:
+            print("fleet-smoke: /healthz degraded with r0 down "
+                  "mid-drill", flush=True)
+
         # the monitor must respawn r0 (a second pid note) and the
         # respawned replica must serve the LATEST generation
         if wait_for(lambda: len(pids.get("r0", [])) >= 2,
@@ -257,6 +353,18 @@ def fleet_phase(cat, round0, events_path, metrics_path, env) -> list:
             else:
                 print("fleet-smoke: respawned r0 rejoined routing on "
                       "the injected generation", flush=True)
+            hz = json.loads(ops("/healthz"))
+            if hz.get("status") != "ok" or hz.get("replicas_live") != 2:
+                failures.append(f"post-respawn /healthz not ok: {hz}")
+            else:
+                print("fleet-smoke: /healthz ok again after the "
+                      "respawn", flush=True)
+        # a second /slo evaluation gives the burn windows a delta to
+        # compute over (two snapshots inside the fast window)
+        slo = json.loads(ops("/slo"))
+        if not slo.get("served_total"):
+            failures.append(f"post-drill /slo shows no served "
+                            f"traffic: {slo}")
 
         f.write(b"shutdown\n")
         f.flush()
@@ -336,7 +444,8 @@ def stream_checks(events_path, metrics_path, new_round) -> list:
     metrics_text = open(metrics_path).read()
     for needle in ("cocoa_serve_replicas_live 2",
                    "cocoa_serve_shed_total",
-                   "cocoa_serve_requeue_total"):
+                   "cocoa_serve_requeue_total",
+                   "cocoa_query_traces_total"):
         if needle not in metrics_text:
             failures.append(f"{needle!r} missing from the fleet "
                             f"metrics textfile")
@@ -344,6 +453,55 @@ def stream_checks(events_path, metrics_path, new_round) -> list:
     if m and int(m.group(1)) < 1:
         failures.append("cocoa_serve_requeue_total is 0 after a "
                         "SIGKILL under traffic")
+
+    # sampled query traces: the front door (the router owns fleet
+    # emission) must carry schema-valid query_trace events with BOTH
+    # the router-side and the replica-side hops filled, and the
+    # waterfall assembler must name a dominant hop over them
+    qts = [r for r in recs if r["event"] == "query_trace"]
+    if len(qts) < 2:
+        failures.append(f"expected >=2 query_trace events at the "
+                        f"front door (8 traced lines at "
+                        f"--traceSample=4), got {len(qts)}")
+    for qt in qts:
+        for hop in ("router_queue_s", "replica_queue_s", "device_s",
+                    "serialize_s", "total_s"):
+            if qt.get(hop) is None:
+                failures.append(f"query_trace {qt.get('trace_id')} "
+                                f"missing hop {hop}: {qt}")
+        if qt.get("replica") not in ("r0", "r1"):
+            failures.append(f"query_trace names no replica: {qt}")
+    from cocoa_tpu.telemetry import trace_report
+    wf = trace_report.query_waterfall(qts)
+    if qts and wf["dominant_hop"] is None:
+        failures.append(f"query waterfall names no dominant hop: {wf}")
+
+    # per-replica metrics SLOT ownership: each replica owns a distinct
+    # .r<N> textfile; the respawned r0 process inherited the slot and
+    # atomically overwrote it — its compile counter restarts at the
+    # fresh process's 2 (the .r0 EVENT stream, which appends, holds 4)
+    for i in (0, 1):
+        mpath = f"{metrics_path}.r{i}"
+        if not os.path.exists(mpath):
+            failures.append(f"missing per-replica metrics file {mpath}")
+            continue
+        mtext = open(mpath).read()
+        if "cocoa_model_round" not in mtext:
+            failures.append(f"{mpath} carries no model round — not a "
+                            f"serve replica's textfile?")
+        cm = re.search(r"cocoa_compiles_total (\d+)", mtext)
+        want = 2   # one compile per bucket for THIS process lifetime
+        if not cm or int(cm.group(1)) != want:
+            failures.append(
+                f"{mpath} shows cocoa_compiles_total "
+                f"{cm.group(1) if cm else 'absent'}, expected {want} — "
+                f"the slot file must be owned by exactly the newest "
+                f"process in the slot, never interleaved")
+    r0_metrics = open(f"{metrics_path}.r0").read() \
+        if os.path.exists(f"{metrics_path}.r0") else ""
+    if 'cocoa_model_gap_age_seconds{tenant="0"}' not in r0_metrics:
+        failures.append("tenant-labeled gap age missing from the "
+                        "respawned r0's metrics slot file")
     return failures
 
 

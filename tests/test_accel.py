@@ -192,6 +192,7 @@ def test_accel_off_bit_identical_all_modes(mode):
     assert [r.round for r in t_o.records] == [r.round for r in t_p.records]
 
 
+@pytest.mark.slow
 def test_accel_auto_resolution():
     """auto = on for gap-targeted CoCoA+ runs, off without a target (the
     fixed-round benchmark paths stay bit-comparable)."""

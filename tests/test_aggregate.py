@@ -1,0 +1,277 @@
+"""The fleet ops plane (cocoa_tpu/telemetry/aggregate.py): textfile
+merging, the rolling SLO math, and the HTTP status endpoints.
+
+What these tests pin:
+
+- **exposition parsing**: ``split_sample`` never throws on a torn or
+  garbage line, ``family`` folds histogram member suffixes;
+- **the merge**: every sample gains a PREPENDED ``replica="<label>"``
+  with existing labels kept, families group under exactly one ``# TYPE``
+  line (first typed wins, untyped upgraded), and the merge is
+  deterministic in sorted-label order;
+- **latency accounting**: within-SLA is the cumulative bucket at the
+  largest edge <= SLA — latencies in the straddling bucket count as
+  over (conservative, never optimistic);
+- **the SLO tracker**: injectable clock, attainment/burn from in-window
+  cumulative deltas, lifetime fallback until a window holds a delta,
+  and the snapshot horizon prune;
+- **the HTTP plane**: /metrics, /healthz (ok vs degraded vs the
+  live=null untracked source), /slo + its typed ``slo_status`` event,
+  404 on unknown routes — over a real ephemeral-port server;
+- renderers are also exercised directly (no sockets), because that is
+  the surface the fleet smoke's curl checks stand on.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from cocoa_tpu.telemetry import aggregate
+from cocoa_tpu.telemetry import events as tele_events
+from cocoa_tpu.telemetry import schema as tele_schema
+
+
+@pytest.fixture(autouse=True)
+def clean_bus():
+    tele_events.get_bus().reset()
+    yield tele_events.get_bus()
+    tele_events.get_bus().reset()
+
+
+# --- exposition parsing ------------------------------------------------------
+
+
+def test_split_sample_shapes_and_garbage():
+    assert aggregate.split_sample("cocoa_x 3") == ("cocoa_x", "", "3")
+    assert aggregate.split_sample(
+        'cocoa_x{tenant="0",le="0.5"} 1.5') == (
+            "cocoa_x", 'tenant="0",le="0.5"', "1.5")
+    for junk in ("", "   ", "# HELP cocoa_x whatever",
+                 "# TYPE cocoa_x counter", "cocoa_x", "cocoa_x notnum",
+                 "{oops} 3", "cocoa_x{unclosed 3"):
+        assert aggregate.split_sample(junk) == (None, None, None), junk
+
+
+def test_family_folds_histogram_members():
+    assert aggregate.family("cocoa_round_seconds_bucket") \
+        == "cocoa_round_seconds"
+    assert aggregate.family("cocoa_round_seconds_sum") \
+        == "cocoa_round_seconds"
+    assert aggregate.family("cocoa_round_seconds_count") \
+        == "cocoa_round_seconds"
+    assert aggregate.family("cocoa_rounds_total") == "cocoa_rounds_total"
+
+
+def test_merge_prepends_replica_and_groups_types():
+    merged = aggregate.merge_expositions({
+        "r1": ("# TYPE cocoa_c counter\n"
+               "cocoa_c 2\n"
+               'cocoa_g{tenant="1"} 7\n'),
+        "r0": ("# TYPE cocoa_c counter\n"
+               "cocoa_c 1\n"),
+    })
+    lines = merged.splitlines()
+    # one TYPE line per family, sources merged in sorted-label order
+    assert lines.count("# TYPE cocoa_c counter") == 1
+    assert 'cocoa_c{replica="r0"} 1' in lines
+    assert 'cocoa_c{replica="r1"} 2' in lines
+    assert lines.index('cocoa_c{replica="r0"} 1') \
+        < lines.index('cocoa_c{replica="r1"} 2')
+    # existing labels survive AFTER the replica label
+    assert 'cocoa_g{replica="r1",tenant="1"} 7' in lines
+    # the no-TYPE family got an untyped declaration
+    assert "# TYPE cocoa_g untyped" in lines
+
+
+def test_merge_upgrades_untyped_family():
+    # r0 (sorted first) carries the sample with no TYPE; r1 declares it
+    merged = aggregate.merge_expositions({
+        "r0": "cocoa_c 1\n",
+        "r1": "# TYPE cocoa_c counter\ncocoa_c 2\n",
+    })
+    assert "# TYPE cocoa_c counter" in merged
+    assert "untyped" not in merged
+
+
+def test_read_sources_skips_missing(tmp_path):
+    p = tmp_path / "m.prom"
+    p.write_text("cocoa_c 1\n")
+    out = aggregate.read_sources({"a": str(p),
+                                  "b": str(tmp_path / "nope.prom")})
+    assert out == {"a": "cocoa_c 1\n"}
+
+
+def test_scrape_gauge_unlabeled_only():
+    text = ('cocoa_model_gap_age_seconds{tenant="0"} 9\n'
+            "cocoa_model_gap_age_seconds 3.5\n")
+    assert aggregate.scrape_gauge(text,
+                                  "cocoa_model_gap_age_seconds") == 3.5
+    assert aggregate.scrape_gauge(text, "cocoa_model_round") is None
+
+
+def _hist(counts_by_edge, total):
+    lines = ["# TYPE cocoa_serve_latency_seconds histogram"]
+    cum = 0
+    for edge, n in counts_by_edge:
+        cum += n
+        lines.append(f'cocoa_serve_latency_seconds_bucket{{le="{edge}"}}'
+                     f" {cum}")
+    lines.append(f'cocoa_serve_latency_seconds_bucket{{le="+Inf"}}'
+                 f" {total}")
+    lines.append(f"cocoa_serve_latency_seconds_count {total}")
+    return "\n".join(lines) + "\n"
+
+
+def test_latency_totals_conservative_at_the_straddle():
+    # 10 under 0.025s, 2 in (0.025, 0.05], 2 beyond: at sla=0.04 the
+    # largest edge <= sla is 0.025, so the straddling 2 count as over
+    text = _hist([("0.025", 10), ("0.05", 2)], 14)
+    assert aggregate.latency_totals({"r0": text}, 0.04) == (14, 4)
+    # at sla=0.05 the 0.05 bucket is within — only the tail is over
+    assert aggregate.latency_totals({"r0": text}, 0.05) == (14, 2)
+
+
+def test_latency_totals_sums_across_sources():
+    a = _hist([("0.05", 5)], 6)
+    b = _hist([("0.05", 3)], 3)
+    assert aggregate.latency_totals({"r0": a, "r1": b}, 0.05) == (9, 1)
+
+
+# --- the rolling SLO math ----------------------------------------------------
+
+
+def test_slo_tracker_windows_burn_and_fallback():
+    trk = aggregate.SloTracker(0.05, objective=0.99, fast_s=10.0,
+                               slow_s=100.0)
+    # empty: nothing to report
+    s = trk.status(now=0.0)
+    assert s["attainment"] is None and s["served_total"] == 0
+    trk.observe(100, 1, now=0.0)
+    # one snapshot: no window delta yet — lifetime fallback answers
+    s = trk.status(now=0.0)
+    assert s["attainment"] == pytest.approx(0.99)
+    assert s["burn_fast"] is None and s["burn_slow"] is None
+    # +5s: 100 more served, 2 more over — both windows hold the delta
+    trk.observe(200, 3, now=5.0)
+    s = trk.status(now=5.0)
+    assert s["attainment"] == pytest.approx(0.98)
+    assert s["burn_fast"] == pytest.approx(2.0)
+    assert s["burn_slow"] == pytest.approx(2.0)
+    assert s["served_total"] == 200 and s["over_sla_total"] == 3
+    # +50s: the fast window has slid past both snapshots' delta
+    trk.observe(200, 3, now=55.0)
+    s = trk.status(now=55.0)
+    assert s["burn_fast"] is None          # no traffic inside 10s
+    assert s["burn_slow"] == pytest.approx(2.0)
+
+
+def test_slo_tracker_prunes_but_keeps_a_base():
+    trk = aggregate.SloTracker(0.05, slow_s=10.0)
+    for t in range(0, 100, 5):
+        trk.observe(t * 10, 0, now=float(t))
+    # snapshots older than 2x slow_s are gone, a base survives
+    assert len(trk._snaps) <= 6
+    assert trk.status(now=95.0)["attainment"] == pytest.approx(1.0)
+
+
+def test_slo_tracker_rejects_bad_objective():
+    with pytest.raises(ValueError):
+        aggregate.SloTracker(0.05, objective=1.0)
+
+
+# --- the HTTP plane ----------------------------------------------------------
+
+
+def _write_replica(tmp_path, name, rnd, age, hist=None):
+    p = tmp_path / f"m.prom.{name}"
+    text = (f"# TYPE cocoa_model_round gauge\n"
+            f"cocoa_model_round {rnd}\n"
+            f"# TYPE cocoa_model_gap_age_seconds gauge\n"
+            f"cocoa_model_gap_age_seconds {age}\n")
+    if hist:
+        text += hist
+    p.write_text(text)
+    return str(p)
+
+
+def test_renderers_healthz_ok_degraded_and_untracked(tmp_path):
+    router_prom = tmp_path / "m.prom"
+    router_prom.write_text("cocoa_compiles_total 0\n")
+    paths = {"r0": _write_replica(tmp_path, "r0", 3, 1.5),
+             "r1": _write_replica(tmp_path, "r1", 5, 0.5),
+             "router": str(router_prom)}
+    live = {"r0": True, "r1": True}
+    plane = aggregate.StatusServer(lambda: paths, sla_s=0.05,
+                                   liveness_fn=lambda: dict(live))
+    h = json.loads(plane.render_healthz())
+    assert h["status"] == "ok"
+    assert h["round"] == 5 and h["replicas_live"] == 2
+    assert h["replicas"]["r0"]["round"] == 3
+    assert h["replicas"]["r0"]["gap_age_s"] == pytest.approx(1.5)
+    # the router's own source is scraped but untracked: live=null
+    assert h["replicas"]["router"]["live"] is None
+    live["r0"] = False
+    h = json.loads(plane.render_healthz())
+    assert h["status"] == "degraded" and h["replicas_live"] == 1
+    assert h["replicas"]["r0"]["live"] is False
+    plane._http.server_close()
+
+
+def test_renderers_solo_server_counts_sources_as_live(tmp_path):
+    paths = {"server": _write_replica(tmp_path, "s", 2, 0.1)}
+    plane = aggregate.StatusServer(lambda: paths, sla_s=0.05)
+    h = json.loads(plane.render_healthz())
+    assert h["status"] == "ok" and h["replicas"]["server"]["live"]
+    plane._http.server_close()
+
+
+def test_status_server_http_routes_and_slo_event(tmp_path, clean_bus):
+    ev = tmp_path / "ev.jsonl"
+    clean_bus.configure(jsonl_path=str(ev))
+    hist = _hist([("0.025", 8), ("0.05", 1)], 10)
+    paths = {"r0": _write_replica(tmp_path, "r0", 7, 0.2, hist=hist)}
+    plane = aggregate.StatusServer(lambda: paths, sla_s=0.05,
+                                   liveness_fn=lambda: {"r0": True}
+                                   ).start()
+    try:
+        host, port = plane.address
+
+        def get(route):
+            return urllib.request.urlopen(
+                f"http://{host}:{port}{route}", timeout=10)
+
+        body = get("/metrics").read().decode()
+        assert 'cocoa_model_round{replica="r0"} 7' in body
+        h = json.loads(get("/healthz").read().decode())
+        assert h["status"] == "ok" and h["round"] == 7
+        s = json.loads(get("/slo").read().decode())
+        assert s["served_total"] == 10 and s["over_sla_total"] == 1
+        assert s["sla_ms"] == pytest.approx(50.0)
+        assert s["replicas_live"] == 1
+        with pytest.raises(urllib.error.HTTPError):
+            get("/nope")
+    finally:
+        plane.stop()
+    # the /slo evaluation landed as a schema-valid typed event
+    assert not tele_schema.check_file(str(ev))
+    recs = [json.loads(ln) for ln in open(ev) if ln.strip()]
+    slo = [r for r in recs if r.get("event") == "slo_status"]
+    assert len(slo) == 1 and slo[0]["served_total"] == 10
+
+
+def test_status_server_survives_a_torn_scrape(tmp_path):
+    # a sources_fn that throws must answer 500, not kill the plane
+    def bad_sources():
+        raise RuntimeError("torn")
+
+    plane = aggregate.StatusServer(bad_sources, sla_s=0.05).start()
+    try:
+        host, port = plane.address
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10)
+        assert ei.value.code == 500
+    finally:
+        plane.stop()

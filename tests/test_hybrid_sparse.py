@@ -176,11 +176,12 @@ def test_hot_cols_rejects_dense_layout(zipf_data):
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode,sigma", [
     ("cocoa", 1.0),
-    # tier-1 budget: one arm keeps the fast-sweep parity signal; the
-    # plus/frozen arms run under -m slow and in the dedicated CI parity
-    # step (which runs this file unfiltered)
+    # tier-1 budget (rounds 22/24): every arm now rides -m slow — the
+    # dedicated CI parity step runs this file unfiltered, so the parity
+    # contract keeps its own CI signal
     pytest.param("plus", 4.0, marks=pytest.mark.slow),
     pytest.param("frozen", 1.0, marks=pytest.mark.slow)])
 def test_hybrid_block_matches_fast(zipf_data, mode, sigma):
@@ -206,6 +207,7 @@ def test_hybrid_block_matches_fast(zipf_data, mode, sigma):
                      mode, sigma, rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_hybrid_block_f64(zipf_data):
     """f64 pins the algebra at ~1e-12 — the same 'bit-comparable at f64'
     contract the round-6 kernel carries (fp reassociation is the entire
@@ -227,6 +229,7 @@ def test_hybrid_block_f64(zipf_data):
                      "plus", 4.0, rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_hybrid_block_split_fallback_segmented(zipf_data, monkeypatch):
     """The SMEM split-fallback branch: shrink the budget so the residual
     Gram runs in (S, S) row-segment tiles, and span two blocks (H=200)
@@ -284,11 +287,12 @@ def test_hybrid_densified_fallback(zipf_data):
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode,sigma", [
     ("cocoa", 1.0),
-    # tier-1 budget: one arm keeps the fast-sweep parity signal; the
-    # plus/frozen arms run under -m slow and in the dedicated CI parity
-    # step (which runs this file unfiltered)
+    # tier-1 budget (rounds 22/24): every arm now rides -m slow — the
+    # dedicated CI parity step runs this file unfiltered, so the parity
+    # contract keeps its own CI signal
     pytest.param("plus", 4.0, marks=pytest.mark.slow),
     pytest.param("frozen", 1.0, marks=pytest.mark.slow)])
 def test_hybrid_seq_kernel_matches_fast(zipf_data, mode, sigma):
@@ -313,6 +317,7 @@ def test_hybrid_seq_kernel_matches_fast(zipf_data, mode, sigma):
                      mode, sigma, rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_hybrid_seq_kernel_segmented(zipf_data, monkeypatch):
     """SMEM segmentation of the sequential hybrid round: the hot Δw must
     carry across segment boundaries exactly like [w | Δw] does."""
@@ -377,6 +382,7 @@ def test_auto_block_size_hybrid(zipf_data):
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_hybrid_through_driver_block(zipf_data):
     """run_cocoa on the hybrid layout (sparse-Gram block path) reproduces
     the unsplit fast-path trajectory, including the final duality gap."""

@@ -1,0 +1,281 @@
+"""Per-query distributed tracing (docs/DESIGN.md §22): the
+``trace=<id>;`` prefix through the solo server and the fleet router.
+
+What these tests pin:
+
+- **grammar**: the id is 1-32 lowercase hex; the solo server rejects a
+  malformed prefix with the numbers, the router leaves it on the line
+  (pure relay) so the replica's rejection reaches the client;
+- **the off switch**: ``--traceSample=0`` answers a trace-prefixed
+  line BYTE-identically to the same line without the prefix — tracing
+  off is bit-exact, the acceptance pin;
+- **deterministic sampling**: the first trace-prefixed line is always
+  sampled, then every Nth; unsampled lines are byte-identical to
+  untraced ones;
+- **the colon form** (``trace=<id>:<us>;``, the router's upstream
+  mark): always stamps the response's ``"trace"`` object, never emits
+  the event (the router owns it);
+- **real-socket round trips**: the id survives the overflow-forward
+  (loaded home replica -> the idle one) and the requeue past a dead
+  replica, and the router's ``query_trace`` event carries the hop
+  breakdown with per-replica attribution — schema-validated.
+
+The socket tests build compiled serving stacks, so they ride the slow
+marker; the tier-1 sweep covers the grammar/prefix units only.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cocoa_tpu import checkpoint as ckpt_lib
+from cocoa_tpu import serving
+from cocoa_tpu.serving.router import Router
+from cocoa_tpu.serving.server import MarginServer
+from cocoa_tpu.telemetry import events as tele
+from cocoa_tpu.telemetry import schema as tele_schema
+
+D = 24
+
+
+@pytest.fixture
+def bus(tmp_path):
+    b = tele.get_bus()
+    b.reset()
+    path = tmp_path / "events.jsonl"
+    b.configure(jsonl_path=str(path))
+    yield path
+    b.reset()
+
+
+def _read_events(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# --- prefix grammar (no sockets, no compiles) --------------------------------
+
+
+def test_server_peel_trace_forms():
+    peel = MarginServer._peel_trace
+    assert peel(None, "1:0.5") == (None, "1:0.5")
+    assert peel(None, "trace=ab12;1:0.5") == (("ab12", None), "1:0.5")
+    tid, rest = peel(None, "trace=ff:2500;1:0.5")
+    assert tid == ("ff", 0.0025) and rest == "1:0.5"
+    for bad in ("trace=XYZ;1:0.5",          # uppercase
+                "trace=;1:0.5",             # empty id
+                "trace=" + "a" * 33 + ";1:0.5",   # too long
+                "trace=ab:zz;1:0.5",        # non-integer stamp
+                "trace=ab"):                # prefix without a query
+        with pytest.raises(serving.QueryError):
+            peel(None, bad)
+
+
+def test_router_peel_leaves_malformed_untouched():
+    peel = Router._peel_trace
+    assert peel(None, "trace=ab;x") == ("ab", "x")
+    # a bad id stays ON the line: the replica rejects it with the
+    # numbers, the router never swallows input
+    assert peel(None, "trace=XYZ;x") == (None, "trace=XYZ;x")
+    assert peel(None, "trace=ab") == (None, "trace=ab")
+    assert peel(None, "tenant=0;x") == (None, "tenant=0;x")
+
+
+def test_sampler_first_then_every_nth():
+    srv = object.__new__(MarginServer)   # the gate needs no sockets
+    srv.trace_sample = 3
+    import itertools
+
+    srv._trace_seen = itertools.count()
+    assert [srv._sample() for _ in range(7)] == [
+        True, False, False, True, False, False, True]
+    srv.trace_sample = 0
+    assert not srv._sample()
+
+
+# --- real sockets ------------------------------------------------------------
+
+
+def _save(ck, w, round_t=10):
+    ckpt_lib.save(str(ck), "CoCoA+", round_t,
+                  np.asarray(w, np.float32), None, gap=1e-3)
+
+
+def _stack(ck, n_tenants=None):
+    w, info = serving.load_model(ckpt_lib.latest(str(ck), "CoCoA+"))
+    slots = serving.ModelSlots(w, info, dtype=np.float32)
+    scorer = serving.BatchScorer(D, dtype=np.float32, buckets=(4, 16),
+                                 max_nnz=8, n_tenants=n_tenants)
+    scorer.warmup(slots.current()[0])
+    return serving.MicroBatcher(scorer, slots, sla_s=0.01,
+                                algorithm="CoCoA+")
+
+
+def _serve(batcher, n_tenants=None, trace_sample=0):
+    srv = MarginServer(batcher, D, 8, port=0, n_tenants=n_tenants,
+                       trace_sample=trace_sample)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _ask_raw(addr, line):
+    with socket.create_connection(addr, timeout=10) as s:
+        s.sendall((line + "\n").encode())
+        return s.makefile("rb").readline()
+
+
+Q = "1:0.5 3:-0.25"
+
+
+@pytest.mark.slow
+def test_solo_off_and_unsampled_bit_identity(tmp_path, bus):
+    rng = np.random.default_rng(3)
+    _save(tmp_path / "ck", rng.standard_normal(D))
+    batcher = _stack(tmp_path / "ck")
+    try:
+        # trace_sample=0: the prefix is peeled and IGNORED
+        srv = _serve(batcher, trace_sample=0)
+        plain = _ask_raw(srv.address, Q)
+        assert _ask_raw(srv.address, f"trace=ab;{Q}") == plain
+        assert b"trace" not in plain
+        srv.close()
+        # trace_sample=3: line 0 sampled, 1-2 byte-identical to plain
+        srv = _serve(batcher, trace_sample=3)
+        plain = _ask_raw(srv.address, Q)
+        first = _ask_raw(srv.address, f"trace=ab;{Q}")
+        assert b'"trace"' in first
+        assert json.loads(first)["trace"]["id"] == "ab"
+        for _ in range(2):
+            assert _ask_raw(srv.address, f"trace=cd;{Q}") == plain
+        srv.close()
+    finally:
+        batcher.stop()
+    evs = [e for e in _read_events(bus)
+           if e.get("event") == "query_trace"]
+    # only the sampled line emitted, and it is a solo event: no router
+    # hops, no replica attribution
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["trace_id"] == "ab" and ev["replica"] is None
+    assert ev["router_queue_s"] is None and ev["forward_s"] is None
+    assert ev["replica_queue_s"] is not None
+    assert ev["total_s"] > 0
+    assert not tele_schema.check_file(str(bus))
+
+
+@pytest.mark.slow
+def test_solo_colon_form_stamps_but_never_emits(tmp_path, bus):
+    rng = np.random.default_rng(4)
+    _save(tmp_path / "ck", rng.standard_normal(D))
+    batcher = _stack(tmp_path / "ck")
+    try:
+        # sampling OFF: the colon form (router's upstream mark) still
+        # stamps the response — the router that marked it owns the event
+        srv = _serve(batcher, trace_sample=0)
+        resp = json.loads(_ask_raw(srv.address,
+                                   f"trace=beef:1200;{Q}"))
+        assert resp["trace"]["id"] == "beef"
+        assert resp["trace"]["device_s"] is not None
+        srv.close()
+    finally:
+        batcher.stop()
+    assert not [e for e in _read_events(bus)
+                if e.get("event") == "query_trace"]
+
+
+def _dead_listener():
+    """A 'replica' that accepts and instantly hangs up — the router
+    sees a dead connection and must requeue, exactly like a SIGKILLed
+    process whose port is still bound by a respawn race."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+
+    def run():
+        while True:
+            try:
+                c, _ = lsock.accept()
+                c.close()
+            except OSError:
+                return
+
+    threading.Thread(target=run, daemon=True).start()
+    return lsock
+
+
+@pytest.mark.slow
+def test_router_trace_round_trip_overflow_and_requeue(tmp_path, bus):
+    """One fleet, three decision points: plain forward, the
+    overflow-forward off a loaded home, and the requeue past a dead
+    replica — the trace id survives every one of them, and the router's
+    query_trace events attribute each to the replica that answered."""
+    T = 2
+    rng = np.random.default_rng(5)
+    W = rng.standard_normal((T, D)).astype(np.float32)
+    _save(tmp_path / "cat", W)
+    batcher = _stack(tmp_path / "cat", n_tenants=T)
+    dead = _dead_listener()
+    try:
+        r1 = _serve(batcher, n_tenants=T)
+        router = Router([("r0", dead.getsockname()),
+                         ("r1", r1.address)],
+                        sla_s=0.05, route="tenant", trace_sample=1)
+        threading.Thread(target=router.serve_forever,
+                         daemon=True).start()
+        try:
+            # tenant=1 homes on r1 (live): the plain sampled forward
+            resp = json.loads(_ask_raw(router.address,
+                                       f"trace=0a;tenant=1;{Q}"))
+            assert resp["tenant"] == 1 and resp["trace"]["id"] == "0a"
+            # tenant=1 again with r1 LOADED past the shed budget — but
+            # idle r0 (zero inflight) admits: the overflow-forward...
+            # which then finds r0 dead and requeues BACK to r1: both
+            # decision points in one line, id intact
+            rep1 = router.replicas[1]
+            rep1.ewma_s, rep1.inflight = 10.0, 4
+            resp = json.loads(_ask_raw(router.address,
+                                       f"trace=0b;tenant=1;{Q}"))
+            rep1.ewma_s, rep1.inflight = 0.0, 0
+            assert resp["trace"]["id"] == "0b"
+            assert resp["tenant"] == 1
+            assert router.requeue_total >= 1
+            # r0 is now marked dead; a tenant=0 line (home r0) probes
+            # forward to r1 without ever touching the corpse
+            resp = json.loads(_ask_raw(router.address,
+                                       f"trace=0c;tenant=0;{Q}"))
+            assert resp["trace"]["id"] == "0c"
+            # tracing OFF through the SAME fleet is byte-identical
+            router.trace_sample = 0
+            plain = _ask_raw(router.address, f"tenant=0;{Q}")
+            assert _ask_raw(router.address,
+                            f"trace=dd;tenant=0;{Q}") == plain
+        finally:
+            router.stop()
+            router.close()
+        r1.close()
+    finally:
+        batcher.stop()
+        dead.close()
+    evs = {e["trace_id"]: e for e in _read_events(bus)
+           if e.get("event") == "query_trace"}
+    assert set(evs) == {"0a", "0b", "0c"}
+    for ev in evs.values():
+        assert ev["replica"] == "r1"
+        assert ev["router_queue_s"] is not None
+        assert ev["replica_queue_s"] is not None
+        assert ev["device_s"] is not None
+        assert ev["total_s"] >= ev["router_queue_s"]
+    assert evs["0a"]["requeues"] == 0
+    assert evs["0b"]["requeues"] >= 1      # died on r0, replayed on r1
+    assert evs["0b"]["tenant"] == 1
+    # the whole stream — traces plus the requeue's replica_state
+    # exemplar — validates against the typed schema
+    assert not tele_schema.check_file(str(bus))
+    states = [e for e in _read_events(bus)
+              if e.get("event") == "replica_state"
+              and e.get("state") == "requeue"]
+    assert any(s.get("trace_id") == "0b" for s in states)

@@ -264,6 +264,7 @@ def _warm_ds():
     return shard_dataset(data, k=4, layout="dense", dtype=jnp.float32), data.n
 
 
+@pytest.mark.slow
 def test_warm_start_equals_manual_handoff():
     """The in-loop smooth_hinge→hinge handoff must equal the manual
     two-run procedure (SWEEPS.md 'warm smooth_hinge' rows) bit-for-bit:

@@ -225,6 +225,7 @@ def test_warm_read_across_geometry_change(tmp_path):
     _assert_ds_equal(ds_fresh, ds_warm)
 
 
+@pytest.mark.slow
 def test_cached_hybrid_matches_fresh_auto_resolution(tmp_path):
     """``--hotCols=auto`` resolved from the CACHED histogram equals the
     fresh whole-file resolution, the cached residual width equals the

@@ -52,11 +52,12 @@ def _compare_per_shard(da_b, dw_b, sa, w, alpha, idxs, n, mode, sigma,
                                    rtol=rtol, atol=atol)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode,sigma", [
     ("cocoa", 1.0),
-    # tier-1 budget: one arm keeps the fast-sweep parity signal; the
-    # plus/frozen arms run under -m slow and in the dedicated CI parity
-    # step (which runs this file unfiltered)
+    # tier-1 budget (rounds 22/24): every arm now rides -m slow — the
+    # dedicated CI parity step runs this file unfiltered, so the parity
+    # contract keeps its own CI signal
     pytest.param("plus", 4.0, marks=pytest.mark.slow),
     pytest.param("frozen", 1.0, marks=pytest.mark.slow)])
 def test_sparse_block_kernel_matches_fast(tiny_data, mode, sigma):
@@ -82,6 +83,7 @@ def test_sparse_block_kernel_matches_fast(tiny_data, mode, sigma):
                        mode, sigma, rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sparse_block_kernel_f64(tiny_data):
     """Float64 interpret mode pins the algebra tightly (the fp-association
     differences shrink to ~1e-12) — same tolerance contract as the f64
@@ -103,11 +105,12 @@ def test_sparse_block_kernel_f64(tiny_data):
                        "plus", 4.0, rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode,sigma", [
     ("cocoa", 1.0),
-    # tier-1 budget: one arm keeps the fast-sweep parity signal; the
-    # plus/frozen arms run under -m slow and in the dedicated CI parity
-    # step (which runs this file unfiltered)
+    # tier-1 budget (rounds 22/24): every arm now rides -m slow — the
+    # dedicated CI parity step runs this file unfiltered, so the parity
+    # contract keeps its own CI signal
     pytest.param("plus", 4.0, marks=pytest.mark.slow),
     pytest.param("frozen", 1.0, marks=pytest.mark.slow)])
 def test_sparse_block_segmented_smem(tiny_data, monkeypatch, mode, sigma):
@@ -141,6 +144,7 @@ def test_sparse_block_segmented_smem(tiny_data, monkeypatch, mode, sigma):
                        mode, sigma, rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("loss,smoothing", [("smooth_hinge", 0.5),
                                             ("logistic", 1.0)])
 def test_sparse_block_generic_losses(tiny_data, loss, smoothing):
@@ -167,6 +171,7 @@ def test_sparse_block_generic_losses(tiny_data, loss, smoothing):
                        loss=loss, smoothing=smoothing)
 
 
+@pytest.mark.slow
 def test_sparse_block_duplicates_exact(tiny_data):
     """A pathological stream — every draw the same index — makes the Gram
     self-coupling plus the equality tile carry the whole sequential
@@ -203,6 +208,7 @@ def test_seg_rows_and_fits():
     assert not sparse_chain_fits(8, 2544, 47236, 5000, 128, 4)
 
 
+@pytest.mark.slow
 def test_sparse_block_auto_dispatch(monkeypatch):
     """The block dispatch picks the sparse Gram path FROM THE LAYOUT: a
     sparse dataset whose densified tile cannot fit the fused kernel
@@ -262,6 +268,7 @@ def test_sparse_block_rejects_dense_layout(tiny_data):
         )
 
 
+@pytest.mark.slow
 def test_sparse_block_through_driver(tiny_data):
     """Driver integration (the chunked per_round_batched routing): the
     sparse Gram block solver reproduces the no-block fast-path trajectory
@@ -313,6 +320,7 @@ def test_auto_block_size_per_layout(tiny_data):
     assert auto_block_size(ds_wide, 2, jnp.float32) == 0
 
 
+@pytest.mark.slow
 def test_cli_block_size_auto(tmp_path, capsys):
     """--blockSize=auto through the CLI: rejected without --math=fast,
     resolved per layout otherwise."""
